@@ -1,0 +1,42 @@
+"""pipelinedp-tpu: a TPU-native framework for differentially-private
+aggregation over large keyed datasets.
+
+Same capability surface as PipelineDP (reference: pipeline_dp/__init__.py),
+re-designed TPU-first: the aggregation hot path (contribution bounding,
+per-partition combining, partition selection, noise) runs as one fused
+JAX/XLA program over columnar sharded arrays; budget accounting and report
+generation stay host-side.
+"""
+
+from pipelinedp_tpu.aggregate_params import (
+    AggregateParams,
+    CalculatePrivateContributionBoundsParams,
+    CountParams,
+    MeanParams,
+    Metric,
+    Metrics,
+    MechanismType,
+    NoiseKind,
+    NormKind,
+    PartitionSelectionStrategy,
+    PrivacyIdCountParams,
+    PrivateContributionBounds,
+    SelectPartitionsParams,
+    SumParams,
+    VarianceParams,
+)
+from pipelinedp_tpu.budget_accounting import (
+    Budget,
+    BudgetAccountant,
+    MechanismSpec,
+    NaiveBudgetAccountant,
+    PLDBudgetAccountant,
+)
+from pipelinedp_tpu.data_extractors import (
+    DataExtractors,
+    MultiValueDataExtractors,
+    PreAggregateExtractors,
+)
+from pipelinedp_tpu.report_generator import ExplainComputationReport
+
+__version__ = '0.1.0'

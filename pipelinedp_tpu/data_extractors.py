@@ -1,0 +1,41 @@
+"""Data extractors: how to pull (privacy_id, partition_key, value) out of rows.
+
+Reference parity: pipeline_dp/data_extractors.py:5-37. In the TPU build these
+callables run host-side during columnar encoding (see columnar.py); on device
+the data is already struct-of-arrays.
+"""
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+
+@dataclass
+class DataExtractors:
+    """Functions that extract the needed pieces of information from a row."""
+    privacy_id_extractor: Optional[Callable] = None
+    partition_extractor: Optional[Callable] = None
+    value_extractor: Optional[Callable] = None
+
+
+@dataclass
+class PreAggregateExtractors:
+    """Extractors for pre-aggregated data.
+
+    Pre-aggregated rows have form (partition_key, preaggregate_data), where
+    preaggregate_data = (count, sum, n_partitions, n_contributions) describes
+    one privacy unit's contributions to that partition.
+    """
+    partition_extractor: Callable
+    preaggregate_extractor: Callable
+
+
+@dataclass
+class MultiValueDataExtractors(DataExtractors):
+    """Extractors with multiple value columns (each row yields a tuple of
+    values); used for multi-column aggregations."""
+    value_extractors: Optional[tuple] = None
+
+    def __post_init__(self):
+        if self.value_extractors is not None:
+            self.value_extractor = lambda row: tuple(
+                e(row) for e in self.value_extractors)

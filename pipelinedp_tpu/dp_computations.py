@@ -1,0 +1,652 @@
+"""DP numerics: sensitivity calculus, additive mechanisms, mean/variance.
+
+Reference parity: pipeline_dp/dp_computations.py:29-761. The reference wraps
+Google's C++ mechanisms via PyDP; here the numerics are native:
+
+  * Gaussian calibration uses the *analytic Gaussian mechanism* (Balle & Wang
+    2018): the exact delta(sigma) formula inverted by bisection — the same
+    algorithm Google's library implements.
+  * Host-side sampling uses numpy Generator; device-side sampling (the hot
+    path) is fused into the XLA aggregation kernel (ops/noise.py) with
+    counter-based per-partition keys.
+  * The optional native C++ secure sampler (snapped geometric Laplace,
+    native/dpcore) guards against floating-point attacks where required;
+    distributional equivalence is validated by KS tests.
+"""
+
+import abc
+import math
+from dataclasses import dataclass
+from typing import Any, List, Optional, Tuple, Union
+
+import numpy as np
+
+from pipelinedp_tpu import aggregate_params
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu.aggregate_params import NoiseKind, NormKind
+
+# Module-level RNG for host-side mechanisms. Seedable for tests.
+_rng = np.random.default_rng()
+
+
+def seed_mechanism_rng(seed: Optional[int]) -> None:
+    """Seeds the host-side mechanism RNG (tests / reproducibility)."""
+    global _rng
+    _rng = np.random.default_rng(seed)
+
+
+@dataclass
+class ScalarNoiseParams:
+    """Parameters for computing DP sum, count, mean, variance."""
+
+    eps: float
+    delta: float
+    min_value: Optional[float]
+    max_value: Optional[float]
+    min_sum_per_partition: Optional[float]
+    max_sum_per_partition: Optional[float]
+    max_partitions_contributed: int
+    max_contributions_per_partition: Optional[int]
+    noise_kind: NoiseKind
+
+    def __post_init__(self):
+        assert (self.min_value is None) == (
+            self.max_value is None
+        ), "min_value and max_value should be both set or both None."
+        assert (self.min_sum_per_partition is None) == (
+            self.max_sum_per_partition is None
+        ), ("min_sum_per_partition and max_sum_per_partition should be both "
+            "set or both None.")
+
+    def l0_sensitivity(self) -> int:
+        return self.max_partitions_contributed
+
+    @property
+    def bounds_per_contribution_are_set(self) -> bool:
+        return self.min_value is not None and self.max_value is not None
+
+    @property
+    def bounds_per_partition_are_set(self) -> bool:
+        return (self.min_sum_per_partition is not None and
+                self.max_sum_per_partition is not None)
+
+
+def compute_squares_interval(min_value: float,
+                             max_value: float) -> Tuple[float, float]:
+    """Bounds of {x^2 : x in [min_value, max_value]}."""
+    if min_value < 0 < max_value:
+        return 0, max(min_value**2, max_value**2)
+    return min_value**2, max_value**2
+
+
+def compute_middle(min_value: float, max_value: float) -> float:
+    """Overflow-safe midpoint of [min_value, max_value]."""
+    return min_value + (max_value - min_value) / 2
+
+
+def compute_l1_sensitivity(l0_sensitivity: float,
+                           linf_sensitivity: float) -> float:
+    return l0_sensitivity * linf_sensitivity
+
+
+def compute_l2_sensitivity(l0_sensitivity: float,
+                           linf_sensitivity: float) -> float:
+    return math.sqrt(l0_sensitivity) * linf_sensitivity
+
+
+def _norm_cdf(z: float) -> float:
+    return 0.5 * math.erfc(-z / math.sqrt(2))
+
+
+def gaussian_delta(sigma: float, eps: float, l2_sensitivity: float) -> float:
+    """Exact delta of the Gaussian mechanism (Balle & Wang 2018, Thm. 8).
+
+    delta = Phi(D/(2 sigma) - eps sigma/D) - e^eps Phi(-D/(2 sigma) - eps
+    sigma/D) with D = l2_sensitivity.
+    """
+    d = l2_sensitivity
+    a = d / (2 * sigma) - eps * sigma / d
+    b = -d / (2 * sigma) - eps * sigma / d
+    return _norm_cdf(a) - math.exp(eps) * _norm_cdf(b)
+
+
+def gaussian_sigma(eps: float,
+                   delta: float,
+                   l2_sensitivity: float,
+                   tol: float = 1e-12) -> float:
+    """Minimal sigma s.t. the Gaussian mechanism is (eps, delta)-DP.
+
+    Analytic (exact) calibration: bisection on the monotone-decreasing
+    gaussian_delta. Replaces PyDP GaussianMechanism.std
+    (reference dp_computations.py:107-117).
+    """
+    if delta <= 0:
+        raise ValueError("Gaussian mechanism requires delta > 0.")
+    if delta >= 1:
+        raise ValueError("delta must be < 1.")
+    # Bracket sigma: start from the classic sqrt(2 ln(1.25/delta))/eps guess.
+    hi = l2_sensitivity * math.sqrt(2 * math.log(1.25 / delta)) / eps + 1e-12
+    while gaussian_delta(hi, eps, l2_sensitivity) > delta:
+        hi *= 2
+    lo = hi
+    while gaussian_delta(lo, eps, l2_sensitivity) < delta and lo > 1e-300:
+        lo /= 2
+    for _ in range(200):
+        mid = (lo + hi) / 2
+        if gaussian_delta(mid, eps, l2_sensitivity) > delta:
+            lo = mid
+        else:
+            hi = mid
+        if hi - lo <= tol * hi:
+            break
+    return hi
+
+
+def compute_sigma(eps: float, delta: float, l2_sensitivity: float) -> float:
+    """Optimal Gaussian sigma (reference-parity alias of gaussian_sigma)."""
+    return gaussian_sigma(eps, delta, l2_sensitivity)
+
+
+def apply_laplace_mechanism(value: float, eps: float, l1_sensitivity: float):
+    """value + Laplace(b = l1_sensitivity / eps) (reference :120-133)."""
+    return value + _rng.laplace(0, l1_sensitivity / eps)
+
+
+def apply_gaussian_mechanism(value: float, eps: float, delta: float,
+                             l2_sensitivity: float):
+    """value + N(0, sigma^2) with analytic sigma (reference :136-152)."""
+    return value + _rng.normal(0, gaussian_sigma(eps, delta, l2_sensitivity))
+
+
+def _add_random_noise(value: float, eps: float, delta: float,
+                      l0_sensitivity: float, linf_sensitivity: float,
+                      noise_kind: NoiseKind) -> float:
+    if noise_kind == NoiseKind.LAPLACE:
+        return apply_laplace_mechanism(
+            value, eps, compute_l1_sensitivity(l0_sensitivity,
+                                               linf_sensitivity))
+    if noise_kind == NoiseKind.GAUSSIAN:
+        return apply_gaussian_mechanism(
+            value, eps, delta,
+            compute_l2_sensitivity(l0_sensitivity, linf_sensitivity))
+    raise ValueError("Noise kind must be either Laplace or Gaussian.")
+
+
+@dataclass
+class AdditiveVectorNoiseParams:
+    eps_per_coordinate: float
+    delta_per_coordinate: float
+    max_norm: float
+    l0_sensitivity: float
+    linf_sensitivity: float
+    norm_kind: NormKind
+    noise_kind: NoiseKind
+
+
+def _clip_vector(vec: np.ndarray, max_norm: float, norm_kind: NormKind):
+    kind = norm_kind.value
+    if kind == "linf":
+        return np.clip(vec, -max_norm, max_norm)
+    if kind in ("l1", "l2"):
+        order = int(kind[-1])
+        vec_norm = np.linalg.norm(vec, ord=order)
+        return vec * min(1, max_norm / vec_norm)
+    raise NotImplementedError(
+        f"Vector Norm of kind '{kind}' is not supported.")
+
+
+def add_noise_vector(vec: np.ndarray, noise_params: AdditiveVectorNoiseParams):
+    """Clips `vec` to the norm ball and noises each coordinate
+    (reference :198-230)."""
+    vec = _clip_vector(vec, noise_params.max_norm, noise_params.norm_kind)
+    return np.array([
+        _add_random_noise(s, noise_params.eps_per_coordinate,
+                          noise_params.delta_per_coordinate,
+                          noise_params.l0_sensitivity,
+                          noise_params.linf_sensitivity,
+                          noise_params.noise_kind) for s in vec
+    ])
+
+
+def equally_split_budget(eps: float, delta: float, no_mechanisms: int):
+    """Splits (eps, delta) into no_mechanisms shares that sum exactly
+    (reference :233-261)."""
+    if no_mechanisms <= 0:
+        raise ValueError("The number of mechanisms must be a positive integer.")
+    eps_used = delta_used = 0
+    budgets = []
+    for _ in range(no_mechanisms - 1):
+        budget = (eps / no_mechanisms, delta / no_mechanisms)
+        eps_used += budget[0]
+        delta_used += budget[1]
+        budgets.append(budget)
+    budgets.append((eps - eps_used, delta - delta_used))
+    return budgets
+
+
+def _compute_mean_for_normalized_sum(dp_count: float, sum_: float,
+                                     min_value: float, max_value: float,
+                                     eps: float, delta: float,
+                                     l0_sensitivity: float,
+                                     max_contributions_per_partition: float,
+                                     noise_kind: NoiseKind):
+    """DP mean of a normalized sum via the DP count (reference :264-304)."""
+    if min_value == max_value:
+        return min_value
+    middle = compute_middle(min_value, max_value)
+    linf_sensitivity = max_contributions_per_partition * abs(middle - min_value)
+    dp_normalized_sum = _add_random_noise(sum_, eps, delta, l0_sensitivity,
+                                          linf_sensitivity, noise_kind)
+    dp_count_clamped = max(1.0, dp_count)
+    return dp_normalized_sum / dp_count_clamped
+
+
+def compute_dp_var(count: int, normalized_sum: float,
+                   normalized_sum_squares: float,
+                   dp_params: ScalarNoiseParams):
+    """DP (count, sum, mean, variance) from normalized moments
+    (reference :307-366)."""
+    ((count_eps, count_delta), (sum_eps, sum_delta),
+     (sum_squares_eps,
+      sum_squares_delta)) = equally_split_budget(dp_params.eps,
+                                                 dp_params.delta, 3)
+    l0_sensitivity = dp_params.l0_sensitivity()
+
+    dp_count = _add_random_noise(count, count_eps, count_delta, l0_sensitivity,
+                                 dp_params.max_contributions_per_partition,
+                                 dp_params.noise_kind)
+
+    dp_mean = _compute_mean_for_normalized_sum(
+        dp_count, normalized_sum, dp_params.min_value, dp_params.max_value,
+        sum_eps, sum_delta, l0_sensitivity,
+        dp_params.max_contributions_per_partition, dp_params.noise_kind)
+
+    squares_min_value, squares_max_value = compute_squares_interval(
+        dp_params.min_value, dp_params.max_value)
+
+    dp_mean_squares = _compute_mean_for_normalized_sum(
+        dp_count, normalized_sum_squares, squares_min_value, squares_max_value,
+        sum_squares_eps, sum_squares_delta, l0_sensitivity,
+        dp_params.max_contributions_per_partition, dp_params.noise_kind)
+
+    dp_var = dp_mean_squares - dp_mean**2
+    if dp_params.min_value != dp_params.max_value:
+        dp_mean += compute_middle(dp_params.min_value, dp_params.max_value)
+
+    return dp_count, dp_mean * dp_count, dp_mean, dp_var
+
+
+def _compute_noise_std(linf_sensitivity: float,
+                       dp_params: ScalarNoiseParams) -> float:
+    """Noise std for the given linf sensitivity (reference :369-382)."""
+    if dp_params.noise_kind == NoiseKind.LAPLACE:
+        l1 = compute_l1_sensitivity(dp_params.l0_sensitivity(),
+                                    linf_sensitivity)
+        b = l1 / dp_params.eps
+        return b * math.sqrt(2)
+    if dp_params.noise_kind == NoiseKind.GAUSSIAN:
+        l2 = compute_l2_sensitivity(dp_params.l0_sensitivity(),
+                                    linf_sensitivity)
+        return gaussian_sigma(dp_params.eps, dp_params.delta, l2)
+    raise ValueError("Only Laplace and Gaussian noise is supported.")
+
+
+def compute_dp_count_noise_std(dp_params: ScalarNoiseParams) -> float:
+    return _compute_noise_std(dp_params.max_contributions_per_partition,
+                              dp_params)
+
+
+def compute_dp_sum_noise_std(dp_params: ScalarNoiseParams) -> float:
+    linf = max(abs(dp_params.min_sum_per_partition),
+               abs(dp_params.max_sum_per_partition))
+    return _compute_noise_std(linf, dp_params)
+
+
+class AdditiveMechanism(abc.ABC):
+    """Base class for additive DP mechanisms (Laplace, Gaussian)."""
+
+    @abc.abstractmethod
+    def add_noise(self, value: Union[int, float]) -> float:
+        """Anonymizes value by adding noise."""
+
+    @property
+    @abc.abstractmethod
+    def noise_kind(self) -> NoiseKind:
+        pass
+
+    @property
+    @abc.abstractmethod
+    def noise_parameter(self) -> float:
+        """Noise distribution parameter (b for Laplace, sigma for Gauss)."""
+
+    @property
+    @abc.abstractmethod
+    def std(self) -> float:
+        """Noise standard deviation."""
+
+    @property
+    @abc.abstractmethod
+    def sensitivity(self) -> float:
+        """Mechanism sensitivity."""
+
+    @abc.abstractmethod
+    def describe(self) -> str:
+        """Description for explain computation reports."""
+
+
+class LaplaceMechanism(AdditiveMechanism):
+    """Laplace mechanism: noise b = l1_sensitivity / eps."""
+
+    def __init__(self, epsilon: float, l1_sensitivity: float):
+        self._epsilon = epsilon
+        self._l1_sensitivity = l1_sensitivity
+
+    @classmethod
+    def create_from_epsilon(cls, epsilon: float,
+                            l1_sensitivity: float) -> 'LaplaceMechanism':
+        return LaplaceMechanism(epsilon, l1_sensitivity)
+
+    @classmethod
+    def create_from_std_deviation(cls, normalized_stddev: float,
+                                  l1_sensitivity: float) -> 'LaplaceMechanism':
+        """normalized_stddev = stddev / l1_sensitivity (PLD accounting)."""
+        b = normalized_stddev / math.sqrt(2)
+        return LaplaceMechanism(1 / b, l1_sensitivity)
+
+    def add_noise(self, value: Union[int, float]) -> float:
+        return float(value) + _rng.laplace(0, self.noise_parameter)
+
+    @property
+    def epsilon(self) -> float:
+        return self._epsilon
+
+    @property
+    def noise_parameter(self) -> float:
+        return self._l1_sensitivity / self._epsilon
+
+    @property
+    def std(self) -> float:
+        return self.noise_parameter * math.sqrt(2)
+
+    @property
+    def noise_kind(self) -> NoiseKind:
+        return NoiseKind.LAPLACE
+
+    @property
+    def sensitivity(self) -> float:
+        return self._l1_sensitivity
+
+    def describe(self) -> str:
+        return (f"Laplace mechanism:  parameter={self.noise_parameter}  eps="
+                f"{self._epsilon}  l1_sensitivity={self.sensitivity}")
+
+
+class GaussianMechanism(AdditiveMechanism):
+    """Gaussian mechanism with analytic (optimal) sigma calibration."""
+
+    def __init__(self,
+                 sigma: float,
+                 l2_sensitivity: float,
+                 epsilon: float = 0.0,
+                 delta: float = 0.0):
+        self._sigma = sigma
+        self._l2_sensitivity = l2_sensitivity
+        self._epsilon = epsilon
+        self._delta = delta
+
+    @classmethod
+    def create_from_epsilon_delta(cls, epsilon: float, delta: float,
+                                  l2_sensitivity: float) -> 'GaussianMechanism':
+        sigma = gaussian_sigma(epsilon, delta, l2_sensitivity)
+        return GaussianMechanism(sigma,
+                                 l2_sensitivity,
+                                 epsilon=epsilon,
+                                 delta=delta)
+
+    @classmethod
+    def create_from_std_deviation(cls, normalized_stddev: float,
+                                  l2_sensitivity: float) -> 'GaussianMechanism':
+        """normalized_stddev = stddev / l2_sensitivity (PLD accounting)."""
+        return GaussianMechanism(normalized_stddev * l2_sensitivity,
+                                 l2_sensitivity)
+
+    def add_noise(self, value: Union[int, float]) -> float:
+        return float(value) + _rng.normal(0, self._sigma)
+
+    @property
+    def epsilon(self) -> float:
+        return self._epsilon
+
+    @property
+    def delta(self) -> float:
+        return self._delta
+
+    @property
+    def noise_kind(self) -> NoiseKind:
+        return NoiseKind.GAUSSIAN
+
+    @property
+    def noise_parameter(self) -> float:
+        return self._sigma
+
+    @property
+    def std(self) -> float:
+        return self._sigma
+
+    @property
+    def sensitivity(self) -> float:
+        return self._l2_sensitivity
+
+    def describe(self) -> str:
+        if self._epsilon > 0:
+            eps_delta_str = f"eps={self._epsilon}  delta={self._delta}  "
+        else:
+            eps_delta_str = ""
+        return (f"Gaussian mechanism:  parameter={self.noise_parameter}"
+                f"  {eps_delta_str}l2_sensitivity={self.sensitivity}")
+
+
+class MeanMechanism:
+    """DP mean as DP(normalized sum) / DP(count) + mid (reference :541-576).
+
+    normalized_sum = sum(x_i - mid) has linf sensitivity
+    (max_value - min_value)/2 * max_contributions_per_partition, smaller than
+    the raw sum's max(|min|, |max|) — a strict utility win.
+    """
+
+    def __init__(self, range_middle: float, count_mechanism: AdditiveMechanism,
+                 sum_mechanism: AdditiveMechanism):
+        self._range_middle = range_middle
+        self._count_mechanism = count_mechanism
+        self._sum_mechanism = sum_mechanism
+
+    def compute_mean(self, count: int, normalized_sum: float):
+        dp_count = self._count_mechanism.add_noise(count)
+        denominator = max(1.0, dp_count)
+        dp_normalized_sum = self._sum_mechanism.add_noise(normalized_sum)
+        dp_mean = self._range_middle + dp_normalized_sum / denominator
+        dp_sum = dp_mean * dp_count
+        return dp_count, dp_sum, dp_mean
+
+    @property
+    def count_mechanism(self) -> AdditiveMechanism:
+        return self._count_mechanism
+
+    @property
+    def sum_mechanism(self) -> AdditiveMechanism:
+        return self._sum_mechanism
+
+    @property
+    def range_middle(self) -> float:
+        return self._range_middle
+
+    def describe(self) -> str:
+        return (f"    a. Computed 'normalized_sum' = sum of (value - "
+                f"{self._range_middle})\n"
+                f"    b. Applied to 'count' {self._count_mechanism.describe()}\n"
+                f"    c. Applied to 'normalized_sum' "
+                f"{self._sum_mechanism.describe()}")
+
+
+@dataclass
+class Sensitivities:
+    """Sensitivities of an additive DP mechanism, with consistency checks
+    (reference :579-619)."""
+    l0: Optional[int] = None
+    linf: Optional[float] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+
+    def __post_init__(self):
+
+        def check_is_positive(num: Any, name: str):
+            if num is not None and num <= 0:
+                raise ValueError(f"{name} must be positive, but {num} given.")
+
+        check_is_positive(self.l0, "L0")
+        check_is_positive(self.linf, "Linf")
+        check_is_positive(self.l1, "L1")
+        check_is_positive(self.l2, "L2")
+
+        if (self.l0 is None) != (self.linf is None):
+            raise ValueError("l0 and linf sensitivities must be either both set"
+                             " or both unset.")
+
+        if self.l0 is not None and self.linf is not None:
+            l1 = compute_l1_sensitivity(self.l0, self.linf)
+            if self.l1 is None:
+                self.l1 = l1
+            elif abs(l1 - self.l1) > 1e-12:
+                raise ValueError(f"L1={self.l1} != L0*Linf={l1}")
+
+            l2 = compute_l2_sensitivity(self.l0, self.linf)
+            if self.l2 is None:
+                self.l2 = l2
+            elif abs(l2 - self.l2) > 1e-12:
+                raise ValueError(f"L2={self.l2} != sqrt(L0)*Linf={l2}")
+
+
+def create_additive_mechanism(mechanism_spec: budget_accounting.MechanismSpec,
+                              sensitivities: Sensitivities
+                             ) -> AdditiveMechanism:
+    """AdditiveMechanism from a (budget-finalized) spec (reference :622-647)."""
+    noise_kind = mechanism_spec.mechanism_type.to_noise_kind()
+    if noise_kind == NoiseKind.LAPLACE:
+        if sensitivities.l1 is None:
+            raise ValueError("L1 or (L0 and Linf) sensitivities must be set for"
+                             " Laplace mechanism.")
+        if mechanism_spec.standard_deviation_is_set:
+            return LaplaceMechanism.create_from_std_deviation(
+                mechanism_spec.noise_standard_deviation, sensitivities.l1)
+        return LaplaceMechanism.create_from_epsilon(mechanism_spec.eps,
+                                                    sensitivities.l1)
+
+    if noise_kind == NoiseKind.GAUSSIAN:
+        if sensitivities.l2 is None:
+            raise ValueError("L2 or (L0 and Linf) sensitivities must be set for"
+                             " Gaussian mechanism.")
+        if mechanism_spec.standard_deviation_is_set:
+            return GaussianMechanism.create_from_std_deviation(
+                mechanism_spec.noise_standard_deviation, sensitivities.l2)
+        return GaussianMechanism.create_from_epsilon_delta(
+            mechanism_spec.eps, mechanism_spec.delta, sensitivities.l2)
+
+    raise AssertionError(f"{noise_kind} not supported.")
+
+
+def create_mean_mechanism(
+        range_middle: float, count_spec: budget_accounting.MechanismSpec,
+        count_sensitivities: Sensitivities,
+        normalized_sum_spec: budget_accounting.MechanismSpec,
+        normalized_sum_sensitivities: Sensitivities) -> MeanMechanism:
+    return MeanMechanism(
+        range_middle,
+        create_additive_mechanism(count_spec, count_sensitivities),
+        create_additive_mechanism(normalized_sum_spec,
+                                  normalized_sum_sensitivities))
+
+
+class ExponentialMechanism:
+    """Exponential mechanism for DP parameter choice (reference :662-716)."""
+
+    class ScoringFunction(abc.ABC):
+        """Scoring function for the exponential mechanism."""
+
+        @abc.abstractmethod
+        def score(self, k) -> float:
+            """The higher the score, the likelier `k` is chosen."""
+
+        @property
+        @abc.abstractmethod
+        def global_sensitivity(self) -> float:
+            pass
+
+        @property
+        @abc.abstractmethod
+        def is_monotonic(self) -> bool:
+            """Whether score(D, k) is monotonic in the dataset D."""
+
+    def __init__(self, scoring_function: 'ScoringFunction') -> None:
+        self._scoring_function = scoring_function
+
+    def apply(self, eps: float, inputs_to_score_col: List[Any]) -> Any:
+        probs = self._calculate_probabilities(eps, inputs_to_score_col)
+        index = _rng.choice(len(inputs_to_score_col), p=probs)
+        return inputs_to_score_col[index]
+
+    def _calculate_probabilities(self, eps: float,
+                                 inputs_to_score_col: List[Any]):
+        scores = np.array(
+            [self._scoring_function.score(k) for k in inputs_to_score_col],
+            dtype=np.float64)
+        denominator = self._scoring_function.global_sensitivity
+        if not self._scoring_function.is_monotonic:
+            denominator *= 2
+        # Stabilized softmax.
+        logits = scores * eps / denominator
+        logits -= logits.max()
+        weights = np.exp(logits)
+        return weights / weights.sum()
+
+
+def compute_sensitivities_for_count(
+        params: aggregate_params.AggregateParams) -> Sensitivities:
+    if params.max_contributions is not None:
+        return Sensitivities(l1=params.max_contributions,
+                             l2=params.max_contributions)
+    return Sensitivities(l0=params.max_partitions_contributed,
+                         linf=params.max_contributions_per_partition)
+
+
+def compute_sensitivities_for_privacy_id_count(
+        params: aggregate_params.AggregateParams) -> Sensitivities:
+    if params.max_contributions is not None:
+        return Sensitivities(l1=params.max_contributions,
+                             l2=math.sqrt(params.max_contributions))
+    return Sensitivities(l0=params.max_partitions_contributed, linf=1)
+
+
+def compute_sensitivities_for_sum(
+        params: aggregate_params.AggregateParams) -> Sensitivities:
+    l0_sensitivity = params.max_partitions_contributed
+    if params.bounds_per_contribution_are_set:
+        max_abs_val = max(abs(params.min_value), abs(params.max_value))
+        if params.max_contributions:
+            l1_l2 = max_abs_val * params.max_contributions
+            return Sensitivities(l1=l1_l2, l2=l1_l2)
+        linf_sensitivity = max_abs_val * params.max_contributions_per_partition
+    else:
+        linf_sensitivity = max(abs(params.min_sum_per_partition),
+                               abs(params.max_sum_per_partition))
+    return Sensitivities(l0=l0_sensitivity, linf=linf_sensitivity)
+
+
+def compute_sensitivities_for_normalized_sum(
+        params: aggregate_params.AggregateParams) -> Sensitivities:
+    max_abs_value = (params.max_value - params.min_value) / 2
+    if params.max_contributions:
+        l1_l2 = max_abs_value * params.max_contributions
+        return Sensitivities(l1=l1_l2, l2=l1_l2)
+    return Sensitivities(l0=params.max_partitions_contributed,
+                         linf=max_abs_value *
+                         params.max_contributions_per_partition)

@@ -1,0 +1,51 @@
+"""Sampling helpers (host side).
+
+Reference parity: pipeline_dp/sampling_utils.py:19-51 — uniform choice without
+replacement that preserves native Python element types, and a deterministic
+hash-based value sampler. Device-side per-key sampling lives in
+ops/segment_ops.py (vectorized random-rank selection).
+"""
+
+import hashlib
+from typing import Optional
+
+import numpy as np
+
+
+def choose_from_list_without_replacement(a: list,
+                                         size: int,
+                                         rng: Optional[
+                                             np.random.Generator] = None
+                                        ) -> list:
+    """Uniformly samples `size` elements of `a` without replacement.
+
+    Returns `a` unchanged when it already has <= size elements. Indices (not
+    elements) are sampled so arbitrary Python objects survive unconverted.
+    """
+    if len(a) <= size:
+        return a
+    if rng is None:
+        sampled = np.random.choice(np.arange(len(a)), size, replace=False)
+    else:
+        sampled = rng.choice(np.arange(len(a)), size, replace=False)
+    return [a[i] for i in sampled]
+
+
+def _compute_64bit_hash(v) -> int:
+    m = hashlib.sha1()
+    m.update(repr(v).encode())
+    return int(m.hexdigest()[:16], 16)
+
+
+class ValueSampler:
+    """Deterministic value sampler.
+
+    keep(value) is deterministic per value; over random values it keeps with
+    probability sampling_rate.
+    """
+
+    def __init__(self, sampling_rate: float):
+        self._sample_bound = int(round(2**64 * sampling_rate))
+
+    def keep(self, value) -> bool:
+        return _compute_64bit_hash(value) < self._sample_bound

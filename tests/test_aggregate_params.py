@@ -1,0 +1,134 @@
+"""Tests for the parameter/validation layer.
+
+Modeled on the reference's validation-table test style
+(/root/reference/tests/aggregate_params_test.py, dp_engine_test.py:96-143).
+"""
+
+import pytest
+
+import pipelinedp_tpu as pdp
+
+
+def _count_params(**kwargs):
+    defaults = dict(metrics=[pdp.Metrics.COUNT],
+                    noise_kind=pdp.NoiseKind.LAPLACE,
+                    max_partitions_contributed=2,
+                    max_contributions_per_partition=3)
+    defaults.update(kwargs)
+    return pdp.AggregateParams(**defaults)
+
+
+class TestMetric:
+
+    def test_str(self):
+        assert str(pdp.Metrics.COUNT) == "COUNT"
+        assert str(pdp.Metrics.PERCENTILE(90)) == "PERCENTILE(90)"
+
+    def test_eq_hash(self):
+        assert pdp.Metrics.PERCENTILE(90) == pdp.Metrics.PERCENTILE(90)
+        assert pdp.Metrics.PERCENTILE(90) != pdp.Metrics.PERCENTILE(50)
+        assert hash(pdp.Metrics.SUM) == hash(pdp.Metric("SUM"))
+        assert pdp.Metrics.COUNT != "COUNT"
+
+    def test_is_percentile(self):
+        assert pdp.Metrics.PERCENTILE(50).is_percentile
+        assert not pdp.Metrics.COUNT.is_percentile
+
+
+class TestNoiseKindMechanismType:
+
+    def test_conversion_roundtrip(self):
+        assert (pdp.NoiseKind.LAPLACE.convert_to_mechanism_type() ==
+                pdp.MechanismType.LAPLACE)
+        assert (pdp.NoiseKind.GAUSSIAN.convert_to_mechanism_type() ==
+                pdp.MechanismType.GAUSSIAN)
+        assert pdp.MechanismType.LAPLACE.to_noise_kind() == pdp.NoiseKind.LAPLACE
+        assert (pdp.MechanismType.GAUSSIAN.to_noise_kind() ==
+                pdp.NoiseKind.GAUSSIAN)
+        with pytest.raises(ValueError):
+            pdp.MechanismType.GENERIC.to_noise_kind()
+
+
+class TestAggregateParamsValidation:
+
+    def test_valid_count(self):
+        _count_params()
+
+    def test_valid_sum_with_value_bounds(self):
+        _count_params(metrics=[pdp.Metrics.SUM], min_value=0, max_value=5)
+
+    def test_valid_sum_with_partition_bounds(self):
+        _count_params(metrics=[pdp.Metrics.SUM],
+                      min_sum_per_partition=0,
+                      max_sum_per_partition=5)
+
+    @pytest.mark.parametrize(
+        "kwargs,match",
+        [
+            (dict(min_value=1), "both set or both None"),
+            (dict(max_value=1), "both set or both None"),
+            (dict(min_sum_per_partition=1), "both set or both None"),
+            (dict(min_value=1, max_value=0), "equal to or greater"),
+            (dict(min_value=float("nan"), max_value=1), "finite number"),
+            (dict(min_value=float("inf"), max_value=1), "finite number"),
+            (dict(min_value=0, max_value=1, min_sum_per_partition=0,
+                  max_sum_per_partition=1), "both set"),
+            (dict(max_partitions_contributed=None), "both"),
+            (dict(max_partitions_contributed=0), "positive integer"),
+            (dict(max_partitions_contributed=1.5), "positive integer"),
+            (dict(pre_threshold=0), "positive integer"),
+        ],
+    )
+    def test_invalid_params(self, kwargs, match):
+        with pytest.raises(ValueError, match=match):
+            _count_params(**kwargs)
+
+    def test_metrics_need_bounds(self):
+        with pytest.raises(ValueError, match="bounds per partition"):
+            _count_params(metrics=[pdp.Metrics.SUM])
+        with pytest.raises(ValueError, match="min_sum_per_partition is not"):
+            _count_params(metrics=[pdp.Metrics.MEAN],
+                          min_sum_per_partition=0,
+                          max_sum_per_partition=1)
+
+    def test_vector_sum_incompatible_with_scalar_metrics(self):
+        with pytest.raises(ValueError, match="vector sum"):
+            _count_params(metrics=[pdp.Metrics.VECTOR_SUM, pdp.Metrics.SUM],
+                          min_value=0,
+                          max_value=1)
+
+    def test_privacy_id_count_with_enforced_bounds(self):
+        with pytest.raises(ValueError, match="PRIVACY_ID_COUNT"):
+            _count_params(metrics=[pdp.Metrics.PRIVACY_ID_COUNT],
+                          contribution_bounds_already_enforced=True)
+
+    def test_max_contributions_exclusive(self):
+        pdp.AggregateParams(metrics=[pdp.Metrics.COUNT], max_contributions=5)
+        with pytest.raises(ValueError, match="only one"):
+            _count_params(max_contributions=5)
+        with pytest.raises(ValueError, match="either max_contributions"):
+            pdp.AggregateParams(metrics=[pdp.Metrics.COUNT])
+
+    def test_custom_combiners_with_metrics(self):
+        with pytest.raises(ValueError, match="Custom combiners"):
+            _count_params(custom_combiners=[object()])
+
+    def test_str_readable(self):
+        s = str(_count_params())
+        assert "COUNT" in s and "max_partitions_contributed=2" in s
+
+
+class TestEpsilonDeltaValidation:
+
+    @pytest.mark.parametrize("eps,delta", [(0, 0), (-1, 0), (float("inf"), 0),
+                                           (float("nan"), 0), (1, -1e-9),
+                                           (1, 1.0), (1, float("nan"))])
+    def test_invalid(self, eps, delta):
+        from pipelinedp_tpu import input_validators
+        with pytest.raises(ValueError):
+            input_validators.validate_epsilon_delta(eps, delta, "test")
+
+    def test_valid(self):
+        from pipelinedp_tpu import input_validators
+        input_validators.validate_epsilon_delta(1.0, 0, "test")
+        input_validators.validate_epsilon_delta(0.1, 1e-10, "test")

@@ -1,0 +1,216 @@
+"""Tests for budget accounting (naive + PLD) and the native PLD library.
+
+Modeled on /root/reference/tests/budget_accounting_test.py patterns: split
+proportions, scope normalization, restriction enforcement, PLD binary search.
+"""
+
+import math
+
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.accounting import pld as pldlib
+from pipelinedp_tpu.aggregate_params import MechanismType
+
+
+class TestMechanismSpec:
+
+    def test_lazy_access_raises(self):
+        spec = pdp.MechanismSpec(mechanism_type=MechanismType.LAPLACE)
+        with pytest.raises(AssertionError):
+            _ = spec.eps
+        with pytest.raises(AssertionError):
+            _ = spec.noise_standard_deviation
+
+    def test_set_and_get(self):
+        spec = pdp.MechanismSpec(mechanism_type=MechanismType.GAUSSIAN)
+        spec.set_eps_delta(0.5, 1e-8)
+        assert spec.eps == 0.5
+        assert spec.delta == 1e-8
+        assert spec.use_delta()
+
+    def test_laplace_does_not_use_delta(self):
+        spec = pdp.MechanismSpec(mechanism_type=MechanismType.LAPLACE)
+        assert not spec.use_delta()
+
+
+class TestNaiveBudgetAccountant:
+
+    def test_equal_split_laplace(self):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        s1 = acc.request_budget(MechanismType.LAPLACE)
+        s2 = acc.request_budget(MechanismType.LAPLACE)
+        acc.compute_budgets()
+        assert s1.eps == pytest.approx(0.5)
+        assert s2.eps == pytest.approx(0.5)
+        assert s1.delta == 0
+
+    def test_weighted_split(self):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1, total_delta=1e-6)
+        s1 = acc.request_budget(MechanismType.LAPLACE, weight=3)
+        s2 = acc.request_budget(MechanismType.GAUSSIAN, weight=1)
+        acc.compute_budgets()
+        assert s1.eps == pytest.approx(0.75)
+        assert s2.eps == pytest.approx(0.25)
+        # Only the Gaussian mechanism consumes delta.
+        assert s2.delta == pytest.approx(1e-6)
+
+    def test_count_multiplies_weight(self):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        s1 = acc.request_budget(MechanismType.LAPLACE, count=3)
+        s2 = acc.request_budget(MechanismType.LAPLACE)
+        acc.compute_budgets()
+        assert s1.eps == pytest.approx(0.25)
+        assert s2.eps == pytest.approx(0.25)
+
+    def test_gaussian_without_delta_raises(self):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        with pytest.raises(ValueError, match="Gaussian"):
+            acc.request_budget(MechanismType.GAUSSIAN)
+
+    def test_request_after_compute_raises(self):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        acc.request_budget(MechanismType.LAPLACE)
+        acc.compute_budgets()
+        with pytest.raises(Exception, match="after compute_budgets"):
+            acc.request_budget(MechanismType.LAPLACE)
+
+    def test_compute_twice_raises(self):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        acc.request_budget(MechanismType.LAPLACE)
+        acc.compute_budgets()
+        with pytest.raises(Exception, match="twice"):
+            acc.compute_budgets()
+
+    def test_scope_normalizes_weights(self):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1, total_delta=0)
+        with acc.scope(weight=0.5):
+            s1 = acc.request_budget(MechanismType.LAPLACE)
+            s2 = acc.request_budget(MechanismType.LAPLACE)
+        with acc.scope(weight=0.5):
+            s3 = acc.request_budget(MechanismType.LAPLACE)
+        acc.compute_budgets()
+        assert s1.eps == pytest.approx(0.25)
+        assert s2.eps == pytest.approx(0.25)
+        assert s3.eps == pytest.approx(0.5)
+
+    def test_num_aggregations_enforced(self):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1,
+                                        total_delta=0,
+                                        num_aggregations=2)
+        acc._compute_budget_for_aggregation(1)
+        acc.request_budget(MechanismType.LAPLACE)
+        with pytest.raises(ValueError, match="num_aggregations"):
+            acc.compute_budgets()
+
+    def test_num_aggregations_and_weights_conflict(self):
+        with pytest.raises(ValueError):
+            pdp.NaiveBudgetAccountant(total_epsilon=1,
+                                      total_delta=0,
+                                      num_aggregations=2,
+                                      aggregation_weights=[1, 2])
+
+
+class TestPld:
+
+    def test_gaussian_epsilon_matches_analytic_shape(self):
+        # For sigma=2, delta=1e-6: epsilon from PLD must be finite, positive
+        # and close to the analytic Gaussian mechanism's calibration.
+        pld = pldlib.from_gaussian_mechanism(2.0,
+                                             value_discretization_interval=1e-3)
+        eps = pld.get_epsilon_for_delta(1e-6)
+        assert 0 < eps < 10
+        # More noise -> smaller epsilon.
+        pld2 = pldlib.from_gaussian_mechanism(
+            4.0, value_discretization_interval=1e-3)
+        assert pld2.get_epsilon_for_delta(1e-6) < eps
+
+    def test_laplace_pure_dp(self):
+        # Laplace(b) is (1/b, 0)-DP: epsilon at delta=0 is 1/b (up to the
+        # pessimistic discretization error).
+        b = 2.0
+        pld = pldlib.from_laplace_mechanism(b,
+                                            value_discretization_interval=1e-4)
+        eps = pld.get_epsilon_for_delta(0)
+        assert eps == pytest.approx(1 / b, abs=1e-3)
+
+    def test_composition_additivity_upper_bound(self):
+        # eps of the composition is between the single-mechanism eps and the
+        # naive sum of epsilons.
+        pld = pldlib.from_laplace_mechanism(1.0,
+                                            value_discretization_interval=1e-4)
+        composed = pld.compose(pld)
+        eps1 = pld.get_epsilon_for_delta(1e-9)
+        eps2 = composed.get_epsilon_for_delta(1e-9)
+        assert eps1 < eps2 <= 2 * eps1 + 1e-3
+
+    def test_self_compose_matches_compose(self):
+        pld = pldlib.from_gaussian_mechanism(3.0,
+                                             value_discretization_interval=1e-3)
+        a = pld.compose(pld).compose(pld)
+        b = pld.self_compose(3)
+        assert a.get_epsilon_for_delta(1e-6) == pytest.approx(
+            b.get_epsilon_for_delta(1e-6), rel=1e-6)
+
+    def test_delta_monotone_in_epsilon(self):
+        pld = pldlib.from_gaussian_mechanism(1.0,
+                                             value_discretization_interval=1e-3)
+        deltas = [pld.get_delta_for_epsilon(e) for e in (0.0, 0.5, 1.0, 2.0)]
+        assert all(d1 >= d2 for d1, d2 in zip(deltas, deltas[1:]))
+
+    def test_from_privacy_parameters(self):
+        pld = pldlib.from_privacy_parameters(
+            1.0, 1e-6, value_discretization_interval=1e-4)
+        eps = pld.get_epsilon_for_delta(1e-6)
+        assert eps == pytest.approx(1.0, abs=1e-3)
+
+
+class TestPLDBudgetAccountant:
+
+    def test_delta_zero_closed_form(self):
+        acc = pdp.PLDBudgetAccountant(total_epsilon=1, total_delta=0)
+        s1 = acc.request_budget(MechanismType.LAPLACE)
+        s2 = acc.request_budget(MechanismType.LAPLACE)
+        acc.compute_budgets()
+        assert acc.minimum_noise_std == pytest.approx(2 * math.sqrt(2))
+        assert s1.noise_standard_deviation == pytest.approx(2 * math.sqrt(2))
+        assert s2.noise_standard_deviation == pytest.approx(2 * math.sqrt(2))
+
+    def test_binary_search_satisfies_budget(self):
+        total_eps, total_delta = 1.0, 1e-6
+        acc = pdp.PLDBudgetAccountant(total_epsilon=total_eps,
+                                      total_delta=total_delta,
+                                      pld_discretization=1e-3)
+        acc.request_budget(MechanismType.GAUSSIAN)
+        acc.request_budget(MechanismType.GAUSSIAN)
+        acc.compute_budgets()
+        std = acc.minimum_noise_std
+        assert std > 0
+        # Verify the composed PLD at the found noise std fits in the budget.
+        pld = pldlib.from_gaussian_mechanism(
+            std, value_discretization_interval=1e-3).self_compose(2)
+        assert pld.get_epsilon_for_delta(total_delta) <= total_eps * 1.01
+
+    def test_pld_beats_naive_for_many_mechanisms(self):
+        # PLD composition should allow strictly less noise than naive
+        # accounting for >2 Gaussian mechanisms.
+        total_eps, total_delta = 1.0, 1e-6
+        n = 4
+        acc = pdp.PLDBudgetAccountant(total_epsilon=total_eps,
+                                      total_delta=total_delta,
+                                      pld_discretization=1e-3)
+        specs = [acc.request_budget(MechanismType.GAUSSIAN) for _ in range(n)]
+        acc.compute_budgets()
+        from pipelinedp_tpu import dp_computations
+        naive_std = dp_computations.gaussian_sigma(total_eps / n,
+                                                   total_delta / n, 1.0)
+        assert specs[0].noise_standard_deviation < naive_std
+
+    def test_generic_mechanism_gets_eps_delta(self):
+        acc = pdp.PLDBudgetAccountant(total_epsilon=1,
+                                      total_delta=1e-6,
+                                      pld_discretization=1e-3)
+        s = acc.request_budget(MechanismType.GENERIC)
+        acc.compute_budgets()
+        assert s.eps > 0
+        assert s.delta > 0

@@ -1,0 +1,238 @@
+"""Tests for native DP numerics.
+
+Statistical-distribution tests follow the reference pattern
+(/root/reference/tests/dp_computations_test.py:99-177): large-sample noise
+draws checked for mean/std within multi-sigma confidence deltas.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import dp_computations as dp
+from pipelinedp_tpu.budget_accounting import MechanismSpec
+from pipelinedp_tpu.aggregate_params import MechanismType
+
+N_SAMPLES = 200_000
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    dp.seed_mechanism_rng(12345)
+    yield
+    dp.seed_mechanism_rng(None)
+
+
+class TestSensitivityCalculus:
+
+    def test_l1_l2(self):
+        assert dp.compute_l1_sensitivity(4, 2.5) == 10
+        assert dp.compute_l2_sensitivity(4, 2.5) == 5
+
+    def test_middle_and_squares(self):
+        assert dp.compute_middle(-1, 3) == 1
+        assert dp.compute_squares_interval(-2, 1) == (0, 4)
+        assert dp.compute_squares_interval(1, 2) == (1, 4)
+
+    def test_sensitivities_consistency(self):
+        s = dp.Sensitivities(l0=4, linf=2)
+        assert s.l1 == 8
+        assert s.l2 == 4
+        with pytest.raises(ValueError, match="L1"):
+            dp.Sensitivities(l0=4, linf=2, l1=5)
+        with pytest.raises(ValueError, match="positive"):
+            dp.Sensitivities(l0=-1, linf=2)
+        with pytest.raises(ValueError, match="both"):
+            dp.Sensitivities(l0=4)
+
+    def test_per_metric_sensitivities(self):
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=2,
+                                     min_value=-1,
+                                     max_value=4)
+        assert dp.compute_sensitivities_for_count(params).l1 == 6
+        assert dp.compute_sensitivities_for_privacy_id_count(params).l1 == 3
+        assert dp.compute_sensitivities_for_sum(params).linf == 8
+        # normalized sum: (4 - -1)/2 * 2 = 5
+        assert dp.compute_sensitivities_for_normalized_sum(params).linf == 5
+
+
+class TestAnalyticGaussian:
+
+    @pytest.mark.parametrize("eps,delta,sens", [(1.0, 1e-6, 1.0),
+                                                (0.1, 1e-10, 3.0),
+                                                (5.0, 1e-5, 0.5),
+                                                (10.0, 1e-12, 1.0)])
+    def test_calibration_is_tight(self, eps, delta, sens):
+        sigma = dp.gaussian_sigma(eps, delta, sens)
+        assert dp.gaussian_delta(sigma, eps, sens) <= delta * (1 + 1e-6)
+        # Slightly smaller sigma must violate delta (tightness).
+        assert dp.gaussian_delta(sigma * 0.999, eps, sens) > delta
+
+    def test_beats_classic_bound(self):
+        # The analytic mechanism is never worse than the classic
+        # sqrt(2 ln(1.25/delta))/eps calibration (for eps<=1).
+        eps, delta = 0.5, 1e-6
+        classic = math.sqrt(2 * math.log(1.25 / delta)) / eps
+        assert dp.gaussian_sigma(eps, delta, 1.0) <= classic
+
+    def test_invalid_delta(self):
+        with pytest.raises(ValueError):
+            dp.gaussian_sigma(1.0, 0, 1.0)
+
+
+class TestNoiseDistributions:
+
+    def test_laplace_mechanism_distribution(self):
+        mech = dp.LaplaceMechanism.create_from_epsilon(2.0, 4.0)  # b = 2
+        samples = np.array([mech.add_noise(10.0) for _ in range(N_SAMPLES)])
+        b = 2.0
+        assert samples.mean() == pytest.approx(10.0,
+                                               abs=5 * b * math.sqrt(2) /
+                                               math.sqrt(N_SAMPLES))
+        assert samples.std() == pytest.approx(b * math.sqrt(2), rel=0.02)
+        assert mech.std == pytest.approx(b * math.sqrt(2))
+
+    def test_gaussian_mechanism_distribution(self):
+        mech = dp.GaussianMechanism.create_from_epsilon_delta(1.0, 1e-6, 1.0)
+        sigma = mech.std
+        samples = np.array([mech.add_noise(0.0) for _ in range(N_SAMPLES)])
+        assert samples.mean() == pytest.approx(0.0,
+                                               abs=5 * sigma /
+                                               math.sqrt(N_SAMPLES))
+        assert samples.std() == pytest.approx(sigma, rel=0.02)
+        # ~68%/95% mass within 1/2 sigma.
+        within1 = np.mean(np.abs(samples) < sigma)
+        assert within1 == pytest.approx(0.6827, abs=0.01)
+
+    def test_create_from_std_deviation(self):
+        lap = dp.LaplaceMechanism.create_from_std_deviation(2.0, 3.0)
+        assert lap.std == pytest.approx(2.0 * 3.0)
+        gauss = dp.GaussianMechanism.create_from_std_deviation(2.0, 3.0)
+        assert gauss.std == pytest.approx(6.0)
+
+
+class TestBudgetSplit:
+
+    def test_equally_split_budget(self):
+        budgets = dp.equally_split_budget(1.0, 1e-6, 3)
+        assert len(budgets) == 3
+        assert sum(b[0] for b in budgets) == pytest.approx(1.0)
+        assert sum(b[1] for b in budgets) == pytest.approx(1e-6)
+        with pytest.raises(ValueError):
+            dp.equally_split_budget(1.0, 0, 0)
+
+
+class TestMeanAndVariance:
+
+    def _huge_eps_params(self, **kwargs):
+        defaults = dict(eps=1e6,
+                        delta=1e-8,
+                        min_value=0.0,
+                        max_value=10.0,
+                        min_sum_per_partition=None,
+                        max_sum_per_partition=None,
+                        max_partitions_contributed=1,
+                        max_contributions_per_partition=3,
+                        noise_kind=pdp.NoiseKind.LAPLACE)
+        defaults.update(kwargs)
+        return dp.ScalarNoiseParams(**defaults)
+
+    def test_mean_mechanism_huge_eps(self):
+        spec_count = MechanismSpec(MechanismType.LAPLACE)
+        spec_count.set_eps_delta(1e6, 0)
+        spec_sum = MechanismSpec(MechanismType.LAPLACE)
+        spec_sum.set_eps_delta(1e6, 0)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.MEAN],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=3,
+                                     min_value=0.0,
+                                     max_value=10.0)
+        mech = dp.create_mean_mechanism(
+            5.0, spec_count, dp.compute_sensitivities_for_count(params),
+            spec_sum, dp.compute_sensitivities_for_normalized_sum(params))
+        # values [4, 6, 8]: count=3, normalized_sum = (4-5)+(6-5)+(8-5)=3
+        dp_count, dp_sum, dp_mean = mech.compute_mean(3, 3.0)
+        assert dp_count == pytest.approx(3, abs=1e-2)
+        assert dp_mean == pytest.approx(6.0, abs=1e-2)
+        assert dp_sum == pytest.approx(18.0, abs=0.1)
+
+    def test_compute_dp_var_huge_eps(self):
+        params = self._huge_eps_params()
+        values = np.array([2.0, 4.0, 6.0])
+        middle = 5.0
+        normalized = values - middle
+        count, nsum, nsum2 = 3, normalized.sum(), (normalized**2).sum()
+        dp_count, dp_sum, dp_mean, dp_var = dp.compute_dp_var(
+            count, nsum, nsum2, params)
+        assert dp_count == pytest.approx(3, abs=1e-2)
+        assert dp_mean == pytest.approx(4.0, abs=1e-2)
+        assert dp_var == pytest.approx(values.var(), abs=0.1)
+
+    def test_noise_std_predictors(self):
+        params = self._huge_eps_params(eps=1.0,
+                                       min_sum_per_partition=0.0,
+                                       max_sum_per_partition=2.0,
+                                       min_value=None,
+                                       max_value=None)
+        count_std = dp.compute_dp_count_noise_std(params)
+        assert count_std == pytest.approx(3 / 1.0 * math.sqrt(2))
+        sum_std = dp.compute_dp_sum_noise_std(params)
+        assert sum_std == pytest.approx(2 / 1.0 * math.sqrt(2))
+
+
+class TestVectorNoise:
+
+    def test_clip_linf(self):
+        vec = np.array([-5.0, 0.5, 3.0])
+        clipped = dp._clip_vector(vec, 1.0, pdp.NormKind.Linf)
+        np.testing.assert_allclose(clipped, [-1.0, 0.5, 1.0])
+
+    def test_clip_l2(self):
+        vec = np.array([3.0, 4.0])
+        clipped = dp._clip_vector(vec, 1.0, pdp.NormKind.L2)
+        np.testing.assert_allclose(clipped, [0.6, 0.8])
+
+    def test_add_noise_vector_huge_eps(self):
+        params = dp.AdditiveVectorNoiseParams(
+            eps_per_coordinate=1e6,
+            delta_per_coordinate=0,
+            max_norm=10.0,
+            l0_sensitivity=1,
+            linf_sensitivity=1,
+            norm_kind=pdp.NormKind.Linf,
+            noise_kind=pdp.NoiseKind.LAPLACE)
+        noised = dp.add_noise_vector(np.array([1.0, 2.0]), params)
+        np.testing.assert_allclose(noised, [1.0, 2.0], atol=1e-2)
+
+
+class TestExponentialMechanism:
+
+    class _Scoring(dp.ExponentialMechanism.ScoringFunction):
+
+        def score(self, k):
+            return float(k)
+
+        @property
+        def global_sensitivity(self):
+            return 1.0
+
+        @property
+        def is_monotonic(self):
+            return True
+
+    def test_probabilities(self):
+        mech = dp.ExponentialMechanism(self._Scoring())
+        probs = mech._calculate_probabilities(1.0, [0, 1, 2])
+        assert probs[2] > probs[1] > probs[0]
+        assert probs.sum() == pytest.approx(1.0)
+        # Closed form: p_i ∝ e^i
+        expected = np.exp([0, 1, 2]) / np.exp([0, 1, 2]).sum()
+        np.testing.assert_allclose(probs, expected, rtol=1e-12)
+
+    def test_apply_returns_input_element(self):
+        mech = dp.ExponentialMechanism(self._Scoring())
+        assert mech.apply(10.0, [1, 2, 50]) in (1, 2, 50)

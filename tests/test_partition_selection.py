@@ -1,0 +1,125 @@
+"""Tests for native partition-selection strategies.
+
+The truncated-geometric closed form is validated against a direct evaluation
+of the defining DP recurrence — the strongest possible internal check.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from pipelinedp_tpu import partition_selection as ps
+from pipelinedp_tpu.aggregate_params import PartitionSelectionStrategy
+
+
+def _truncated_geometric_recurrence(eps, delta, l0, n_max):
+    """Direct O(n) evaluation of pi_n (Desfontaines et al. 2020)."""
+    eps1, delta1 = eps / l0, delta / l0
+    e = math.exp(eps1)
+    pis = [0.0]
+    for _ in range(n_max):
+        prev = pis[-1]
+        pi = min(e * prev + delta1, 1 - (1 - prev - delta1) / e, 1.0)
+        pis.append(pi)
+    return pis
+
+
+class TestTruncatedGeometric:
+
+    @pytest.mark.parametrize("eps,delta,l0", [(1.0, 1e-5, 1), (0.5, 1e-6, 3),
+                                              (2.0, 1e-4, 10),
+                                              (0.1, 1e-8, 1)])
+    def test_closed_form_matches_recurrence(self, eps, delta, l0):
+        selector = ps.create_partition_selection_strategy(
+            PartitionSelectionStrategy.TRUNCATED_GEOMETRIC, eps, delta, l0)
+        n_max = 500
+        expected = _truncated_geometric_recurrence(eps, delta, l0, n_max)
+        for n in list(range(0, 50)) + [100, 200, 499]:
+            assert selector.probability_of_keep(n) == pytest.approx(
+                expected[n], rel=1e-9, abs=1e-15), f"n={n}"
+
+    def test_monotone_and_limits(self):
+        selector = ps.create_partition_selection_strategy(
+            PartitionSelectionStrategy.TRUNCATED_GEOMETRIC, 1.0, 1e-6, 2)
+        probs = selector.probability_of_keep_vec(np.arange(0, 1000))
+        assert probs[0] == 0.0
+        assert np.all(np.diff(probs) >= -1e-15)
+        assert probs[-1] == pytest.approx(1.0)
+        # pi_1 = delta' for small delta.
+        assert selector.probability_of_keep(1) == pytest.approx(1e-6 / 2)
+
+    def test_large_n_stable(self):
+        selector = ps.create_partition_selection_strategy(
+            PartitionSelectionStrategy.TRUNCATED_GEOMETRIC, 1.0, 1e-6, 1)
+        assert selector.probability_of_keep(10**9) == 1.0
+
+    def test_should_keep_extremes(self):
+        selector = ps.create_partition_selection_strategy(
+            PartitionSelectionStrategy.TRUNCATED_GEOMETRIC, 1.0, 1e-6, 1)
+        assert not selector.should_keep(0)
+        assert selector.should_keep(10**6)
+
+
+class TestThresholding:
+
+    @pytest.mark.parametrize("strategy", [
+        PartitionSelectionStrategy.LAPLACE_THRESHOLDING,
+        PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING,
+    ])
+    def test_delta_bound_and_monotonicity(self, strategy):
+        eps, delta, l0 = 1.0, 1e-6, 3
+        selector = ps.create_partition_selection_strategy(
+            strategy, eps, delta, l0)
+        # A partition with one user must keep with probability <= delta.
+        assert selector.probability_of_keep(1) <= delta
+        probs = selector.probability_of_keep_vec(np.arange(0, 200))
+        assert np.all(np.diff(probs) >= -1e-15)
+        assert probs[-1] > 0.999
+
+    def test_laplace_threshold_midpoint(self):
+        selector = ps.create_partition_selection_strategy(
+            PartitionSelectionStrategy.LAPLACE_THRESHOLDING, 1.0, 1e-6, 1)
+        t = selector.threshold
+        # At n = threshold the keep probability is exactly 1/2.
+        assert selector._probability_of_keep_shifted(np.array(
+            [t]))[0] == pytest.approx(0.5)
+
+    def test_gaussian_sigma_positive(self):
+        selector = ps.create_partition_selection_strategy(
+            PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING, 1.0, 1e-6, 4)
+        assert selector.sigma > 0
+        assert selector.threshold > 1
+
+
+class TestPreThreshold:
+
+    def test_pre_threshold_zeroes_small_counts(self):
+        selector = ps.create_partition_selection_strategy(
+            PartitionSelectionStrategy.TRUNCATED_GEOMETRIC,
+            1.0,
+            1e-6,
+            1,
+            pre_threshold=10)
+        no_pre = ps.create_partition_selection_strategy(
+            PartitionSelectionStrategy.TRUNCATED_GEOMETRIC, 1.0, 1e-6, 1)
+        for n in range(10):
+            assert selector.probability_of_keep(n) == 0.0
+        # Shifted by pre_threshold - 1.
+        assert selector.probability_of_keep(15) == pytest.approx(
+            no_pre.probability_of_keep(6))
+
+
+class TestValidation:
+
+    def test_invalid_args(self):
+        create = ps.create_partition_selection_strategy
+        strategy = PartitionSelectionStrategy.TRUNCATED_GEOMETRIC
+        with pytest.raises(ValueError):
+            create(strategy, 0, 1e-6, 1)
+        with pytest.raises(ValueError):
+            create(strategy, 1.0, 0, 1)
+        with pytest.raises(ValueError):
+            create(strategy, 1.0, 1e-6, 0)
+        with pytest.raises(ValueError):
+            create(strategy, 1.0, 1e-6, 1, pre_threshold=0)
