@@ -38,5 +38,15 @@ from pipelinedp_tpu.data_extractors import (
     PreAggregateExtractors,
 )
 from pipelinedp_tpu.report_generator import ExplainComputationReport
+from pipelinedp_tpu.combiners import Combiner, CustomCombiner
+from pipelinedp_tpu.dp_engine import DPEngine
+from pipelinedp_tpu.pipeline_backend import (
+    LocalBackend,
+    MultiProcLocalBackend,
+    PipelineBackend,
+    TPUBackend,
+    register_annotator,
+    Annotator,
+)
 
 __version__ = '0.1.0'

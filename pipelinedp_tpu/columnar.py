@@ -1,0 +1,89 @@
+"""Host-side columnar encoding: Python rows -> struct-of-arrays.
+
+The device engine operates on columnar arrays:
+    pid:    int32[n]  contiguous privacy-unit ids (vocab-encoded)
+    pk:     int32[n]  partition ids in [0, n_partitions); -1 = dropped row
+    values: float[n]  scalar contribution values
+
+The host keeps the string-key vocabularies (partition id <-> original key),
+which is exactly the host/device split called for in SURVEY.md §5: the
+device never sees Python objects.
+
+Large-scale users skip this module entirely and feed integer/float arrays
+straight to executor.aggregate_arrays.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from pipelinedp_tpu.data_extractors import DataExtractors
+
+
+@dataclass
+class EncodedData:
+    """Columnar dataset + decode vocabularies."""
+    pid: np.ndarray  # int32[n]
+    pk: np.ndarray  # int32[n], -1 marks rows in no (public) partition
+    values: np.ndarray  # float64[n]
+    partition_vocab: List[Any]  # partition id -> original partition key
+    n_privacy_ids: int
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.pid)
+
+    @property
+    def n_partitions(self) -> int:
+        return len(self.partition_vocab)
+
+    @property
+    def valid(self) -> np.ndarray:
+        return self.pk >= 0
+
+
+def encode(col,
+           data_extractors: DataExtractors,
+           public_partitions: Optional[Sequence[Any]] = None) -> EncodedData:
+    """Extracts and integer-encodes (privacy_id, partition_key, value) rows.
+
+    With public partitions, the partition vocabulary is fixed to them and
+    rows in other partitions are marked invalid (pk = -1) — the columnar
+    analogue of DPEngine._drop_partitions + _add_empty_public_partitions
+    (empty public partitions exist as all-zero columns).
+    """
+    pid_extractor = data_extractors.privacy_id_extractor or (lambda row: 0)
+    pk_extractor = data_extractors.partition_extractor
+    value_extractor = data_extractors.value_extractor or (lambda row: 0.0)
+
+    pid_vocab: Dict[Any, int] = {}
+    pk_vocab: Dict[Any, int] = {}
+    partition_vocab: List[Any] = []
+    if public_partitions is not None:
+        for pk in public_partitions:
+            if pk not in pk_vocab:
+                pk_vocab[pk] = len(partition_vocab)
+                partition_vocab.append(pk)
+    public = public_partitions is not None
+
+    pids, pks, values = [], [], []
+    for row in col:
+        pid_raw = pid_extractor(row)
+        pk_raw = pk_extractor(row)
+        pid_id = pid_vocab.setdefault(pid_raw, len(pid_vocab))
+        if public:
+            pk_id = pk_vocab.get(pk_raw, -1)
+        else:
+            pk_id = pk_vocab.setdefault(pk_raw, len(partition_vocab))
+            if pk_id == len(partition_vocab):
+                partition_vocab.append(pk_raw)
+        pids.append(pid_id)
+        pks.append(pk_id)
+        values.append(value_extractor(row))
+
+    return EncodedData(pid=np.asarray(pids, dtype=np.int32),
+                       pk=np.asarray(pks, dtype=np.int32),
+                       values=np.asarray(values, dtype=np.float64),
+                       partition_vocab=partition_vocab,
+                       n_privacy_ids=len(pid_vocab))

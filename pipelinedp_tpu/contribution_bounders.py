@@ -1,0 +1,160 @@
+"""Contribution bounders: cap each privacy unit's influence by sampling.
+
+Reference parity: pipeline_dp/contribution_bounders.py:25-225. Three
+strategies over the generic backend op-vocabulary:
+
+  * SamplingCrossAndPerPartitionContributionBounder — Linf then L0 sampling;
+  * SamplingPerPrivacyIdContributionBounder — total max_contributions;
+  * SamplingCrossPartitionContributionBounder — L0 only (the combiner clips
+    per-partition sums for Linf).
+
+On the TPU path the equivalent bounding runs inside the fused kernel
+(executor.py): per-(pid, pk) random-rank selection and per-pid partition
+sampling over sorted segments — semantically the same uniform sampling.
+"""
+
+import abc
+import collections
+from typing import Callable, Iterable
+
+from pipelinedp_tpu import sampling_utils
+
+
+class ContributionBounder(abc.ABC):
+    """Interface for contribution-bounding strategies."""
+
+    @abc.abstractmethod
+    def bound_contributions(self, col, params, backend, report_generator,
+                            aggregate_fn: Callable):
+        """Bounds contributions and aggregates per (privacy_id, partition_key).
+
+        Args:
+          col: collection of (privacy_id, partition_key, value).
+          params: AggregateParams with the bounds.
+          backend: PipelineBackend.
+          report_generator: ReportGenerator to narrate the stages.
+          aggregate_fn: list-of-values -> accumulator.
+
+        Returns:
+          collection of ((privacy_id, partition_key), accumulator).
+        """
+
+
+class SamplingCrossAndPerPartitionContributionBounder(ContributionBounder):
+    """Bounds both Linf (per-partition) and L0 (cross-partition) by sampling."""
+
+    def bound_contributions(self, col, params, backend, report_generator,
+                            aggregate_fn):
+        max_partitions_contributed = params.max_partitions_contributed
+        max_contributions_per_partition = (
+            params.max_contributions_per_partition)
+        col = backend.map_tuple(
+            col, lambda pid, pk, v: ((pid, pk), v),
+            "Rekey to ((privacy_id, partition_key), value)")
+        col = backend.sample_fixed_per_key(
+            col, max_contributions_per_partition,
+            "Sample per (privacy_id, partition_key)")
+        report_generator.add_stage(
+            f"Per-partition contribution bounding: for each privacy_id and "
+            f"each partition, randomly select "
+            f"max(actual_contributions_per_partition, "
+            f"{max_contributions_per_partition}) contributions.")
+        # ((privacy_id, partition_key), [value])
+        col = backend.map_values(
+            col, aggregate_fn, "Apply aggregate_fn after per partition "
+            "bounding")
+        # ((privacy_id, partition_key), accumulator)
+        col = backend.map_tuple(
+            col, lambda pid_pk, acc: (pid_pk[0], (pid_pk[1], acc)),
+            "Rekey to (privacy_id, (partition_key, accumulator))")
+        col = backend.sample_fixed_per_key(col, max_partitions_contributed,
+                                           "Sample per privacy_id")
+        report_generator.add_stage(
+            f"Cross-partition contribution bounding: for each privacy_id "
+            f"randomly select max(actual_partition_contributed, "
+            f"{max_partitions_contributed}) partitions")
+
+        # (privacy_id, [(partition_key, accumulator)])
+        def unnest(pid_and_pk_accs):
+            pid, pk_accs = pid_and_pk_accs
+            return (((pid, pk), acc) for (pk, acc) in pk_accs)
+
+        return backend.flat_map(col, unnest, "Rekey by privacy_id and unnest")
+
+
+class SamplingPerPrivacyIdContributionBounder(ContributionBounder):
+    """Bounds the *total* number of contributions per privacy unit."""
+
+    def bound_contributions(self, col, params, backend, report_generator,
+                            aggregate_fn):
+        max_contributions = params.max_contributions
+        col = backend.map_tuple(
+            col, lambda pid, pk, v: (pid, (pk, v)),
+            "Rekey to (privacy_id, (partition_key, value))")
+        col = backend.sample_fixed_per_key(col, max_contributions,
+                                           "Sample per privacy_id")
+        report_generator.add_stage(
+            f"User contribution bounding: randomly selected not "
+            f"more than {max_contributions} contributions")
+        # (privacy_id, [(partition_key, value)])
+        col = collect_values_per_partition_key_per_privacy_id(col, backend)
+
+        # (privacy_id, [(partition_key, [value])])
+        def unnest(pid_and_partition_values):
+            pid, partition_values = pid_and_partition_values
+            for pk, values in partition_values:
+                yield (pid, pk), values
+
+        col = backend.flat_map(col, unnest, "Unnest")
+        # ((privacy_id, partition_key), [value])
+        return backend.map_values(
+            col, aggregate_fn,
+            "Apply aggregate_fn after per privacy_id contribution bounding")
+
+
+class SamplingCrossPartitionContributionBounder(ContributionBounder):
+    """Bounds only L0; aggregate_fn is responsible for Linf (e.g. SumCombiner
+    clipping the per-partition sum)."""
+
+    def bound_contributions(self, col, params, backend, report_generator,
+                            aggregate_fn):
+        col = backend.map_tuple(
+            col, lambda pid, pk, v: (pid, (pk, v)),
+            "Rekey to (privacy_id, (partition_key, value))")
+        col = backend.group_by_key(col, "Group by privacy_id")
+        # (privacy_id, [(partition_key, value)])
+        col = collect_values_per_partition_key_per_privacy_id(col, backend)
+        # (privacy_id, [(partition_key, [value])])
+        sample = sampling_utils.choose_from_list_without_replacement
+        sample_size = params.max_partitions_contributed
+        col = backend.map_values(col, lambda a: sample(a, sample_size),
+                                 "Sample")
+        report_generator.add_stage(
+            f"Cross-partition contribution bounding: for each privacy_id "
+            f"randomly select max(actual_partition_contributed, "
+            f"{sample_size}) partitions")
+
+        # (privacy_id, [(partition_key, [value])])
+        def unnest(pid_and_partition_values):
+            pid, partition_values = pid_and_partition_values
+            for pk, values in partition_values:
+                yield (pid, pk), values
+
+        col = backend.flat_map(col, unnest, "Unnest per privacy_id")
+        # ((privacy_id, partition_key), [value])
+        return backend.map_values(
+            col, aggregate_fn,
+            "Apply aggregate_fn after cross-partition contribution bounding")
+
+
+def collect_values_per_partition_key_per_privacy_id(col, backend):
+    """(privacy_id, [(pk, value)]) -> (privacy_id, [(pk, [values])])."""
+
+    def collect_fn(pk_value_pairs: Iterable):
+        d = collections.defaultdict(list)
+        for pk, value in pk_value_pairs:
+            d[pk].append(value)
+        return list(d.items())
+
+    return backend.map_values(
+        col, collect_fn, "Collect values per privacy_id and partition_key")

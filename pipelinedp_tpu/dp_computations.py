@@ -276,19 +276,45 @@ def compute_dp_var(count: int, normalized_sum: float,
     return dp_count, dp_mean * dp_count, dp_mean, dp_var
 
 
+def noise_std(eps: float, delta: float, l0_sensitivity: float,
+              linf_sensitivity: float, noise_kind: NoiseKind) -> float:
+    """Noise stddev of the additive mechanism with the given budget and
+    (l0, linf) sensitivities. Single source of truth for both the host
+    mechanisms and the TPU executor's vectorized noise."""
+    if linf_sensitivity == 0:
+        return 0.0
+    if noise_kind == NoiseKind.LAPLACE:
+        b = compute_l1_sensitivity(l0_sensitivity, linf_sensitivity) / eps
+        return b * math.sqrt(2)
+    if noise_kind == NoiseKind.GAUSSIAN:
+        l2 = compute_l2_sensitivity(l0_sensitivity, linf_sensitivity)
+        return gaussian_sigma(eps, delta, l2)
+    raise ValueError("Only Laplace and Gaussian noise is supported.")
+
+
 def _compute_noise_std(linf_sensitivity: float,
                        dp_params: ScalarNoiseParams) -> float:
     """Noise std for the given linf sensitivity (reference :369-382)."""
-    if dp_params.noise_kind == NoiseKind.LAPLACE:
-        l1 = compute_l1_sensitivity(dp_params.l0_sensitivity(),
-                                    linf_sensitivity)
-        b = l1 / dp_params.eps
-        return b * math.sqrt(2)
-    if dp_params.noise_kind == NoiseKind.GAUSSIAN:
-        l2 = compute_l2_sensitivity(dp_params.l0_sensitivity(),
-                                    linf_sensitivity)
-        return gaussian_sigma(dp_params.eps, dp_params.delta, l2)
-    raise ValueError("Only Laplace and Gaussian noise is supported.")
+    return noise_std(dp_params.eps, dp_params.delta,
+                     dp_params.l0_sensitivity(), linf_sensitivity,
+                     dp_params.noise_kind)
+
+
+def compute_dp_var_noise_stds(eps: float, delta: float, l0: int, linf: int,
+                              min_value: float, max_value: float,
+                              noise_kind: NoiseKind) -> Tuple[float, float,
+                                                              float]:
+    """The three noise stddevs used by compute_dp_var's budget split
+    (count, normalized sum, normalized sum of squares) — shared by the host
+    path and the TPU executor."""
+    (e1, d1), (e2, d2), (e3, d3) = equally_split_budget(eps, delta, 3)
+    count_std = noise_std(e1, d1, l0, linf, noise_kind)
+    mid = compute_middle(min_value, max_value)
+    nsum_std = noise_std(e2, d2, l0, linf * abs(mid - min_value), noise_kind)
+    sq_lo, sq_hi = compute_squares_interval(min_value, max_value)
+    mid2 = compute_middle(sq_lo, sq_hi)
+    nsum2_std = noise_std(e3, d3, l0, linf * abs(mid2 - sq_lo), noise_kind)
+    return count_std, nsum_std, nsum2_std
 
 
 def compute_dp_count_noise_std(dp_params: ScalarNoiseParams) -> float:

@@ -1,0 +1,558 @@
+"""Fused columnar DP aggregation executor.
+
+This is the TPU replacement for the reference's interpreted op graph
+(dp_engine.py:101-176): contribution bounding, per-partition combining,
+private partition selection and noise run as ONE jit-compiled XLA program
+over columnar arrays:
+
+    rows (pid, pk, value)
+      -> sort by (pid, pk, u)            # u ~ U(0,1): random ranks
+      -> Linf bounding: rank < max_contributions_per_partition
+      -> per-(pid,pk) accumulators       # segment sums: count/sum/nsum/nsum2
+      -> sort pairs by (pid, u')         # L0 bounding: rank < l0
+      -> per-partition dense columns     # segment sums into [0, P)
+      -> DP partition selection          # closed-form keep probs + Bernoulli
+      -> noise, metric formulas          # vectorized, stds are traced inputs
+
+The three shuffles of the reference (SURVEY.md §3.1) become two lexsorts and
+one scatter — no host round-trips, no per-partition C++ calls.
+
+The program is split in two phases so the multi-chip path
+(parallel/sharded.py) can insert a psum between them:
+
+    partial_columns(rows_shard)  -> dense per-partition partial columns
+    [lax.psum over the mesh]
+    finalize(columns)            -> selection + noise + metric formulas
+
+Budget laziness: noise stddevs and selection (eps, delta) enter as *traced*
+scalars, so BudgetAccountant.compute_budgets() may run after compilation;
+the engine wraps execution in a lazy generator that runs on first iteration.
+"""
+
+import functools
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipelinedp_tpu import columnar
+from pipelinedp_tpu import combiners as dp_combiners
+from pipelinedp_tpu import dp_computations
+from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
+                                             Metrics, NoiseKind)
+from pipelinedp_tpu.ops import noise as noise_ops
+from pipelinedp_tpu.ops import segment_ops
+from pipelinedp_tpu.ops import selection_ops
+
+
+def _ftype():
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def _partition_segment_sum(data, seg_ids, num_segments: int):
+    """Float segment-sum into the (small) partition axis.
+
+    On the f64 path this is a plain segment sum. On the f32 path (real TPU —
+    no f64 hardware) a flat scatter-add accrues O(n) sequential rounding bias
+    on hot partitions, which can reach the order of the DP noise; chunking
+    into B independent scatters followed by a tree reduction over B cuts the
+    bias to O(n/B + B) at the cost of a (B, num_segments) intermediate.
+    """
+    if jax.config.jax_enable_x64:
+        return jax.ops.segment_sum(data, seg_ids, num_segments)
+    n = data.shape[0]
+    chunks = 1
+    while chunks < 256 and (n % (chunks * 2) == 0) and n // (chunks * 2) >= 64:
+        chunks *= 2
+    if chunks == 1:
+        return jax.ops.segment_sum(data, seg_ids, num_segments)
+    partials = jax.vmap(
+        lambda d, s: jax.ops.segment_sum(d, s, num_segments))(
+            data.reshape(chunks, -1), seg_ids.reshape(chunks, -1))
+    return partials.sum(axis=0)
+
+
+def _count_segment_sum(mask, seg_ids, num_segments: int):
+    """Exact integer segment count (i32 accumulate, cast to float)."""
+    return jax.ops.segment_sum(mask.astype(jnp.int32), seg_ids,
+                               num_segments).astype(_ftype())
+
+
+@dataclass(frozen=True)
+class MetricPlanEntry:
+    """Static description of one child combiner's device computation."""
+    kind: str  # count | privacy_id_count | sum | mean | variance
+    outputs: Tuple[str, ...]  # metric names in the child's output order
+    n_stds: int  # number of noise stddevs the entry consumes
+
+
+@dataclass(frozen=True)
+class KernelConfig:
+    """Hashable static configuration of the fused kernel."""
+    n_partitions: int
+    linf: int  # 0 = no per-partition row sampling
+    l0: int  # 0 = no cross-partition pair sampling
+    total_bound: int  # max_contributions (0 = unset)
+    sample_per_partition: bool
+    clip_per_value: bool
+    clip_pair_sum: bool
+    bounds_enforced: bool
+    noise_kind: NoiseKind
+    private_selection: bool
+    selection: Optional[selection_ops.SelectionParams]
+    max_rows_per_privacy_id: int
+    plan: Tuple[MetricPlanEntry, ...]
+    degenerate_range: bool  # min_value == max_value
+
+
+SUPPORTED_COLUMNAR_METRICS = (Metrics.COUNT, Metrics.PRIVACY_ID_COUNT,
+                              Metrics.SUM, Metrics.MEAN, Metrics.VARIANCE)
+
+
+def supports(params: AggregateParams) -> bool:
+    """Whether the fused columnar path can run this aggregation."""
+    if params.custom_combiners:
+        return False
+    if any(m.is_percentile or m == Metrics.VECTOR_SUM
+           for m in params.metrics):
+        return False
+    return True
+
+
+def build_plan(
+        compound: dp_combiners.CompoundCombiner
+) -> Tuple[MetricPlanEntry, ...]:
+    """Builds the static metric plan from a CompoundCombiner's children."""
+    plan = []
+    for child in compound.combiners:
+        if isinstance(child, dp_combiners.CountCombiner):
+            plan.append(MetricPlanEntry('count', ('count',), 1))
+        elif isinstance(child, dp_combiners.PrivacyIdCountCombiner):
+            plan.append(
+                MetricPlanEntry('privacy_id_count', ('privacy_id_count',), 1))
+        elif isinstance(child, dp_combiners.SumCombiner):
+            plan.append(MetricPlanEntry('sum', ('sum',), 1))
+        elif isinstance(child, dp_combiners.MeanCombiner):
+            names = child.metrics_names()
+            outputs = ['mean'] + [m for m in ('count', 'sum') if m in names]
+            plan.append(MetricPlanEntry('mean', tuple(outputs), 2))
+        elif isinstance(child, dp_combiners.VarianceCombiner):
+            # True output order = VarianceCombiner.compute_metrics insertion
+            # order (variance, then count/sum/mean as requested).
+            names = child.metrics_names()
+            outputs = ['variance'] + [
+                m for m in ('count', 'sum', 'mean') if m in names
+            ]
+            plan.append(MetricPlanEntry('variance', tuple(outputs), 3))
+        else:
+            raise NotImplementedError(
+                f"Combiner {type(child).__name__} has no columnar lowering")
+    return tuple(plan)
+
+
+def compute_noise_stds(compound: dp_combiners.CompoundCombiner,
+                       params: AggregateParams) -> np.ndarray:
+    """Noise stddevs for every plan entry, in plan order.
+
+    Must be called after BudgetAccountant.compute_budgets(): mechanisms are
+    materialized from the (now filled) specs. The result feeds the kernel as
+    a traced array — the budget two-phase protocol on device.
+    """
+    stds: List[float] = []
+    for child in compound.combiners:
+        if isinstance(
+                child,
+            (dp_combiners.CountCombiner, dp_combiners.PrivacyIdCountCombiner,
+             dp_combiners.SumCombiner)):
+            stds.append(child.get_mechanism().std)
+        elif isinstance(child, dp_combiners.MeanCombiner):
+            mech = child.get_mechanism()
+            stds.append(mech.count_mechanism.std)
+            stds.append(mech.sum_mechanism.std)
+        elif isinstance(child, dp_combiners.VarianceCombiner):
+            stds.extend(_variance_stds(child, params))
+        else:
+            raise NotImplementedError(type(child))
+    return np.asarray(stds, dtype=np.float64)
+
+
+def _variance_stds(child: dp_combiners.VarianceCombiner,
+                   params: AggregateParams) -> List[float]:
+    """The three noise stds of compute_dp_var (shared helper, so the TPU
+    path can never diverge from the host calibration)."""
+    return list(
+        dp_computations.compute_dp_var_noise_stds(
+            child._params.eps, child._params.delta,
+            params.max_partitions_contributed,
+            params.max_contributions_per_partition, params.min_value,
+            params.max_value, params.noise_kind))
+
+
+def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
+                    valid: jnp.ndarray, min_v, max_v, min_s, max_s, mid,
+                    rows_key: jax.Array, cfg: KernelConfig):
+    """Phase 1: contribution bounding + per-partition partial columns.
+
+    Runs per shard on the multi-chip path (each privacy unit's rows must be
+    co-located on one shard). Returns a dict of f[P] dense columns:
+    count / sum / nsum / nsum2 / pid_count / row_count.
+    """
+    f = _ftype()
+    n = pid.shape[0]
+    P = cfg.n_partitions
+    i32 = jnp.int32
+    values = values.astype(f)
+    key_total, key_linf, key_l0 = jax.random.split(rows_key, 3)
+
+    pk_sent = jnp.where(valid, pk, P).astype(i32)
+    pid_sent = jnp.where(valid, pid, jnp.iinfo(i32).max).astype(i32)
+
+    if cfg.total_bound and not cfg.bounds_enforced:
+        # Total-contribution bounding: uniform <=K subset of each pid's rows.
+        rand0 = jax.random.uniform(key_total, (n,))
+        order0 = jnp.lexsort((rand0, pid_sent))
+        new_pid0 = segment_ops.boundary_mask(pid_sent[order0])
+        _, rank0 = segment_ops.segment_starts_and_ids(new_pid0)
+        keep0 = jnp.zeros(n, bool).at[order0].set(rank0 < cfg.total_bound)
+        valid = valid & keep0
+        pk_sent = jnp.where(valid, pk, P).astype(i32)
+        pid_sent = jnp.where(valid, pid, jnp.iinfo(i32).max).astype(i32)
+
+    if cfg.bounds_enforced:
+        # No privacy ids: each row is its own contribution group.
+        row_mask = valid
+        clipped = jnp.clip(values, min_v,
+                           max_v) if cfg.clip_per_value else values
+        contrib = jnp.where(row_mask, clipped, 0.0)
+        if cfg.clip_pair_sum:
+            contrib = jnp.clip(contrib, min_s, max_s)
+        seg_pk = pk_sent
+        part_count = _count_segment_sum(row_mask, seg_pk, P + 1)[:P]
+        part_sum = _partition_segment_sum(contrib, seg_pk, P + 1)[:P]
+        ncontrib = jnp.where(row_mask, clipped - mid, 0.0)
+        part_nsum = _partition_segment_sum(ncontrib, seg_pk, P + 1)[:P]
+        part_nsum2 = _partition_segment_sum(ncontrib * ncontrib, seg_pk,
+                                            P + 1)[:P]
+        return dict(count=part_count,
+                    sum=part_sum,
+                    nsum=part_nsum,
+                    nsum2=part_nsum2,
+                    pid_count=part_count,
+                    row_count=part_count)
+
+    # --- Linf bounding: random rank within (pid, pk). ---
+    rand = jax.random.uniform(key_linf, (n,))
+    order = jnp.lexsort((rand, pk_sent, pid_sent))
+    spid = pid_sent[order]
+    spk = pk_sent[order]
+    sval = values[order]
+    svalid = valid[order]
+    new_pair = segment_ops.boundary_mask(spid, spk)
+    pair_id, rank = segment_ops.segment_starts_and_ids(new_pair)
+    if cfg.sample_per_partition and cfg.linf:
+        row_mask = svalid & (rank < cfg.linf)
+    else:
+        row_mask = svalid
+    clipped = jnp.clip(sval, min_v, max_v) if cfg.clip_per_value else sval
+    contrib = jnp.where(row_mask, clipped, 0.0)
+
+    # --- Per-(pid, pk) accumulators. ---
+    maskf = row_mask.astype(f)
+    pair_count = segment_ops.segment_sum(maskf, pair_id, n)
+    pair_sum = segment_ops.segment_sum(contrib, pair_id, n)
+    if cfg.clip_pair_sum:
+        pair_sum = jnp.clip(pair_sum, min_s, max_s)
+    ncontrib = jnp.where(row_mask, clipped - mid, 0.0)
+    pair_nsum = segment_ops.segment_sum(ncontrib, pair_id, n)
+    pair_nsum2 = segment_ops.segment_sum(ncontrib * ncontrib, pair_id, n)
+    pair_pk = segment_ops.segment_constant(spk, pair_id, n)
+    pair_pid = segment_ops.segment_constant(spid, pair_id, n)
+    pair_valid = segment_ops.segment_sum(svalid.astype(jnp.int32), pair_id,
+                                         n) > 0
+
+    # --- L0 bounding: random rank of pairs within pid. ---
+    if cfg.l0:
+        rand2 = jax.random.uniform(key_l0, (n,))
+        pair_pid_key = jnp.where(pair_valid, pair_pid, jnp.iinfo(i32).max)
+        order2 = jnp.lexsort((rand2, pair_pid_key))
+        new_pid2 = segment_ops.boundary_mask(pair_pid_key[order2])
+        _, prank = segment_ops.segment_starts_and_ids(new_pid2)
+        keep_l0 = jnp.zeros(n, bool).at[order2].set(prank < cfg.l0)
+        keep_l0 = keep_l0 & pair_valid
+    else:
+        keep_l0 = pair_valid
+
+    # --- Per-partition dense columns. ---
+    seg_pk = jnp.where(keep_l0, pair_pk, P).astype(i32)
+    keepf = keep_l0.astype(f)
+    part_count = _partition_segment_sum(pair_count * keepf, seg_pk, P + 1)[:P]
+    part_sum = _partition_segment_sum(pair_sum * keepf, seg_pk, P + 1)[:P]
+    part_nsum = _partition_segment_sum(pair_nsum * keepf, seg_pk, P + 1)[:P]
+    part_nsum2 = _partition_segment_sum(pair_nsum2 * keepf, seg_pk,
+                                        P + 1)[:P]
+    part_pid_count = _count_segment_sum(keep_l0, seg_pk, P + 1)[:P]
+    return dict(count=part_count,
+                sum=part_sum,
+                nsum=part_nsum,
+                nsum2=part_nsum2,
+                pid_count=part_pid_count,
+                row_count=part_pid_count)
+
+
+def finalize(cols, min_v, mid, stds: jnp.ndarray, final_key: jax.Array,
+             cfg: KernelConfig):
+    """Phase 2: DP partition selection + noise + metric formulas.
+
+    On the multi-chip path `cols` are globally psum'd columns; this phase is
+    computed identically on every shard (same key -> same results).
+    """
+    f = _ftype()
+    key_sel, key_noise = jax.random.split(final_key, 2)
+    part_row_count = cols['row_count']
+    P = cfg.n_partitions
+
+    if cfg.private_selection:
+        est = jnp.ceil(part_row_count / cfg.max_rows_per_privacy_id).astype(
+            jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+        keep = selection_ops.sample_keep_decisions(key_sel, est, cfg.selection)
+    else:
+        keep = jnp.ones(P, dtype=bool)
+
+    outputs = {}
+    std_offset = 0
+    for i, entry in enumerate(cfg.plan):
+        ekey = jax.random.fold_in(key_noise, i)
+        kind = cfg.noise_kind
+
+        def noised(col, std_idx, subkey_idx):
+            return col + noise_ops.additive_noise(
+                jax.random.fold_in(ekey, subkey_idx), col.shape,
+                stds[std_idx].astype(f), kind)
+
+        if entry.kind == 'count':
+            outputs['count'] = noised(cols['count'], std_offset, 0)
+        elif entry.kind == 'privacy_id_count':
+            outputs['privacy_id_count'] = noised(cols['pid_count'],
+                                                 std_offset, 0)
+        elif entry.kind == 'sum':
+            outputs['sum'] = noised(cols['sum'], std_offset, 0)
+        elif entry.kind == 'mean':
+            dp_count = noised(cols['count'], std_offset, 0)
+            dp_nsum = noised(cols['nsum'], std_offset + 1, 1)
+            denom = jnp.maximum(1.0, dp_count)
+            dp_mean = mid + dp_nsum / denom
+            outputs['mean'] = dp_mean
+            if 'count' in entry.outputs:
+                outputs['count'] = dp_count
+            if 'sum' in entry.outputs:
+                outputs['sum'] = dp_mean * dp_count
+        elif entry.kind == 'variance':
+            dp_count = noised(cols['count'], std_offset, 0)
+            denom = jnp.maximum(1.0, dp_count)
+            if cfg.degenerate_range:
+                dp_nmean = jnp.full_like(cols['count'], min_v)
+                dp_nsqmean = dp_nmean * dp_nmean
+            else:
+                dp_nmean = noised(cols['nsum'], std_offset + 1, 1) / denom
+                dp_nsqmean = noised(cols['nsum2'], std_offset + 2, 2) / denom
+            variance = dp_nsqmean - dp_nmean * dp_nmean
+            dp_mean = dp_nmean + (0.0 if cfg.degenerate_range else mid)
+            outputs['variance'] = variance
+            if 'mean' in entry.outputs:
+                outputs['mean'] = dp_mean
+            if 'count' in entry.outputs:
+                outputs['count'] = dp_count
+            if 'sum' in entry.outputs:
+                outputs['sum'] = dp_mean * dp_count
+        std_offset += entry.n_stds
+
+    return outputs, keep, part_row_count
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def aggregate_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+                     stds, rng_key, cfg: KernelConfig):
+    """Single-device fused program: partial_columns + finalize."""
+    rows_key, final_key = jax.random.split(rng_key, 2)
+    cols = partial_columns(pid, pk, values, valid, min_v, max_v, min_s, max_s,
+                           mid, rows_key, cfg)
+    return finalize(cols, min_v, mid, stds, final_key, cfg)
+
+
+def make_kernel_config(
+        params: AggregateParams,
+        compound: dp_combiners.CompoundCombiner,
+        n_partitions: int,
+        private_selection: bool,
+        selection_params: Optional[selection_ops.SelectionParams]
+) -> KernelConfig:
+    """Builds the static kernel config from aggregation parameters."""
+    clip_per_value = params.bounds_per_contribution_are_set
+    clip_pair_sum = params.bounds_per_partition_are_set
+    max_rows = 1
+    if params.contribution_bounds_already_enforced:
+        max_rows = (params.max_contributions or
+                    params.max_contributions_per_partition or 1)
+    degenerate = (params.min_value is not None and
+                  params.min_value == params.max_value)
+    return KernelConfig(
+        n_partitions=n_partitions,
+        linf=params.max_contributions_per_partition or 0,
+        l0=(0 if params.max_contributions else
+            (params.max_partitions_contributed or 0)),
+        total_bound=params.max_contributions or 0,
+        sample_per_partition=compound.expects_per_partition_sampling(),
+        clip_per_value=clip_per_value,
+        clip_pair_sum=clip_pair_sum,
+        bounds_enforced=params.contribution_bounds_already_enforced,
+        noise_kind=params.noise_kind,
+        private_selection=private_selection,
+        selection=selection_params,
+        max_rows_per_privacy_id=max_rows,
+        plan=build_plan(compound),
+        degenerate_range=degenerate)
+
+
+def kernel_scalars(params: AggregateParams):
+    """Traced clipping scalars (0.0 placeholders when unused)."""
+    min_v = params.min_value if params.min_value is not None else 0.0
+    max_v = params.max_value if params.max_value is not None else 0.0
+    min_s = (params.min_sum_per_partition
+             if params.min_sum_per_partition is not None else 0.0)
+    max_s = (params.max_sum_per_partition
+             if params.max_sum_per_partition is not None else 0.0)
+    mid = (dp_computations.compute_middle(min_v, max_v)
+           if params.min_value is not None else 0.0)
+    return min_v, max_v, min_s, max_s, mid
+
+
+def _round_up_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def pad_rows(encoded: columnar.EncodedData):
+    """Pads row arrays to the next power of two (invalid-marked), so jit
+    compilation is reused across datasets of similar size."""
+    n = encoded.n_rows
+    n_pad = max(8, _round_up_pow2(n))
+    if n_pad == n:
+        return (encoded.pid, encoded.pk, encoded.values,
+                encoded.valid)
+    pad = n_pad - n
+    pid = np.concatenate([encoded.pid, np.zeros(pad, np.int32)])
+    pk = np.concatenate([encoded.pk, np.full(pad, -1, np.int32)])
+    values = np.concatenate([encoded.values, np.zeros(pad, np.float64)])
+    valid = np.concatenate([encoded.valid, np.zeros(pad, bool)])
+    return pid, pk, values, valid
+
+
+def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
+                   public_partitions, budget_accountant, report_generator):
+    """Graph-time setup + lazily executed fused aggregation.
+
+    Budgets are requested NOW (graph time); the device program runs when the
+    returned generator is first iterated — after compute_budgets().
+    """
+    compound = dp_combiners.create_compound_combiner(params,
+                                                     budget_accountant)
+    private = public_partitions is None
+    selection_budget = None
+    if private:
+        selection_budget = budget_accountant.request_budget(
+            mechanism_type=MechanismType.GENERIC)
+
+    # Report stages (mirrors the generic path narration).
+    if not private:
+        report_generator.add_stage(
+            "Public partition selection: dropped non public partitions")
+    if not params.contribution_bounds_already_enforced:
+        if params.max_contributions:
+            report_generator.add_stage(
+                f"User contribution bounding: randomly selected not "
+                f"more than {params.max_contributions} contributions")
+        else:
+            if compound.expects_per_partition_sampling():
+                report_generator.add_stage(
+                    f"Per-partition contribution bounding: for each privacy_id "
+                    f"and each partition, randomly select "
+                    f"max(actual_contributions_per_partition, "
+                    f"{params.max_contributions_per_partition}) contributions.")
+            report_generator.add_stage(
+                f"Cross-partition contribution bounding: for each privacy_id "
+                f"randomly select max(actual_partition_contributed, "
+                f"{params.max_partitions_contributed}) partitions")
+    if private:
+        strategy = params.partition_selection_strategy
+        pre_threshold_str = (f", pre_threshold={params.pre_threshold}"
+                             if params.pre_threshold else "")
+        report_generator.add_stage(
+            lambda: f"Private Partition selection: using {strategy.value} "
+            f"method with (eps={selection_budget.eps}, "
+            f"delta={selection_budget.delta}{pre_threshold_str})")
+    for stage in compound.explain_computation():
+        report_generator.add_stage(stage)
+
+    public_list = (list(public_partitions)
+                   if public_partitions is not None else None)
+    rows = col  # materialized at execution time
+
+    def generator():
+        encoded = columnar.encode(rows, data_extractors, public_list)
+        selection_params = None
+        if private:
+            selection_params = selection_ops.selection_params_from_host(
+                params.partition_selection_strategy, selection_budget.eps,
+                selection_budget.delta, params.max_partitions_contributed,
+                params.pre_threshold)
+        n_partitions = encoded.n_partitions
+        if backend.max_partitions is not None:
+            if backend.max_partitions < n_partitions:
+                raise ValueError(
+                    f"TPUBackend(max_partitions={backend.max_partitions}) is "
+                    f"smaller than the {n_partitions} partitions in the data.")
+            n_partitions = backend.max_partitions
+        cfg = make_kernel_config(params, compound, n_partitions, private,
+                                 selection_params)
+        stds = compute_noise_stds(compound, params)
+        key = noise_ops.make_noise_key(getattr(backend, "noise_seed", None))
+        min_v, max_v, min_s, max_s, mid = kernel_scalars(params)
+        pid, pk, values, valid = pad_rows(encoded)
+        if backend.mesh is not None:
+            from pipelinedp_tpu.parallel import sharded
+            outputs, keep, _ = sharded.sharded_aggregate_arrays(
+                backend.mesh, pid, pk, values, valid, min_v, max_v, min_s,
+                max_s, mid, stds, key, cfg)
+        else:
+            outputs, keep, _ = aggregate_kernel(
+                jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(values),
+                jnp.asarray(valid), min_v, max_v, min_s, max_s, mid,
+                jnp.asarray(stds), key, cfg)
+        yield from decode_results(outputs, keep, encoded.partition_vocab,
+                                  compound)
+
+    return generator()
+
+
+def decode_results(outputs, keep, partition_vocab: Sequence[Any],
+                   compound: dp_combiners.CompoundCombiner):
+    """Device arrays -> [(partition_key, MetricsTuple)], matching the generic
+    path's namedtuple field order (per-child compute_metrics dict order)."""
+    keep_np = np.asarray(keep)
+    outputs_np = {name: np.asarray(col) for name, col in outputs.items()}
+    # Field order = concatenated plan-entry outputs, which build_plan stores
+    # in each child's true compute_metrics insertion order — identical to
+    # CompoundCombiner.compute_metrics on the generic path.
+    field_order: List[str] = [
+        name for entry in build_plan(compound) for name in entry.outputs
+    ]
+    n_real = len(partition_vocab)
+    for idx in np.nonzero(keep_np)[0]:
+        if idx >= n_real:
+            continue  # padding partitions beyond the vocabulary
+        values = tuple(
+            float(outputs_np[name][idx]) for name in field_order)
+        yield (partition_vocab[idx],
+               dp_combiners._create_named_tuple_instance(
+                   "MetricsTuple", tuple(field_order), values))

@@ -1,0 +1,1 @@
+"""Device kernels and array-shaped primitives for the TPU engine."""

@@ -1,0 +1,46 @@
+"""On-device noise generation, fused into the aggregation XLA program.
+
+The reference crosses into PyDP C++ once per partition per metric to draw
+noise (dp_computations.py:457-509). Here noise for all partitions and all
+metric columns is drawn vectorized with JAX's counter-based RNG and added in
+the same compiled program as the aggregation — zero host round-trips.
+
+Noise scale (stddev) is a *traced* scalar input, never a compile-time
+constant, so BudgetAccountant.compute_budgets() may run after tracing.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from pipelinedp_tpu.aggregate_params import NoiseKind
+
+
+def laplace_noise(key: jax.Array, shape, std) -> jnp.ndarray:
+    """Laplace noise with the given *standard deviation* (b = std/sqrt(2))."""
+    b = std / jnp.sqrt(2.0)
+    return jax.random.laplace(key, shape) * b
+
+
+def gaussian_noise(key: jax.Array, shape, std) -> jnp.ndarray:
+    return jax.random.normal(key, shape) * std
+
+
+def additive_noise(key: jax.Array, shape, std,
+                   noise_kind: NoiseKind) -> jnp.ndarray:
+    """Noise with standard deviation `std` of the given kind (static)."""
+    if noise_kind == NoiseKind.LAPLACE:
+        return laplace_noise(key, shape, std)
+    if noise_kind == NoiseKind.GAUSSIAN:
+        return gaussian_noise(key, shape, std)
+    raise ValueError(f"Unsupported noise kind {noise_kind}")
+
+
+def make_noise_key(seed: Optional[int]) -> jax.Array:
+    """Base PRNG key for one aggregation; fresh nondeterministic if seed is
+    None."""
+    if seed is None:
+        import secrets
+        seed = secrets.randbits(63)
+    return jax.random.PRNGKey(seed)

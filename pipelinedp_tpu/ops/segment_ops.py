@@ -1,0 +1,59 @@
+"""Sorted-segment primitives for the columnar DP engine.
+
+The reference's keyed shuffles (group_by_key / sample_fixed_per_key /
+combine_accumulators_per_key, pipeline_backend.py:68-181) become, on a
+fixed-shape machine: lexicographic sort + boundary flags + cumulative scans +
+segment sums. Per-key uniform sampling without replacement is a random sort
+key + rank-within-segment comparison — every (key, value) gets an independent
+uniform draw, rows are sorted by (key, draw), and `rank < k` keeps exactly a
+uniform k-subset per key. All ops are O(n log n), XLA-fusable, static-shape.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def segment_starts_and_ids(new_segment: jnp.ndarray):
+    """Given a sorted-order boundary mask, returns (segment_id, rank) per row.
+
+    Args:
+        new_segment: bool[n], True where a new segment begins (element 0 must
+            be True).
+
+    Returns:
+        segment_id: i32[n], 0-based dense segment index per row.
+        rank: i32[n], 0-based position of the row inside its segment.
+    """
+    n = new_segment.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    segment_id = jnp.cumsum(new_segment.astype(jnp.int32)) - 1
+    starts = jax.lax.cummax(jnp.where(new_segment, idx, 0))
+    rank = idx - starts
+    return segment_id, rank
+
+
+def boundary_mask(*sorted_keys) -> jnp.ndarray:
+    """True where any of the (already sorted) key columns changes."""
+    n = sorted_keys[0].shape[0]
+    mask = jnp.zeros(n, dtype=bool).at[0].set(True)
+    for key in sorted_keys:
+        mask = mask | jnp.concatenate(
+            [jnp.ones(1, dtype=bool), key[1:] != key[:-1]])
+    return mask
+
+
+def segment_sum(data, segment_ids, num_segments: int):
+    """Sorted segment sum wrapper."""
+    return jax.ops.segment_sum(data,
+                               segment_ids,
+                               num_segments=num_segments,
+                               indices_are_sorted=True)
+
+
+def segment_constant(data, segment_ids, num_segments: int):
+    """Per-segment value of a column that is constant within each segment
+    (e.g. the pid/pk key columns a segment was grouped by)."""
+    return jax.ops.segment_max(data,
+                               segment_ids,
+                               num_segments=num_segments,
+                               indices_are_sorted=True)

@@ -1,0 +1,7 @@
+"""Multi-chip execution: mesh construction and sharded aggregation."""
+
+from pipelinedp_tpu.parallel.mesh import make_mesh
+from pipelinedp_tpu.parallel.sharded import (
+    shard_rows_by_pid,
+    sharded_aggregate_arrays,
+)

@@ -1,0 +1,25 @@
+"""Device-mesh helpers.
+
+The framework's parallelism model (SURVEY.md §2.5): rows are data-sharded by
+privacy-unit hash over a 1-D mesh axis "shards"; per-partition partial
+accumulators are combined with lax.psum over ICI. DCN-reachable multi-host
+meshes work the same way — jax.devices() spans all hosts under jax.distributed.
+"""
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              n_devices: Optional[int] = None) -> Mesh:
+    """1-D mesh over the given (or all) devices, axis name "shards"."""
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))

@@ -25,11 +25,13 @@ plus vectorized numpy versions used to build the device kernels
 """
 
 import abc
+import functools
 import math
 from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
+from scipy import special
 
 from pipelinedp_tpu.aggregate_params import PartitionSelectionStrategy
 from pipelinedp_tpu import dp_computations
@@ -122,19 +124,25 @@ class TruncatedGeometricPartitionSelector(PartitionSelector):
                          pre_threshold)
         self._eps1 = self._epsilon / self._l0
         self._delta1 = self._delta / self._l0
-        e = math.exp(self._eps1)
         d1 = self._delta1
         # Largest n such that phase-1 still applies to step n (i.e.
-        # pi_{n-1} <= (1 - d1)/(1 + e)).
-        ratio = 1.0 + (e - 1.0) * (1.0 - d1) / (d1 * (1.0 + e))
-        self._n_cross = 1 + int(math.floor(math.log(ratio) / self._eps1))
-        self._pi_cross = self._phase1(self._n_cross)
+        # pi_{n-1} <= (1 - d1)/(1 + e^eps1)). The ratio is computed via
+        # tanh(eps1/2) = (e-1)/(e+1), which never overflows for huge eps.
+        t = math.tanh(self._eps1 / 2)
+        self._n_cross = 1 + int(
+            math.floor(math.log1p(t * (1.0 - d1) / d1) / self._eps1))
+        self._pi_cross = float(self._phase1(self._n_cross))
 
     def _phase1(self, n):
-        # pi_n = d1 * (e^{n eps1} - 1) / (e^{eps1} - 1), computed stably.
+        # pi_n = d1 * (e^{n eps1} - 1) / (e^{eps1} - 1) evaluated in log
+        # space (overflow-safe for huge eps):
+        # log pi_n = log d1 + (n-1) eps1 + log1p(-e^{-n eps1})
+        #            - log1p(-e^{-eps1}).
         n = np.asarray(n, dtype=np.float64)
-        return (self._delta1 * np.expm1(n * self._eps1) /
-                math.expm1(self._eps1))
+        log_pi = (math.log(self._delta1) + (n - 1.0) * self._eps1 +
+                  np.log1p(-np.exp(-n * self._eps1)) -
+                  math.log1p(-math.exp(-self._eps1)))
+        return np.exp(np.minimum(log_pi, 0.0))
 
     def _probability_of_keep_shifted(self, n: np.ndarray) -> np.ndarray:
         n = np.asarray(n, dtype=np.float64)
@@ -200,7 +208,6 @@ class GaussianThresholdingPartitionSelector(PartitionSelector):
                                                      math.sqrt(self._l0))
         delta_p = -math.expm1(math.log1p(-threshold_delta) / self._l0)
         # Phi^{-1}(1 - delta_p) via erfcinv: Phi^{-1}(p)=-sqrt(2)erfcinv(2p).
-        from scipy import special
         quantile = -math.sqrt(2) * special.erfcinv(2 * (1 - delta_p))
         self._threshold = 1.0 + self._sigma * quantile
 
@@ -213,7 +220,6 @@ class GaussianThresholdingPartitionSelector(PartitionSelector):
         return self._threshold
 
     def _probability_of_keep_shifted(self, n: np.ndarray) -> np.ndarray:
-        from scipy import special
         z = (self._threshold - np.asarray(n, dtype=np.float64)) / self._sigma
         return 0.5 * special.erfc(z / math.sqrt(2))
 
@@ -228,6 +234,7 @@ _STRATEGY_TO_CLASS = {
 }
 
 
+@functools.lru_cache(maxsize=256)
 def create_partition_selection_strategy(
         strategy: PartitionSelectionStrategy,
         epsilon: float,
@@ -235,7 +242,12 @@ def create_partition_selection_strategy(
         max_partitions_contributed: int,
         pre_threshold: Optional[int] = None) -> PartitionSelector:
     """Creates a native partition-selection strategy object
-    (reference-parity factory: pipeline_dp/partition_selection.py:29-44)."""
+    (reference-parity factory: pipeline_dp/partition_selection.py:29-44).
+
+    Cached: selectors are deterministic in their parameters, and the engine's
+    per-partition filter would otherwise re-run the (bisection-heavy)
+    calibration once per partition.
+    """
     cls = _STRATEGY_TO_CLASS.get(strategy)
     if cls is None:
         raise ValueError(f"Unknown partition selection strategy {strategy}")

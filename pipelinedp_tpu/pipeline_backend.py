@@ -1,0 +1,667 @@
+"""Pipeline execution backends.
+
+The engine is a backend-generic dataflow builder: every step is a call to one
+of the ~20 `PipelineBackend` primitives with a stage-name string (reference:
+pipeline_dp/pipeline_backend.py:38-195). Backends provided here:
+
+  * LocalBackend        — lazy Python generators; the ground-truth semantics.
+  * TPUBackend          — columnar JAX/XLA execution. It is a *marker + device
+                          config* object: DPEngine recognizes it and lowers
+                          the whole aggregation to one fused XLA program
+                          (executor.py) instead of interpreting the op graph.
+                          The generic op vocabulary is still implemented
+                          (host-side, numpy) so non-fused utilities
+                          (histograms, analysis glue) run anywhere.
+  * MultiProcLocalBackend — multiprocessing Pool over materialized stages.
+  * BeamBackend / SparkRDDBackend — thin adapters over Apache Beam / PySpark,
+                          available when those packages are importable
+                          (they are optional, exactly as in the reference).
+
+An Annotator hook mirrors reference :826-852.
+"""
+
+import abc
+import collections
+import functools
+import itertools
+import operator
+import random
+from typing import Any, Callable, Iterable, List, Optional
+
+import numpy as np
+
+from pipelinedp_tpu import combiners as dp_combiners
+
+try:
+    import apache_beam as beam
+except ImportError:
+    beam = None
+
+try:
+    import pyspark
+except ImportError:
+    pyspark = None
+
+
+class PipelineBackend(abc.ABC):
+    """Interface implemented by all execution backends."""
+
+    def to_collection(self, collection_or_iterable, col, stage_name: str):
+        """Converts an iterable to the backend-native collection."""
+        del col, stage_name
+        return collection_or_iterable
+
+    def to_multi_transformable_collection(self, col):
+        """Returns a collection that can be iterated multiple times."""
+        return col
+
+    @abc.abstractmethod
+    def map(self, col, fn, stage_name: str):
+        pass
+
+    @abc.abstractmethod
+    def map_with_side_inputs(self, col, fn, side_input_cols, stage_name: str):
+        """fn(row, *side_inputs) where each side input collection is
+        materialized and passed as one object."""
+
+    @abc.abstractmethod
+    def flat_map(self, col, fn, stage_name: str):
+        pass
+
+    def flat_map_with_side_inputs(self, col, fn, side_input_cols,
+                                  stage_name: str):
+        raise NotImplementedError(
+            f"flat_map_with_side_inputs is not supported in "
+            f"{type(self).__name__}")
+
+    @abc.abstractmethod
+    def map_tuple(self, col, fn, stage_name: str):
+        pass
+
+    @abc.abstractmethod
+    def map_values(self, col, fn, stage_name: str):
+        pass
+
+    @abc.abstractmethod
+    def group_by_key(self, col, stage_name: str):
+        """(key, value) -> (key, iterable-of-values)."""
+
+    @abc.abstractmethod
+    def filter(self, col, fn, stage_name: str):
+        pass
+
+    @abc.abstractmethod
+    def filter_by_key(self, col, keys_to_keep, stage_name: str):
+        """Keeps only (key, data) whose key is in keys_to_keep (local list/set
+        or distributed collection)."""
+
+    @abc.abstractmethod
+    def keys(self, col, stage_name: str):
+        pass
+
+    @abc.abstractmethod
+    def values(self, col, stage_name: str):
+        pass
+
+    @abc.abstractmethod
+    def sample_fixed_per_key(self, col, n: int, stage_name: str):
+        """(key, value) -> (key, [<=n uniformly sampled values])."""
+
+    @abc.abstractmethod
+    def count_per_element(self, col, stage_name: str):
+        """element -> (element, count)."""
+
+    @abc.abstractmethod
+    def sum_per_key(self, col, stage_name: str):
+        pass
+
+    @abc.abstractmethod
+    def combine_accumulators_per_key(self, col,
+                                     combiner: 'dp_combiners.Combiner',
+                                     stage_name: str):
+        """Merges all accumulators per key with combiner.merge_accumulators."""
+
+    @abc.abstractmethod
+    def reduce_per_key(self, col, fn: Callable, stage_name: str):
+        """Reduces values per key with an associative commutative fn."""
+
+    @abc.abstractmethod
+    def flatten(self, cols: Iterable, stage_name: str):
+        """Union of several collections."""
+
+    @abc.abstractmethod
+    def distinct(self, col, stage_name: str):
+        pass
+
+    @abc.abstractmethod
+    def to_list(self, col, stage_name: str):
+        """1-element collection holding the list of all elements."""
+
+    def annotate(self, col, stage_name: str, **kwargs):
+        """Applies all registered annotators (no-op by default)."""
+        return col
+
+
+class UniqueLabelsGenerator:
+    """Generates unique stage labels (needed by Beam transform naming)."""
+
+    def __init__(self, suffix):
+        self._labels = set()
+        self._suffix = ("_" + suffix) if suffix else ""
+
+    def _add_if_unique(self, label):
+        if label in self._labels:
+            return False
+        self._labels.add(label)
+        return True
+
+    def unique(self, label):
+        if not label:
+            label = "UNDEFINED_STAGE_NAME"
+        suffix_label = label + self._suffix
+        if self._add_if_unique(suffix_label):
+            return suffix_label
+        for i in itertools.count(1):
+            label_candidate = f"{label}_{i}{self._suffix}"
+            if self._add_if_unique(label_candidate):
+                return label_candidate
+
+
+class LocalBackend(PipelineBackend):
+    """Lazy single-machine backend over Python generators.
+
+    Ground-truth semantics for every other backend (reference :477-583).
+    """
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = random.Random(seed)
+
+    def to_multi_transformable_collection(self, col):
+        return list(col)
+
+    def map(self, col, fn, stage_name: str = None):
+        return (fn(x) for x in col)
+
+    def map_with_side_inputs(self, col, fn, side_input_cols, stage_name=None):
+        side_inputs = [list(s) for s in side_input_cols]
+
+        def gen():
+            for x in col:
+                yield fn(x, *side_inputs)
+
+        return gen()
+
+    def flat_map(self, col, fn, stage_name: str = None):
+        return (x for el in col for x in fn(el))
+
+    def flat_map_with_side_inputs(self, col, fn, side_input_cols,
+                                  stage_name=None):
+        side_inputs = [list(s) for s in side_input_cols]
+
+        def gen():
+            for el in col:
+                yield from fn(el, *side_inputs)
+
+        return gen()
+
+    def map_tuple(self, col, fn, stage_name: str = None):
+        return (fn(*x) for x in col)
+
+    def map_values(self, col, fn, stage_name: str = None):
+        return ((k, fn(v)) for k, v in col)
+
+    def group_by_key(self, col, stage_name: str = None):
+
+        def gen():
+            d = collections.defaultdict(list)
+            for key, value in col:
+                d[key].append(value)
+            yield from d.items()
+
+        return gen()
+
+    def filter(self, col, fn, stage_name: str = None):
+        return (x for x in col if fn(x))
+
+    def filter_by_key(self, col, keys_to_keep, stage_name: str = None):
+
+        def gen():
+            keys = keys_to_keep if isinstance(keys_to_keep,
+                                              (set, frozenset, dict)) else set(
+                                                  keys_to_keep)
+            for key, value in col:
+                if key in keys:
+                    yield key, value
+
+        return gen()
+
+    def keys(self, col, stage_name: str = None):
+        return (k for k, _ in col)
+
+    def values(self, col, stage_name: str = None):
+        return (v for _, v in col)
+
+    def sample_fixed_per_key(self, col, n: int, stage_name: str = None):
+
+        def gen():
+            for key, values in self.group_by_key(col):
+                if len(values) > n:
+                    values = self._rng.sample(values, n)
+                yield key, values
+
+        return gen()
+
+    def count_per_element(self, col, stage_name: str = None):
+
+        def gen():
+            yield from collections.Counter(col).items()
+
+        return gen()
+
+    def sum_per_key(self, col, stage_name: str = None):
+        return self.reduce_per_key(col, operator.add, stage_name)
+
+    def combine_accumulators_per_key(self, col,
+                                     combiner: 'dp_combiners.Combiner',
+                                     stage_name: str = None):
+        return self.reduce_per_key(col, combiner.merge_accumulators, stage_name)
+
+    def reduce_per_key(self, col, fn: Callable, stage_name: str = None):
+
+        def gen():
+            d = {}
+            for key, value in col:
+                d[key] = fn(d[key], value) if key in d else value
+            yield from d.items()
+
+        return gen()
+
+    def flatten(self, cols, stage_name: str = None):
+        return itertools.chain(*cols)
+
+    def distinct(self, col, stage_name: str = None):
+
+        def gen():
+            yield from set(col)
+
+        return gen()
+
+    def to_list(self, col, stage_name: str = None):
+        return iter([list(col)])
+
+    def annotate(self, col, stage_name: str, **kwargs):
+        for annotator in _annotators:
+            col = annotator.annotate(col, self, stage_name, **kwargs)
+        return col
+
+
+class TPUBackend(LocalBackend):
+    """Columnar JAX/XLA backend.
+
+    DPEngine detects this backend and lowers aggregate()/select_partitions()
+    to the fused columnar executor (executor.py / parallel/sharded.py): one
+    jit-compiled program doing contribution bounding + per-partition combine +
+    partition selection + noise on device.
+
+    The generic op vocabulary is inherited from LocalBackend so that
+    non-fused framework utilities (dataset histograms, analysis glue,
+    explain-report plumbing) keep working with this backend too.
+
+    Args:
+        mesh: optional jax.sharding.Mesh (1-D, axis "shards", see
+            parallel/mesh.make_mesh). When set, rows are sharded by privacy
+            id across the mesh and partials combined with lax.psum
+            (parallel/sharded.py). When None, single-device jit.
+        max_partitions: optional static result width. When set, the kernel
+            compiles for this many partitions regardless of how many appear
+            in the data — reuse it across datasets to avoid recompiles.
+        noise_seed: base seed for the on-device counter-based RNG. None ->
+            fresh nondeterministic seed per aggregation.
+    """
+
+    def __init__(self,
+                 mesh=None,
+                 max_partitions: Optional[int] = None,
+                 noise_seed: Optional[int] = None):
+        super().__init__(seed=noise_seed)
+        self.mesh = mesh
+        self.max_partitions = max_partitions
+        self.noise_seed = noise_seed
+
+    @property
+    def is_tpu(self) -> bool:
+        return True
+
+
+# Lambdas cannot be pickled for Pool.map; with the fork start method the
+# function is instead inherited by workers through a module-global set by the
+# pool initializer (the reference uses the same workaround,
+# pipeline_backend.py:586-598).
+_pool_current_func = None
+
+
+def _pool_worker_init(func):
+    global _pool_current_func
+    _pool_current_func = func
+
+
+def _pool_worker(row):
+    return _pool_current_func(row)
+
+
+def _pool_worker_flat(row):
+    # flat_map fns may return generators, which can't be pickled back to the
+    # driver — materialize in the worker.
+    return list(_pool_current_func(row))
+
+
+class MultiProcLocalBackend(PipelineBackend):
+    """Multiprocessing backend: elementwise stages fan out over a Pool.
+
+    Stages materialize their input (no laziness); keyed ops run on the driver.
+    Experimental — mirrors the reference's experimental status
+    (pipeline_backend.py:586-823).
+    """
+
+    def __init__(self, n_jobs: Optional[int] = None):
+        import multiprocessing as mp
+        self._mp = mp
+        self._n_jobs = n_jobs or mp.cpu_count()
+        self._local = LocalBackend()
+
+    def _pool_map(self, fn, data):
+        with self._mp.Pool(self._n_jobs,
+                           initializer=_pool_worker_init,
+                           initargs=(fn,)) as pool:
+            return pool.map(_pool_worker, data)
+
+    def map(self, col, fn, stage_name: str = None):
+        # Lazy: the pool fan-out happens on first iteration, preserving the
+        # two-phase budget protocol (results materialized only after
+        # compute_budgets()).
+        def gen():
+            yield from self._pool_map(fn, list(col))
+
+        return gen()
+
+    def map_with_side_inputs(self, col, fn, side_input_cols, stage_name=None):
+        return self._local.map_with_side_inputs(col, fn, side_input_cols)
+
+    def flat_map(self, col, fn, stage_name: str = None):
+
+        def gen():
+            with self._mp.Pool(self._n_jobs,
+                               initializer=_pool_worker_init,
+                               initargs=(fn,)) as pool:
+                batches = pool.map(_pool_worker_flat, list(col))
+            for batch in batches:
+                yield from batch
+
+        return gen()
+
+    def map_tuple(self, col, fn, stage_name: str = None):
+        return (fn(*x) for x in col)
+
+    def map_values(self, col, fn, stage_name: str = None):
+        return self._local.map_values(col, fn)
+
+    def group_by_key(self, col, stage_name: str = None):
+        return self._local.group_by_key(col)
+
+    def filter(self, col, fn, stage_name: str = None):
+        return self._local.filter(col, fn)
+
+    def filter_by_key(self, col, keys_to_keep, stage_name: str = None):
+        return self._local.filter_by_key(col, keys_to_keep)
+
+    def keys(self, col, stage_name: str = None):
+        return self._local.keys(col)
+
+    def values(self, col, stage_name: str = None):
+        return self._local.values(col)
+
+    def sample_fixed_per_key(self, col, n: int, stage_name: str = None):
+        return self._local.sample_fixed_per_key(col, n)
+
+    def count_per_element(self, col, stage_name: str = None):
+        return self._local.count_per_element(col)
+
+    def sum_per_key(self, col, stage_name: str = None):
+        return self._local.sum_per_key(col)
+
+    def combine_accumulators_per_key(self, col, combiner, stage_name=None):
+        return self._local.combine_accumulators_per_key(col, combiner)
+
+    def reduce_per_key(self, col, fn, stage_name: str = None):
+        return self._local.reduce_per_key(col, fn)
+
+    def flatten(self, cols, stage_name: str = None):
+        return itertools.chain(*cols)
+
+    def distinct(self, col, stage_name: str = None):
+        return self._local.distinct(col)
+
+    def to_list(self, col, stage_name: str = None):
+        return iter([list(col)])
+
+    def annotate(self, col, stage_name: str, **kwargs):
+        return self._local.annotate(col, stage_name, **kwargs)
+
+
+if beam is not None:
+
+    class BeamBackend(PipelineBackend):
+        """Apache Beam adapter (optional dependency, reference :223-374)."""
+
+        def __init__(self, suffix: str = ""):
+            self._ulg = UniqueLabelsGenerator(suffix)
+
+        @property
+        def unique_lable_generator(self):  # reference-compatible name
+            return self._ulg
+
+        def to_collection(self, collection_or_iterable, col, stage_name):
+            if isinstance(collection_or_iterable, beam.PCollection):
+                return collection_or_iterable
+            return col.pipeline | self._ulg.unique(stage_name) >> beam.Create(
+                collection_or_iterable)
+
+        def map(self, col, fn, stage_name):
+            return col | self._ulg.unique(stage_name) >> beam.Map(fn)
+
+        def map_with_side_inputs(self, col, fn, side_input_cols, stage_name):
+            side_inputs = [
+                beam.pvalue.AsList(side) for side in side_input_cols
+            ]
+            return col | self._ulg.unique(stage_name) >> beam.Map(
+                fn, *side_inputs)
+
+        def flat_map(self, col, fn, stage_name):
+            return col | self._ulg.unique(stage_name) >> beam.FlatMap(fn)
+
+        def flat_map_with_side_inputs(self, col, fn, side_input_cols,
+                                      stage_name):
+            side_inputs = [
+                beam.pvalue.AsList(side) for side in side_input_cols
+            ]
+            return col | self._ulg.unique(stage_name) >> beam.FlatMap(
+                fn, *side_inputs)
+
+        def map_tuple(self, col, fn, stage_name):
+            return col | self._ulg.unique(stage_name) >> beam.Map(
+                lambda x: fn(*x))
+
+        def map_values(self, col, fn, stage_name):
+            return col | self._ulg.unique(stage_name) >> beam.MapTuple(
+                lambda k, v: (k, fn(v)))
+
+        def group_by_key(self, col, stage_name):
+            return col | self._ulg.unique(stage_name) >> beam.GroupByKey()
+
+        def filter(self, col, fn, stage_name):
+            return col | self._ulg.unique(stage_name) >> beam.Filter(fn)
+
+        def filter_by_key(self, col, keys_to_keep, stage_name):
+
+            class PartitionsFilterJoin(beam.DoFn):
+
+                def process(self, joined_data):
+                    key, rest = joined_data
+                    values, to_keep = rest.get(VALUES), rest.get(TO_KEEP)
+                    if not values:
+                        return
+                    if to_keep:
+                        for value in values:
+                            yield key, value
+
+            VALUES, TO_KEEP = 0, 1
+            if isinstance(keys_to_keep, (list, set)):
+                keys_to_keep_pcol = col.pipeline | self._ulg.unique(
+                    "keys_to_keep") >> beam.Create(keys_to_keep)
+            else:
+                keys_to_keep_pcol = keys_to_keep
+            keys_to_keep_kv = keys_to_keep_pcol | self._ulg.unique(
+                "key_by") >> beam.Map(lambda k: (k, True))
+            return ({
+                VALUES: col,
+                TO_KEEP: keys_to_keep_kv
+            } | self._ulg.unique(stage_name) >> beam.CoGroupByKey() |
+                    self._ulg.unique("Filter join") >> beam.ParDo(
+                        PartitionsFilterJoin()))
+
+        def keys(self, col, stage_name):
+            return col | self._ulg.unique(stage_name) >> beam.Keys()
+
+        def values(self, col, stage_name):
+            return col | self._ulg.unique(stage_name) >> beam.Values()
+
+        def sample_fixed_per_key(self, col, n, stage_name):
+            return col | self._ulg.unique(
+                stage_name) >> beam.combiners.Sample.FixedSizePerKey(n)
+
+        def count_per_element(self, col, stage_name):
+            return col | self._ulg.unique(
+                stage_name) >> beam.combiners.Count.PerElement()
+
+        def sum_per_key(self, col, stage_name):
+            return col | self._ulg.unique(stage_name) >> beam.CombinePerKey(sum)
+
+        def combine_accumulators_per_key(self, col, combiner, stage_name):
+
+            def merge_accumulators(accumulators):
+                return functools.reduce(combiner.merge_accumulators,
+                                        accumulators)
+
+            return col | self._ulg.unique(stage_name) >> beam.CombinePerKey(
+                merge_accumulators)
+
+        def reduce_per_key(self, col, fn, stage_name):
+            return col | self._ulg.unique(stage_name) >> beam.CombinePerKey(
+                lambda values: functools.reduce(fn, values))
+
+        def flatten(self, cols, stage_name):
+            return tuple(cols) | self._ulg.unique(stage_name) >> beam.Flatten()
+
+        def distinct(self, col, stage_name):
+            return col | self._ulg.unique(stage_name) >> beam.Distinct()
+
+        def to_list(self, col, stage_name):
+            return col | self._ulg.unique(stage_name) >> beam.combiners.ToList()
+
+        def annotate(self, col, stage_name, **kwargs):
+            for annotator in _annotators:
+                col = annotator.annotate(col, self,
+                                         self._ulg.unique(stage_name), **kwargs)
+            return col
+
+
+if pyspark is not None:
+
+    class SparkRDDBackend(PipelineBackend):
+        """PySpark RDD adapter (optional dependency, reference :377-474)."""
+
+        def __init__(self, sc: 'pyspark.SparkContext'):
+            self._sc = sc
+
+        def to_collection(self, collection_or_iterable, col, stage_name):
+            if isinstance(collection_or_iterable, pyspark.RDD):
+                return collection_or_iterable
+            return self._sc.parallelize(collection_or_iterable)
+
+        def map(self, col, fn, stage_name=None):
+            return col.map(fn)
+
+        def map_with_side_inputs(self, col, fn, side_input_cols, stage_name):
+            raise NotImplementedError(
+                "map_with_side_inputs is not implemented for SparkRDDBackend.")
+
+        def flat_map(self, col, fn, stage_name=None):
+            return col.flatMap(fn)
+
+        def map_tuple(self, col, fn, stage_name=None):
+            return col.map(lambda x: fn(*x))
+
+        def map_values(self, col, fn, stage_name=None):
+            return col.mapValues(fn)
+
+        def group_by_key(self, col, stage_name=None):
+            return col.groupByKey()
+
+        def filter(self, col, fn, stage_name=None):
+            return col.filter(fn)
+
+        def filter_by_key(self, col, keys_to_keep, stage_name=None):
+            if isinstance(keys_to_keep, pyspark.RDD):
+                filtering_rdd = keys_to_keep.map(lambda x: (x, None))
+                return col.join(filtering_rdd).map(lambda x: (x[0], x[1][0]))
+            keys = set(keys_to_keep)
+            return col.filter(lambda x: x[0] in keys)
+
+        def keys(self, col, stage_name=None):
+            return col.keys()
+
+        def values(self, col, stage_name=None):
+            return col.values()
+
+        def sample_fixed_per_key(self, col, n, stage_name=None):
+            # Uniformity caveat matches the reference (:446-449).
+            return col.groupByKey().mapValues(
+                lambda vals: random.sample(list(vals), min(n, len(list(vals)))))
+
+        def count_per_element(self, col, stage_name=None):
+            return col.map(lambda x: (x, 1)).reduceByKey(operator.add)
+
+        def sum_per_key(self, col, stage_name=None):
+            return col.reduceByKey(operator.add)
+
+        def combine_accumulators_per_key(self, col, combiner, stage_name=None):
+            return col.reduceByKey(combiner.merge_accumulators)
+
+        def reduce_per_key(self, col, fn, stage_name=None):
+            return col.reduceByKey(fn)
+
+        def flatten(self, cols, stage_name=None):
+            return self._sc.union(list(cols))
+
+        def distinct(self, col, stage_name=None):
+            return col.distinct()
+
+        def to_list(self, col, stage_name=None):
+            raise NotImplementedError(
+                "to_list is not implemented for SparkRDDBackend.")
+
+
+class Annotator(abc.ABC):
+    """User hook attaching metadata (budget, params) to collections."""
+
+    @abc.abstractmethod
+    def annotate(self, col, backend: PipelineBackend, stage_name: str,
+                 **kwargs):
+        """Returns `col` annotated with metadata from kwargs."""
+
+
+_annotators: List[Annotator] = []
+
+
+def register_annotator(annotator: Annotator):
+    _annotators.append(annotator)

@@ -1,0 +1,68 @@
+"""Backend-generic composite pipeline functions.
+
+Reference parity: pipeline_dp/pipeline_functions.py:23-109.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, Type
+
+from pipelinedp_tpu import pipeline_backend
+
+
+def key_by(backend: pipeline_backend.PipelineBackend, col,
+           key_extractor: Callable, stage_name: str):
+    """element -> (key_extractor(element), element)."""
+    return backend.map(col, lambda el: (key_extractor(el), el),
+                       f"{stage_name}: key by")
+
+
+def size(backend: pipeline_backend.PipelineBackend, col, stage_name: str):
+    """Returns a 1-element collection with the number of elements."""
+    col = backend.map(col, lambda x: "fake_common_key",
+                      f"{stage_name}: mapped to common key")
+    col = backend.count_per_element(col, f"{stage_name}: counted elements")
+    return backend.values(col, f"{stage_name}: extracted counts")
+
+
+def collect_to_container(backend: pipeline_backend.PipelineBackend,
+                         cols: Dict[str, Any], container_class: Type,
+                         stage_name: str):
+    """Collects several 1-element collections into one container dataclass.
+
+    Args:
+        cols: {field_name: 1-element collection}; field names must match
+            container_class's dataclass fields.
+        container_class: dataclass to construct.
+    """
+    field_names = list(cols.keys())
+    flattened = backend.flatten(
+        [
+            backend.map(col, lambda x, name=name: (name, x),
+                        f"{stage_name}: key {name} by field name")
+            for name, col in cols.items()
+        ],
+        f"{stage_name}: flatten fields",
+    )
+    grouped = backend.to_list(flattened, f"{stage_name}: collect fields")
+
+    def construct(kv_pairs):
+        kwargs = dict(kv_pairs)
+        missing = set(field_names) - set(kwargs)
+        if missing:
+            raise ValueError(f"missing fields {missing} for "
+                             f"{container_class.__name__}")
+        return container_class(**kwargs)
+
+    return backend.map(grouped, construct,
+                       f"{stage_name}: construct container")
+
+
+def min_max_elements(backend: pipeline_backend.PipelineBackend, col,
+                     stage_name: str):
+    """Returns a 1-element collection ((min, max)) of the input collection."""
+    col = backend.map(col, lambda x: (None, (x, x)),
+                      f"{stage_name}: to (min, max)")
+    col = backend.reduce_per_key(
+        col, lambda a, b: (min(a[0], b[0]), max(a[1], b[1])),
+        f"{stage_name}: reduce to (min, max)")
+    return backend.values(col, f"{stage_name}: drop key")

@@ -1,14 +1,24 @@
 """Test configuration: run everything on a virtual 8-device CPU mesh.
 
 Multi-chip TPU hardware is not available in CI; sharding correctness is
-validated on XLA's host-platform virtual devices. Must run before jax import.
+validated on XLA's host-platform virtual devices.
+
+Note: the environment may pre-register an external TPU platform plugin and
+force jax_platforms to it via sitecustomize (overriding the JAX_PLATFORMS
+env var), so the config must be reset *programmatically* after importing
+jax — before any backend is initialized.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

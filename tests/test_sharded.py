@@ -1,0 +1,156 @@
+"""Multi-chip sharding tests on the 8-device virtual CPU mesh."""
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.parallel import make_mesh, shard_rows_by_pid
+
+HUGE_EPS = 1e7
+
+ROWS = [("u%d" % (i % 50), "pk%d" % (i % 7), float(i % 5))
+        for i in range(1000)]
+
+EXTRACTORS = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                partition_extractor=lambda r: r[1],
+                                value_extractor=lambda r: r[2])
+
+
+def _aggregate(backend, rows, params, public=None, eps=HUGE_EPS):
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=eps,
+                                           total_delta=1e-5)
+    engine = pdp.DPEngine(accountant, backend)
+    result = engine.aggregate(rows, params, EXTRACTORS, public)
+    accountant.compute_budgets()
+    return dict(result)
+
+
+class TestShardRows:
+
+    def test_shard_rows_by_pid_partitions_and_pads(self):
+        pid = np.arange(100, dtype=np.int32)
+        pk = np.zeros(100, dtype=np.int32)
+        values = np.ones(100)
+        valid = np.ones(100, dtype=bool)
+        spid, spk, svalues, svalid = shard_rows_by_pid(
+            pid, pk, values, valid, 8)
+        assert len(spid) % 8 == 0
+        per_shard = len(spid) // 8
+        for s in range(8):
+            block_pid = spid[s * per_shard:(s + 1) * per_shard]
+            block_valid = svalid[s * per_shard:(s + 1) * per_shard]
+            assert np.all(block_pid[block_valid] % 8 == s)
+        assert svalid.sum() == 100
+        assert svalues[svalid].sum() == 100
+
+    def test_all_rows_one_pid(self):
+        pid = np.zeros(10, dtype=np.int32)
+        spid, spk, sval, svalid = shard_rows_by_pid(pid, pid, pid.astype(
+            float), np.ones(10, bool), 4)
+        assert svalid.sum() == 10
+
+
+class TestShardedEngineParity:
+
+    @pytest.mark.parametrize("n_devices", [1, 4, 8])
+    def test_count_sum_matches_local(self, n_devices):
+        mesh = make_mesh(n_devices=n_devices)
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM,
+                     pdp.Metrics.PRIVACY_ID_COUNT],
+            max_partitions_contributed=7,
+            max_contributions_per_partition=30,
+            min_value=0.0,
+            max_value=5.0)
+        public = ["pk%d" % i for i in range(7)]
+        expected = _aggregate(pdp.LocalBackend(seed=0), ROWS, params, public)
+        actual = _aggregate(pdp.TPUBackend(mesh=mesh, noise_seed=0), ROWS,
+                            params, public)
+        assert set(actual) == set(expected)
+        for pk in expected:
+            assert actual[pk].count == pytest.approx(expected[pk].count,
+                                                     abs=0.05)
+            assert actual[pk].sum == pytest.approx(expected[pk].sum, abs=0.05)
+            assert actual[pk].privacy_id_count == pytest.approx(
+                expected[pk].privacy_id_count, abs=0.05)
+
+    def test_private_selection_sharded(self):
+        mesh = make_mesh(n_devices=8)
+        rows = [(f"u{i}", "big", 1.0) for i in range(2000)]
+        rows += [("solo", "tiny", 1.0)]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        result = _aggregate(pdp.TPUBackend(mesh=mesh, noise_seed=1), rows,
+                            params)
+        assert "big" in result
+        assert "tiny" not in result
+        assert result["big"].count == pytest.approx(2000, abs=0.1)
+
+    def test_l0_bounding_across_shards(self):
+        # One privacy id with rows in many partitions: bounding must treat
+        # them globally (all rows co-located on one shard).
+        mesh = make_mesh(n_devices=8)
+        rows = [("hot_user", f"pk{i}", 1.0) for i in range(16)]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=4,
+                                     max_contributions_per_partition=1)
+        public = [f"pk{i}" for i in range(16)]
+        result = _aggregate(pdp.TPUBackend(mesh=mesh, noise_seed=2), rows,
+                            params, public)
+        total = sum(result[pk].count for pk in public)
+        assert total == pytest.approx(4, abs=0.05)
+
+    def test_mean_sharded(self):
+        mesh = make_mesh(n_devices=4)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.MEAN],
+                                     max_partitions_contributed=7,
+                                     max_contributions_per_partition=30,
+                                     min_value=0.0,
+                                     max_value=5.0)
+        public = ["pk%d" % i for i in range(7)]
+        expected = _aggregate(pdp.LocalBackend(seed=0), ROWS, params, public)
+        actual = _aggregate(pdp.TPUBackend(mesh=mesh, noise_seed=3), ROWS,
+                            params, public)
+        for pk in expected:
+            assert actual[pk].mean == pytest.approx(expected[pk].mean,
+                                                    abs=0.01)
+
+
+class TestMultiProcBackend:
+
+    def test_engine_e2e_on_multiproc(self):
+        backend = pdp.MultiProcLocalBackend(n_jobs=2)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=2,
+                                     max_contributions_per_partition=2)
+        rows = [("u1", "A", 1.0), ("u2", "A", 1.0), ("u1", "B", 1.0)]
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-5)
+        engine = pdp.DPEngine(accountant, backend)
+        result = engine.aggregate(rows, params, EXTRACTORS, ["A", "B"])
+        accountant.compute_budgets()
+        result = dict(result)
+        assert result["A"].count == pytest.approx(2, abs=0.01)
+        assert result["B"].count == pytest.approx(1, abs=0.01)
+
+
+class TestMaxPartitionsKnob:
+
+    def test_max_partitions_pads_and_decodes(self):
+        backend = pdp.TPUBackend(max_partitions=64, noise_seed=0)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=2,
+                                     max_contributions_per_partition=2)
+        rows = [("u1", "A", 1.0), ("u2", "B", 1.0)]
+        result = _aggregate(backend, rows, params, ["A", "B"])
+        assert set(result) == {"A", "B"}
+
+    def test_max_partitions_too_small_raises(self):
+        backend = pdp.TPUBackend(max_partitions=1, noise_seed=0)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=2,
+                                     max_contributions_per_partition=2)
+        rows = [("u1", "A", 1.0), ("u2", "B", 1.0)]
+        with pytest.raises(ValueError, match="max_partitions"):
+            _aggregate(backend, rows, params, ["A", "B"])
