@@ -1,0 +1,12 @@
+"""Dataset histograms: contribution-distribution statistics for tuning.
+
+Capability parity with the reference package
+``pipeline_dp/dataset_histograms/`` (histograms.py, computing_histograms.py,
+histogram_error_estimator.py), re-designed for columnar/vectorized
+computation: binning is a numpy ufunc over whole columns instead of a
+per-element lambda chain.
+"""
+
+from pipelinedp_tpu.dataset_histograms import histograms
+from pipelinedp_tpu.dataset_histograms import computing_histograms
+from pipelinedp_tpu.dataset_histograms import histogram_error_estimator

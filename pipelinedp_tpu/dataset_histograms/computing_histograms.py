@@ -1,0 +1,413 @@
+"""Computing dataset histograms.
+
+Capability parity with the reference ``pipeline_dp/dataset_histograms/
+computing_histograms.py`` (log binning ``:28-47``, frequency histograms
+``:62-195``, raw-dataset histograms ``:236-474``, pre-aggregated variants
+``:477-684``), re-designed vectorized: the per-element binning lambda chain
+of the reference is replaced by numpy ufuncs over whole frequency columns,
+and there is an additional pure-columnar entry point
+(:func:`compute_dataset_histograms_columnar`) that computes all six
+histograms from ``(pid, pk, value)`` arrays in a handful of ``np.unique`` /
+``bincount`` passes — the shape the TPU ingest path already has.
+"""
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pipelinedp_tpu import data_extractors as extractors
+from pipelinedp_tpu import pipeline_backend, pipeline_functions
+from pipelinedp_tpu.dataset_histograms import histograms as hist
+
+NUMBER_OF_BUCKETS_IN_LINF_SUM_CONTRIBUTIONS_HISTOGRAM = 10000
+
+
+def _to_bin_lower_upper_logarithmic(value: int) -> Tuple[int, int]:
+    """Log-ish binning keeping 3 leading digits (reference ``:28-47``).
+
+    123 -> [123,124), 1234 -> [1230,1240), 12345 -> [12300,12400); exact
+    powers-of-10 boundary values get a bin of the next width. Keep in sync
+    with private_contribution_bounds.generate_possible_contribution_bounds.
+    """
+    bound = 1000
+    while value > bound:
+        bound *= 10
+    round_base = bound // 1000
+    lower = value // round_base * round_base
+    bin_size = round_base if value != bound else round_base * 10
+    return lower, lower + bin_size
+
+
+def _bin_lowers_log_vectorized(
+        values: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized _to_bin_lower_upper_logarithmic over an int array."""
+    values = np.asarray(values, dtype=np.int64)
+    # bound = smallest power-of-10 multiple of 1000 that is >= value
+    # i.e. bound = 1000 * 10^max(0, ceil(log10(value/1000)))
+    safe = np.maximum(values, 1).astype(np.float64)
+    exp = np.ceil(np.log10(safe / 1000.0))
+    exp = np.maximum(exp, 0).astype(np.int64)
+    bound = 1000 * np.power(10, exp)
+    # float log10 can land one decade off at exact boundaries; correct it.
+    bound = np.where(bound < values, bound * 10, bound)
+    bound_down = bound // 10
+    bound = np.where((bound_down >= 1000) & (bound_down >= values),
+                     bound_down, bound)
+    round_base = bound // 1000
+    lower = values // round_base * round_base
+    bin_size = np.where(values != bound, round_base, round_base * 10)
+    return lower, lower + bin_size
+
+
+def _frequencies_to_histogram(values: np.ndarray,
+                              frequencies: np.ndarray,
+                              name: hist.HistogramType) -> hist.Histogram:
+    """Builds a log-binned integer Histogram from (value, frequency) columns.
+
+    Vectorized equivalent of the reference's map→reduce_per_key chain
+    (``computing_histograms.py:105-195``).
+    """
+    values = np.asarray(values, dtype=np.int64)
+    frequencies = np.asarray(frequencies, dtype=np.int64)
+    if values.size == 0:
+        return hist.Histogram(name, [])
+    lowers, uppers = _bin_lowers_log_vectorized(values)
+    uniq_lowers, inverse = np.unique(lowers, return_inverse=True)
+    counts = np.bincount(inverse, weights=frequencies)
+    sums = np.bincount(inverse, weights=frequencies * values)
+    # per-bin max of values and the bin upper
+    maxes = np.zeros(uniq_lowers.size, dtype=np.int64)
+    np.maximum.at(maxes, inverse, values)
+    bin_uppers = np.zeros(uniq_lowers.size, dtype=np.int64)
+    np.maximum.at(bin_uppers, inverse, uppers)
+    bins = [
+        hist.FrequencyBin(lower=int(l), upper=int(u), count=int(c),
+                          sum=int(s), max=int(m))
+        for l, u, c, s, m in zip(uniq_lowers, bin_uppers, counts, sums, maxes)
+    ]
+    return hist.Histogram(name, bins)
+
+
+def _float_values_to_histogram(values: np.ndarray,
+                               name: hist.HistogramType,
+                               number_of_buckets: int = None
+                               ) -> hist.Histogram:
+    """Equal-width float histogram between min and max (reference ``:314-362``)."""
+    if number_of_buckets is None:
+        number_of_buckets = (
+            NUMBER_OF_BUCKETS_IN_LINF_SUM_CONTRIBUTIONS_HISTOGRAM)
+    values = np.asarray(values, dtype=np.float64)
+    if values.size == 0:
+        return hist.Histogram(name, [])
+    lo, hi = float(values.min()), float(values.max())
+    lowers = np.linspace(lo, hi, number_of_buckets + 1)
+    idx = np.searchsorted(lowers, values, side='right') - 1
+    idx = np.clip(idx, 0, number_of_buckets - 1)
+    uniq_idx, inverse = np.unique(idx, return_inverse=True)
+    counts = np.bincount(inverse)
+    sums = np.bincount(inverse, weights=values)
+    maxes = np.full(uniq_idx.size, -np.inf)
+    np.maximum.at(maxes, inverse, values)
+    bins = [
+        hist.FrequencyBin(lower=float(lowers[i]), upper=float(lowers[i + 1]),
+                          count=int(c), sum=float(s), max=float(m))
+        for i, c, s, m in zip(uniq_idx, counts, sums, maxes)
+    ]
+    return hist.Histogram(name, bins)
+
+
+def _compute_frequency_histogram(col,
+                                 backend: pipeline_backend.PipelineBackend,
+                                 name: hist.HistogramType):
+    """Histogram of element frequencies (collection of positive ints).
+
+    Returns a 1-element collection with hist.Histogram. The count-per-element
+    shuffle stays a backend op; binning happens vectorized on the collected
+    (value, frequency) columns.
+    """
+    col = backend.count_per_element(col, "Frequency of elements")
+    col = backend.to_list(col, "To 1 element collection")
+
+    def build(value_freq_pairs):
+        if not value_freq_pairs:
+            return hist.Histogram(name, [])
+        values, freqs = zip(*value_freq_pairs)
+        return _frequencies_to_histogram(np.array(values), np.array(freqs),
+                                         name)
+
+    return backend.map(col, build, "To histogram")
+
+
+def _compute_weighted_frequency_histogram(
+        col, backend: pipeline_backend.PipelineBackend,
+        name: hist.HistogramType):
+    """Histogram from (value:int, weight:float) pairs (reference ``:81-102``)."""
+    col = backend.sum_per_key(col, "Frequency of elements")
+    col = backend.to_list(col, "To 1 element collection")
+
+    def build(value_weight_pairs):
+        if not value_weight_pairs:
+            return hist.Histogram(name, [])
+        values, weights = zip(*value_weight_pairs)
+        freqs = np.rint(np.array(weights)).astype(np.int64)
+        return _frequencies_to_histogram(np.array(values), freqs, name)
+
+    return backend.map(col, build, "To histogram")
+
+
+def _compute_float_histogram(col, backend: pipeline_backend.PipelineBackend,
+                             name: hist.HistogramType):
+    """Equal-width histogram of a collection of floats (reference ``:135-173``)."""
+    col = backend.to_list(col, "To 1 element collection")
+    return backend.map(col, lambda vals: _float_values_to_histogram(
+        np.array(vals, dtype=np.float64), name), "To histogram")
+
+
+def _list_to_contribution_histograms(
+        histograms: List[hist.Histogram]) -> hist.DatasetHistograms:
+    """Packs a list of named histograms into DatasetHistograms (ref ``:198-220``)."""
+    by_type = {h.name: h for h in histograms}
+    return hist.DatasetHistograms(
+        by_type.get(hist.HistogramType.L0_CONTRIBUTIONS),
+        by_type.get(hist.HistogramType.L1_CONTRIBUTIONS),
+        by_type.get(hist.HistogramType.LINF_CONTRIBUTIONS),
+        by_type.get(hist.HistogramType.LINF_SUM_CONTRIBUTIONS),
+        by_type.get(hist.HistogramType.COUNT_PER_PARTITION),
+        by_type.get(hist.HistogramType.COUNT_PRIVACY_ID_PER_PARTITION),
+    )
+
+
+def _to_dataset_histograms(histogram_list,
+                           backend: pipeline_backend.PipelineBackend):
+    """Combines 1-element histogram collections into DatasetHistograms."""
+    col = backend.flatten(histogram_list, "Histograms to one collection")
+    col = backend.to_list(col, "Histograms to List")
+    return backend.map(col, _list_to_contribution_histograms,
+                       "To DatasetHistograms")
+
+
+############## Raw datasets ##################################################
+
+
+def _compute_l0_contributions_histogram(
+        col, backend: pipeline_backend.PipelineBackend):
+    """#distinct partitions per privacy id (col: distinct (pid, pk))."""
+    col = backend.keys(col, "Drop partition id")
+    col = backend.count_per_element(col, "Compute partitions per privacy id")
+    col = backend.values(col, "Drop privacy id")
+    return _compute_frequency_histogram(col, backend,
+                                        hist.HistogramType.L0_CONTRIBUTIONS)
+
+
+def _compute_l1_contributions_histogram(
+        col, backend: pipeline_backend.PipelineBackend):
+    """#records per privacy id (col: (pid, pk) with duplicates)."""
+    col = backend.keys(col, "Drop partition id")
+    col = backend.count_per_element(col, "Compute records per privacy id")
+    col = backend.values(col, "Drop privacy id")
+    return _compute_frequency_histogram(col, backend,
+                                        hist.HistogramType.L1_CONTRIBUTIONS)
+
+
+def _compute_linf_contributions_histogram(
+        col, backend: pipeline_backend.PipelineBackend):
+    """#rows per (pid, pk) pair."""
+    col = backend.count_per_element(
+        col, "Contributions per (privacy_id, partition)")
+    col = backend.values(col, "Drop privacy id")
+    return _compute_frequency_histogram(col, backend,
+                                        hist.HistogramType.LINF_CONTRIBUTIONS)
+
+
+def _compute_linf_sum_contributions_histogram(
+        col, backend: pipeline_backend.PipelineBackend):
+    """Sum of values per (pid, pk) pair, equal-width float bins."""
+    col = backend.sum_per_key(
+        col, "Sum of contributions per (privacy_id, partition)")
+    col = backend.values(col, "Drop keys")
+    return _compute_float_histogram(col, backend,
+                                    hist.HistogramType.LINF_SUM_CONTRIBUTIONS)
+
+
+def _compute_partition_count_histogram(
+        col, backend: pipeline_backend.PipelineBackend):
+    """Total contribution count per partition."""
+    col = backend.values(col, "Drop privacy keys")
+    col = backend.count_per_element(col, "Count per partition")
+    col = backend.values(col, "Drop partition key")
+    return _compute_frequency_histogram(col, backend,
+                                        hist.HistogramType.COUNT_PER_PARTITION)
+
+
+def _compute_partition_privacy_id_count_histogram(
+        col, backend: pipeline_backend.PipelineBackend):
+    """#privacy ids per partition (col: distinct (pid, pk))."""
+    col = backend.values(col, "Drop privacy key")
+    col = backend.count_per_element(col, "Privacy ids per partition")
+    col = backend.values(col, "Drop partition key")
+    return _compute_frequency_histogram(
+        col, backend, hist.HistogramType.COUNT_PRIVACY_ID_PER_PARTITION)
+
+
+def compute_dataset_histograms(col,
+                               data_extractors: extractors.DataExtractors,
+                               backend: pipeline_backend.PipelineBackend):
+    """Computes all six dataset histograms (reference ``:420-474``).
+
+    Returns a 1-element collection containing DatasetHistograms.
+    """
+    col_with_values = backend.map(
+        col, lambda row: ((data_extractors.privacy_id_extractor(row),
+                           data_extractors.partition_extractor(row)),
+                          data_extractors.value_extractor(row)),
+        "Extract ((privacy_id, partition_key), value)")
+    col_with_values = backend.to_multi_transformable_collection(
+        col_with_values)
+    col = backend.keys(col_with_values, "Drop values")
+    col = backend.to_multi_transformable_collection(col)
+    col_distinct = backend.distinct(col, "Distinct (privacy_id, partition)")
+    col_distinct = backend.to_multi_transformable_collection(col_distinct)
+
+    return _to_dataset_histograms([
+        _compute_l0_contributions_histogram(col_distinct, backend),
+        _compute_l1_contributions_histogram(col, backend),
+        _compute_linf_contributions_histogram(col, backend),
+        _compute_linf_sum_contributions_histogram(col_with_values, backend),
+        _compute_partition_count_histogram(col, backend),
+        _compute_partition_privacy_id_count_histogram(col_distinct, backend),
+    ], backend)
+
+
+############## Pre-aggregated datasets #######################################
+# Pre-aggregated rows are (partition_key, (count, sum, n_partitions,
+# n_contributions)); see pre_aggregation.preaggregate.
+
+
+def _compute_l0_contributions_histogram_on_preaggregated_data(
+        col, backend: pipeline_backend.PipelineBackend):
+    col = backend.map_tuple(col, lambda _, x: (x[2], 1.0 / x[2]),
+                            "Extract n_partitions")
+    return _compute_weighted_frequency_histogram(
+        col, backend, hist.HistogramType.L0_CONTRIBUTIONS)
+
+
+def _compute_l1_contributions_histogram_on_preaggregated_data(
+        col, backend: pipeline_backend.PipelineBackend):
+    col = backend.map_tuple(col, lambda _, x: (x[3], 1.0 / x[2]),
+                            "Extract n_contributions")
+    return _compute_weighted_frequency_histogram(
+        col, backend, hist.HistogramType.L1_CONTRIBUTIONS)
+
+
+def _compute_linf_contributions_histogram_on_preaggregated_data(
+        col, backend: pipeline_backend.PipelineBackend):
+    col = backend.map_tuple(col, lambda _, x: x[0],
+                            "Extract count per partition contribution")
+    return _compute_frequency_histogram(col, backend,
+                                        hist.HistogramType.LINF_CONTRIBUTIONS)
+
+
+def _compute_linf_sum_contributions_histogram_on_preaggregated_data(
+        col, backend: pipeline_backend.PipelineBackend):
+    col = backend.map_tuple(col, lambda _, x: x[1],
+                            "Extract sum per partition contribution")
+    return _compute_float_histogram(col, backend,
+                                    hist.HistogramType.LINF_SUM_CONTRIBUTIONS)
+
+
+def _compute_partition_count_histogram_on_preaggregated_data(
+        col, backend: pipeline_backend.PipelineBackend):
+    col = backend.map_values(col, lambda x: x[0], "Extract count")
+    col = backend.sum_per_key(col, "Sum per partition")
+    col = backend.values(col, "Drop partition keys")
+    return _compute_frequency_histogram(col, backend,
+                                        hist.HistogramType.COUNT_PER_PARTITION)
+
+
+def _compute_partition_privacy_id_count_histogram_on_preaggregated_data(
+        col, backend: pipeline_backend.PipelineBackend):
+    col = backend.keys(col, "Extract partition keys")
+    col = backend.count_per_element(col, "Count privacy IDs per partition")
+    col = backend.values(col, "Drop partition keys")
+    return _compute_frequency_histogram(
+        col, backend, hist.HistogramType.COUNT_PRIVACY_ID_PER_PARTITION)
+
+
+def compute_dataset_histograms_on_preaggregated_data(
+        col, data_extractors: extractors.PreAggregateExtractors,
+        backend: pipeline_backend.PipelineBackend):
+    """All six histograms from pre-aggregated rows (reference ``:642-684``)."""
+    col = backend.map(
+        col, lambda row: (data_extractors.partition_extractor(row),
+                          data_extractors.preaggregate_extractor(row)),
+        "Extract (partition_key, preaggregate_data)")
+    col = backend.to_multi_transformable_collection(col)
+
+    return _to_dataset_histograms([
+        _compute_l0_contributions_histogram_on_preaggregated_data(
+            col, backend),
+        _compute_l1_contributions_histogram_on_preaggregated_data(
+            col, backend),
+        _compute_linf_contributions_histogram_on_preaggregated_data(
+            col, backend),
+        _compute_linf_sum_contributions_histogram_on_preaggregated_data(
+            col, backend),
+        _compute_partition_count_histogram_on_preaggregated_data(
+            col, backend),
+        _compute_partition_privacy_id_count_histogram_on_preaggregated_data(
+            col, backend),
+    ], backend)
+
+
+############## Columnar fast path ############################################
+
+
+def compute_dataset_histograms_columnar(
+        pids: np.ndarray,
+        pks: np.ndarray,
+        values: Optional[np.ndarray] = None) -> hist.DatasetHistograms:
+    """All six histograms from columnar (pid, pk, value) arrays in one pass.
+
+    TPU-first alternative to the collection pipeline: the ingestion path
+    already has integer-encoded columns (columnar.encode), so the grouped
+    counts reduce to np.unique/bincount over whole columns with no
+    per-element Python. Semantics match compute_dataset_histograms.
+    """
+    pids = np.asarray(pids)
+    pks = np.asarray(pks)
+    has_values = values is not None
+    if not has_values:
+        values = np.zeros(pids.shape[0], dtype=np.float64)
+    values = np.asarray(values, dtype=np.float64)
+
+    # group by (pid, pk): contributions count + sum per pair
+    pair_codes, pair_inverse = np.unique(
+        np.stack([pids, pks], axis=1), axis=0, return_inverse=True)
+    pair_counts = np.bincount(pair_inverse)
+    pair_sums = np.bincount(pair_inverse, weights=values)
+    pair_pids = pair_codes[:, 0]
+    pair_pks = pair_codes[:, 1]
+
+    # L0: #distinct partitions per pid
+    _, l0_per_pid = np.unique(pair_pids, return_counts=True)
+    # L1: #records per pid
+    _, l1_per_pid = np.unique(pids, return_counts=True)
+    # partition stats
+    _, count_per_pk = np.unique(pks, return_counts=True)
+    _, pid_count_per_pk = np.unique(pair_pks, return_counts=True)
+
+    def int_hist(values_, name):
+        uniq, freq = np.unique(values_, return_counts=True)
+        return _frequencies_to_histogram(uniq, freq, name)
+
+    return hist.DatasetHistograms(
+        int_hist(l0_per_pid, hist.HistogramType.L0_CONTRIBUTIONS),
+        int_hist(l1_per_pid, hist.HistogramType.L1_CONTRIBUTIONS),
+        int_hist(pair_counts, hist.HistogramType.LINF_CONTRIBUTIONS),
+        _float_values_to_histogram(
+            pair_sums, hist.HistogramType.LINF_SUM_CONTRIBUTIONS)
+        if has_values else None,
+        int_hist(count_per_pk, hist.HistogramType.COUNT_PER_PARTITION),
+        int_hist(pid_count_per_pk,
+                 hist.HistogramType.COUNT_PRIVACY_ID_PER_PARTITION),
+    )

@@ -615,16 +615,28 @@ class ExponentialMechanism:
     def __init__(self, scoring_function: 'ScoringFunction') -> None:
         self._scoring_function = scoring_function
 
-    def apply(self, eps: float, inputs_to_score_col: List[Any]) -> Any:
-        probs = self._calculate_probabilities(eps, inputs_to_score_col)
+    def apply(self,
+              eps: float,
+              inputs_to_score_col: List[Any],
+              scores: Optional[np.ndarray] = None) -> Any:
+        """Samples one input with probability proportional to
+        exp(eps*score/(2*sensitivity)). `scores` may carry precomputed
+        (vectorized) scores for all inputs; otherwise score() is called
+        per input."""
+        probs = self._calculate_probabilities(eps, inputs_to_score_col, scores)
         index = _rng.choice(len(inputs_to_score_col), p=probs)
         return inputs_to_score_col[index]
 
-    def _calculate_probabilities(self, eps: float,
-                                 inputs_to_score_col: List[Any]):
-        scores = np.array(
-            [self._scoring_function.score(k) for k in inputs_to_score_col],
-            dtype=np.float64)
+    def _calculate_probabilities(self,
+                                 eps: float,
+                                 inputs_to_score_col: List[Any],
+                                 scores: Optional[np.ndarray] = None):
+        if scores is None:
+            scores = np.array(
+                [self._scoring_function.score(k) for k in inputs_to_score_col],
+                dtype=np.float64)
+        else:
+            scores = np.asarray(scores, dtype=np.float64)
         denominator = self._scoring_function.global_sensitivity
         if not self._scoring_function.is_monotonic:
             denominator *= 2

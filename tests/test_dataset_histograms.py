@@ -1,0 +1,248 @@
+"""Tests for dataset_histograms (modeled on the reference's
+tests/dataset_histograms/ suites: bin boundaries, histogram contents on small
+datasets, quantiles, ratio_dropped, pre-aggregated parity, columnar parity).
+"""
+
+import numpy as np
+import pytest
+
+from pipelinedp_tpu import DataExtractors, PreAggregateExtractors, LocalBackend
+from pipelinedp_tpu.dataset_histograms import histograms as hist
+from pipelinedp_tpu.dataset_histograms import computing_histograms as ch
+from pipelinedp_tpu.dataset_histograms import histogram_error_estimator as est
+import pipelinedp_tpu as pdp
+
+
+BACKEND = LocalBackend()
+
+
+def _get(one_element_col):
+    result = list(one_element_col)
+    assert len(result) == 1
+    return result[0]
+
+
+class TestLogBinning:
+
+    @pytest.mark.parametrize("value,lower,upper", [
+        (1, 1, 2),
+        (999, 999, 1000),
+        (1000, 1000, 1010),
+        (1001, 1000, 1010),
+        (1234, 1230, 1240),
+        (9999, 9990, 10000),
+        (10000, 10000, 10100),
+        (12345, 12300, 12400),
+        (123456, 123000, 124000),
+    ])
+    def test_scalar(self, value, lower, upper):
+        assert ch._to_bin_lower_upper_logarithmic(value) == (lower, upper)
+
+    def test_vectorized_matches_scalar(self):
+        values = np.concatenate([
+            np.arange(1, 2000),
+            np.array([9999, 10000, 10001, 12345, 99999, 100000, 100001,
+                      123456, 10**7, 10**7 + 5]),
+        ])
+        lowers, uppers = ch._bin_lowers_log_vectorized(values)
+        for v, l, u in zip(values, lowers, uppers):
+            assert ch._to_bin_lower_upper_logarithmic(int(v)) == (l, u), v
+
+
+class TestHistogramDataclasses:
+
+    def _histogram(self):
+        bins = [
+            hist.FrequencyBin(lower=1, upper=2, count=10, sum=10, max=1),
+            hist.FrequencyBin(lower=2, upper=3, count=5, sum=10, max=2),
+            hist.FrequencyBin(lower=5, upper=6, count=5, sum=25, max=5),
+        ]
+        return hist.Histogram(hist.HistogramType.L0_CONTRIBUTIONS, bins)
+
+    def test_totals(self):
+        h = self._histogram()
+        assert h.total_count() == 20
+        assert h.total_sum() == 45
+        assert h.max_value() == 5
+        assert h.is_integer
+
+    def test_quantiles(self):
+        h = self._histogram()
+        # left ratios: bin1: 0, bin2: 10/20=0.5, bin3: 15/20=0.75
+        assert h.quantiles([0.0, 0.4, 0.5, 0.74, 0.75, 1.0]) == [1, 1, 2, 2, 5,
+                                                                 5]
+
+    def test_quantiles_empty_raises(self):
+        h = hist.Histogram(hist.HistogramType.L0_CONTRIBUTIONS,
+                           [hist.FrequencyBin(1, 2, 0, 0, 1)])
+        with pytest.raises(ValueError):
+            h.quantiles([0.5])
+
+    def test_ratio_dropped(self):
+        h = self._histogram()
+        ratios = hist.compute_ratio_dropped(h)
+        # thresholds: 0 → all dropped; 5 = max → 0 dropped
+        assert ratios[0] == (0, 1)
+        assert ratios[-1] == (5, 0.0)
+        d = dict(ratios)
+        # threshold 1: each element keeps 1: dropped = 45 - 20 = 25
+        assert d[1] == pytest.approx(25 / 45)
+        # threshold 2: 10*1 + 5*2 + 5*2 kept = 30 → dropped 15
+        assert d[2] == pytest.approx(15 / 45)
+
+    def test_ratio_dropped_max_not_bin_lower(self):
+        bins = [hist.FrequencyBin(lower=1, upper=2, count=2, sum=2, max=1),
+                hist.FrequencyBin(lower=3, upper=4, count=1, sum=7, max=7)]
+        # NOTE: artificial bin where max > lower.
+        h = hist.Histogram(hist.HistogramType.L0_CONTRIBUTIONS, bins)
+        ratios = hist.compute_ratio_dropped(h)
+        assert ratios[-1] == (7, 0.0)
+
+
+DATA = [
+    # (privacy_id, partition_key, value)
+    (1, 'a', 1.0),
+    (1, 'a', 2.0),
+    (1, 'b', 3.0),
+    (2, 'a', 4.0),
+    (2, 'c', 5.0),
+    (2, 'c', 6.0),
+    (3, 'a', 7.0),
+]
+EXTRACTORS = DataExtractors(privacy_id_extractor=lambda x: x[0],
+                            partition_extractor=lambda x: x[1],
+                            value_extractor=lambda x: x[2])
+
+
+class TestComputeDatasetHistograms:
+
+    def _compute(self):
+        return _get(ch.compute_dataset_histograms(DATA, EXTRACTORS, BACKEND))
+
+    def test_l0(self):
+        h = self._compute().l0_contributions_histogram
+        # pid1 → 2 partitions, pid2 → 2, pid3 → 1
+        assert h.name == hist.HistogramType.L0_CONTRIBUTIONS
+        assert {(b.lower, b.count) for b in h.bins} == {(1, 1), (2, 2)}
+
+    def test_l1(self):
+        h = self._compute().l1_contributions_histogram
+        # pid1 → 3 records, pid2 → 3, pid3 → 1
+        assert {(b.lower, b.count) for b in h.bins} == {(1, 1), (3, 2)}
+
+    def test_linf(self):
+        h = self._compute().linf_contributions_histogram
+        # pairs: (1,a)=2, (1,b)=1, (2,a)=1, (2,c)=2, (3,a)=1
+        assert {(b.lower, b.count) for b in h.bins} == {(1, 3), (2, 2)}
+
+    def test_linf_sum(self):
+        h = self._compute().linf_sum_contributions_histogram
+        # pair sums: 3.0, 3.0, 4.0, 11.0, 7.0
+        assert not h.is_integer
+        assert h.total_count() == 5
+        assert h.total_sum() == pytest.approx(28.0)
+        assert h.lower == pytest.approx(3.0)
+        assert h.upper == pytest.approx(11.0)
+
+    def test_count_per_partition(self):
+        h = self._compute().count_per_partition_histogram
+        # a → 4 rows, b → 1, c → 2
+        assert {(b.lower, b.count) for b in h.bins} == {(1, 1), (2, 1), (4, 1)}
+
+    def test_privacy_id_per_partition(self):
+        h = self._compute().count_privacy_id_per_partition
+        # a → 3 pids, b → 1, c → 1
+        assert {(b.lower, b.count) for b in h.bins} == {(1, 2), (3, 1)}
+
+    def test_columnar_parity(self):
+        pids = np.array([r[0] for r in DATA])
+        pk_map = {'a': 0, 'b': 1, 'c': 2}
+        pks = np.array([pk_map[r[1]] for r in DATA])
+        values = np.array([r[2] for r in DATA])
+        columnar = ch.compute_dataset_histograms_columnar(pids, pks, values)
+        backend_result = self._compute()
+        for field in ('l0_contributions_histogram',
+                      'l1_contributions_histogram',
+                      'linf_contributions_histogram',
+                      'count_per_partition_histogram',
+                      'count_privacy_id_per_partition'):
+            got = getattr(columnar, field)
+            want = getattr(backend_result, field)
+            assert sorted((b.lower, b.count, b.sum) for b in got.bins) == \
+                sorted((b.lower, b.count, b.sum) for b in want.bins), field
+        got_sum = columnar.linf_sum_contributions_histogram
+        want_sum = backend_result.linf_sum_contributions_histogram
+        assert got_sum.total_count() == want_sum.total_count()
+        assert got_sum.total_sum() == pytest.approx(want_sum.total_sum())
+
+
+class TestPreaggregatedHistograms:
+
+    def test_parity_with_raw(self):
+        # preaggregate by hand: (pk, (count, sum, n_partitions, n_contribs))
+        preagg = [
+            ('a', (2, 3.0, 2, 3)),  # pid1@a
+            ('b', (1, 3.0, 2, 3)),  # pid1@b
+            ('a', (1, 4.0, 2, 3)),  # pid2@a
+            ('c', (2, 11.0, 2, 3)),  # pid2@c
+            ('a', (1, 7.0, 1, 1)),  # pid3@a
+        ]
+        extractors = PreAggregateExtractors(
+            partition_extractor=lambda x: x[0],
+            preaggregate_extractor=lambda x: x[1])
+        got = _get(
+            ch.compute_dataset_histograms_on_preaggregated_data(
+                preagg, extractors, BACKEND))
+        want = _get(ch.compute_dataset_histograms(DATA, EXTRACTORS, BACKEND))
+        for field in ('l0_contributions_histogram',
+                      'l1_contributions_histogram',
+                      'linf_contributions_histogram',
+                      'count_per_partition_histogram',
+                      'count_privacy_id_per_partition'):
+            got_h = getattr(got, field)
+            want_h = getattr(want, field)
+            assert sorted((b.lower, b.count) for b in got_h.bins) == \
+                sorted((b.lower, b.count) for b in want_h.bins), field
+
+
+class TestErrorEstimator:
+
+    def test_estimate_rmse_count(self):
+        histograms = _get(
+            ch.compute_dataset_histograms(DATA, EXTRACTORS, BACKEND))
+        estimator = est.create_error_estimator(histograms,
+                                               base_std=1.0,
+                                               metric=pdp.Metrics.COUNT,
+                                               noise=pdp.NoiseKind.LAPLACE)
+        # With bounds above max contributions nothing is dropped:
+        # stddev = base_std * l0 * linf
+        rmse = estimator.estimate_rmse(l0_bound=2, linf_bound=2)
+        # ratio_dropped = 0 → rmse = std = 4 for every partition
+        assert rmse == pytest.approx(4.0)
+
+    def test_estimate_rmse_requires_linf_for_count(self):
+        histograms = _get(
+            ch.compute_dataset_histograms(DATA, EXTRACTORS, BACKEND))
+        estimator = est.create_error_estimator(histograms, 1.0,
+                                               pdp.Metrics.COUNT,
+                                               pdp.NoiseKind.LAPLACE)
+        with pytest.raises(ValueError):
+            estimator.estimate_rmse(l0_bound=1)
+
+    def test_estimator_rejects_sum(self):
+        histograms = _get(
+            ch.compute_dataset_histograms(DATA, EXTRACTORS, BACKEND))
+        with pytest.raises(ValueError):
+            est.create_error_estimator(histograms, 1.0, pdp.Metrics.SUM,
+                                       pdp.NoiseKind.LAPLACE)
+
+    def test_ratio_dropped_interpolation(self):
+        histograms = _get(
+            ch.compute_dataset_histograms(DATA, EXTRACTORS, BACKEND))
+        estimator = est.create_error_estimator(histograms, 1.0,
+                                               pdp.Metrics.PRIVACY_ID_COUNT,
+                                               pdp.NoiseKind.GAUSSIAN)
+        assert estimator.get_ratio_dropped_l0(0) == 1
+        assert estimator.get_ratio_dropped_l0(100) == 0
+        # l0 per pid: [2, 2, 1]; threshold 1 drops 2 of 5 pair-contributions
+        assert estimator.get_ratio_dropped_l0(1) == pytest.approx(2 / 5)

@@ -1,0 +1,127 @@
+"""Tests for private_contribution_bounds (modeled on the reference's
+tests/private_contribution_bounds_test.py patterns: candidate generation,
+scoring values, deterministic choice at huge calculation_eps).
+"""
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import private_contribution_bounds as pcb
+from pipelinedp_tpu.dataset_histograms import histograms as hist
+
+
+def _params(noise=pdp.NoiseKind.LAPLACE,
+            aggregation_eps=1.0,
+            aggregation_delta=0.0,
+            calculation_eps=1.0,
+            upper_bound=100):
+    return pdp.CalculatePrivateContributionBoundsParams(
+        aggregation_noise_kind=noise,
+        aggregation_eps=aggregation_eps,
+        aggregation_delta=aggregation_delta,
+        calculation_eps=calculation_eps,
+        max_partitions_contributed_upper_bound=upper_bound)
+
+
+def _l0_histogram(bin_specs):
+    bins = [
+        hist.FrequencyBin(lower=l, upper=l + 1, count=c, sum=l * c, max=l)
+        for l, c in bin_specs
+    ]
+    return hist.Histogram(hist.HistogramType.L0_CONTRIBUTIONS, bins)
+
+
+class TestGeneratePossibleContributionBounds:
+
+    def test_small(self):
+        assert pcb.generate_possible_contribution_bounds(10) == list(
+            range(1, 11))
+
+    def test_three_digit_grid(self):
+        bounds = pcb.generate_possible_contribution_bounds(10200)
+        assert bounds[:999] == list(range(1, 1000))
+        assert bounds[999:1003] == [1000, 1010, 1020, 1030]
+        assert bounds[-3:] == [10000, 10100, 10200]
+
+    def test_all_have_three_significant_digits(self):
+        for b in pcb.generate_possible_contribution_bounds(10**6):
+            assert b % (10**max(0, len(str(b)) - 3)) == 0
+
+
+class TestL0ScoringFunction:
+
+    def test_score_components_laplace(self):
+        params = _params(upper_bound=10)
+        histogram = _l0_histogram([(1, 5), (4, 2)])
+        f = pcb.L0ScoringFunction(params, number_of_partitions=100,
+                                  l0_histogram=histogram)
+        # B = min(10, 100) = 10; laplace count noise std for l0=k, linf=1:
+        # sqrt(2)*k/eps
+        k = 2
+        expected_noise = 100 * np.sqrt(2) * k / 1.0
+        # dropped: 5 users at 1 → max(1-2,0)=0; 2 users at 4 → (4-2)*2 = 4
+        expected_dropped = 4
+        assert f.score(k) == pytest.approx(-0.5 * expected_noise -
+                                           0.5 * expected_dropped)
+
+    def test_global_sensitivity_capped_by_partitions(self):
+        params = _params(upper_bound=1000)
+        f = pcb.L0ScoringFunction(params, number_of_partitions=7,
+                                  l0_histogram=_l0_histogram([(1, 1)]))
+        assert f.global_sensitivity == 7
+        assert f.is_monotonic
+
+    def test_score_all_matches_scalar(self):
+        params = _params(noise=pdp.NoiseKind.GAUSSIAN, aggregation_delta=1e-5,
+                         upper_bound=50)
+        histogram = _l0_histogram([(1, 10), (3, 5), (20, 2), (60, 1)])
+        f = pcb.L0ScoringFunction(params, number_of_partitions=40,
+                                  l0_histogram=histogram)
+        ks = np.array([1, 2, 5, 10, 40])
+        vectorized = f.score_all(ks)
+        for k, v in zip(ks, vectorized):
+            assert v == pytest.approx(f.score(int(k))), k
+
+
+class TestPrivateL0Calculator:
+
+    def test_deterministic_choice_with_huge_eps(self):
+        # Huge calculation_eps → exponential mechanism ≈ argmax score.
+        params = _params(calculation_eps=1e6, upper_bound=4)
+        backend = pdp.LocalBackend()
+        partitions = ['a', 'b', 'c', 'a']
+        histogram = _l0_histogram([(1, 1000), (3, 1)])
+        histograms_col = [
+            hist.DatasetHistograms(histogram, None, None, None, None, None)
+        ]
+        calculator = pcb.PrivateL0Calculator(params, partitions,
+                                             histograms_col, backend)
+        result = list(calculator.calculate())
+        assert len(result) == 1
+        # Almost all users contribute to 1 partition; noise impact grows with
+        # k, so k=1 maximizes the score.
+        assert result[0] == 1
+
+    def test_engine_entry_point(self):
+        data = [(uid, pk) for uid in range(20) for pk in ('a', 'b')]
+        extractors = pdp.DataExtractors(
+            privacy_id_extractor=lambda x: x[0],
+            partition_extractor=lambda x: x[1],
+            value_extractor=lambda x: 1)
+        budget = pdp.NaiveBudgetAccountant(total_epsilon=1e6, total_delta=1e-5)
+        engine = pdp.DPEngine(budget, pdp.LocalBackend())
+        params = pdp.CalculatePrivateContributionBoundsParams(
+            aggregation_noise_kind=pdp.NoiseKind.LAPLACE,
+            aggregation_eps=1e6,
+            aggregation_delta=0,
+            calculation_eps=1e6,
+            max_partitions_contributed_upper_bound=5)
+        result = list(
+            engine.calculate_private_contribution_bounds(
+                data, params, extractors, partitions=['a', 'b']))
+        assert len(result) == 1
+        bounds = result[0]
+        assert isinstance(bounds, pdp.PrivateContributionBounds)
+        # every user contributes to exactly 2 partitions → l0=2 is optimal
+        assert bounds.max_partitions_contributed == 2
