@@ -1,0 +1,44 @@
+"""Utility analysis & parameter tuning for DP aggregations (L6 layer).
+
+Capability parity with the reference ``analysis/`` package: utility analysis
+(closed-form per-partition error modeling swept over many parameter
+configurations at once), cross-partition report aggregation, parameter
+tuning, pre-aggregation, and dataset summaries — re-designed so the
+per-partition math is vectorized over privacy units and parameter
+configurations (numpy batch kernels instead of per-element Python).
+"""
+
+from pipelinedp_tpu.analysis.data_structures import (
+    MultiParameterConfiguration,
+    UtilityAnalysisOptions,
+    get_aggregate_params,
+    get_partition_selection_strategy,
+)
+from pipelinedp_tpu.analysis.metrics import (
+    ContributionBoundingErrors,
+    DataDropInfo,
+    MeanVariance,
+    MetricUtility,
+    PartitionsInfo,
+    PerPartitionMetrics,
+    RawStatistics,
+    SumMetrics,
+    UtilityReport,
+    UtilityReportBin,
+    ValueErrors,
+)
+from pipelinedp_tpu.analysis.utility_analysis import perform_utility_analysis
+from pipelinedp_tpu.analysis.utility_analysis_engine import (
+    UtilityAnalysisEngine)
+from pipelinedp_tpu.analysis.parameter_tuning import (
+    MinimizingFunction,
+    ParametersToTune,
+    TuneOptions,
+    TuneResult,
+    tune,
+)
+from pipelinedp_tpu.analysis.pre_aggregation import preaggregate
+from pipelinedp_tpu.analysis.dataset_summary import (
+    PublicPartitionsSummary,
+    compute_public_partitions_summary,
+)

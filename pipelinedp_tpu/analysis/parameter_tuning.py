@@ -1,0 +1,305 @@
+"""Parameter tuning from dataset histograms + utility analysis.
+
+Capability parity with the reference ``analysis/parameter_tuning.py``:
+candidate generation from contribution histograms (constant-relative-step
+grid, bin-max subsampling, 2D grids), a utility-analysis sweep over all
+candidates, and argmin-RMSE selection.
+"""
+
+import dataclasses
+import logging
+import math
+from dataclasses import dataclass
+from enum import Enum
+from numbers import Number
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from pipelinedp_tpu import aggregate_params as agg
+from pipelinedp_tpu import data_extractors as extractors
+from pipelinedp_tpu import input_validators
+from pipelinedp_tpu import pipeline_backend
+from pipelinedp_tpu.dataset_histograms import histograms
+from pipelinedp_tpu.analysis import data_structures
+from pipelinedp_tpu.analysis import metrics
+from pipelinedp_tpu.analysis import utility_analysis
+
+
+class MinimizingFunction(Enum):
+    ABSOLUTE_ERROR = 'absolute_error'
+    RELATIVE_ERROR = 'relative_error'
+
+
+@dataclass
+class ParametersToTune:
+    """Which parameters to tune."""
+    max_partitions_contributed: bool = False
+    max_contributions_per_partition: bool = False
+    min_sum_per_partition: bool = False
+    max_sum_per_partition: bool = False
+
+    def __post_init__(self):
+        if not any(dataclasses.asdict(self).values()):
+            raise ValueError("ParametersToTune must have at least 1 parameter "
+                             "to tune.")
+
+
+@dataclass
+class TuneOptions:
+    """Options for the tuning process (reference ``parameter_tuning.py:52-89``).
+
+    Attributes not being tuned are taken from aggregate_params.
+    """
+    epsilon: float
+    delta: float
+    aggregate_params: agg.AggregateParams
+    function_to_minimize: Union[MinimizingFunction, Callable]
+    parameters_to_tune: ParametersToTune
+    partitions_sampling_prob: float = 1
+    pre_aggregated_data: bool = False
+    number_of_parameter_candidates: int = 100
+
+    def __post_init__(self):
+        input_validators.validate_epsilon_delta(self.epsilon, self.delta,
+                                                "TuneOptions")
+
+
+@dataclass
+class TuneResult:
+    """Tuning results (reference ``:92-112``)."""
+    options: TuneOptions
+    contribution_histograms: histograms.DatasetHistograms
+    utility_analysis_parameters: 'data_structures.MultiParameterConfiguration'
+    index_best: int
+    utility_reports: List[metrics.UtilityReport]
+
+
+def _find_candidate_parameters(
+        hist: histograms.DatasetHistograms,
+        parameters_to_tune: ParametersToTune, metric: Optional[agg.Metric],
+        max_candidates: int
+) -> 'data_structures.MultiParameterConfiguration':
+    """Candidates for l0 / linf / max_sum_per_partition (reference ``:115-179``)."""
+    calculate_l0_param = parameters_to_tune.max_partitions_contributed
+    generate_linf_count = metric == agg.Metrics.COUNT
+    generate_max_sum_per_partition = metric == agg.Metrics.SUM
+    calculate_linf_count = (
+        parameters_to_tune.max_contributions_per_partition and
+        generate_linf_count)
+    calculate_sum_per_partition_param = (
+        parameters_to_tune.max_sum_per_partition and
+        generate_max_sum_per_partition)
+    l0_bounds = linf_bounds = None
+    max_sum_per_partition_bounds = min_sum_per_partition_bounds = None
+
+    if calculate_sum_per_partition_param:
+        if hist.linf_sum_contributions_histogram.bins[0].lower < 0:
+            logging.warning(
+                "max_sum_per_partition candidates might be negative; "
+                "min_sum_per_partition tuning is not supported yet, so "
+                "max_sum_per_partition tuning works best when "
+                "linf_sum_contributions_histogram has no negative sums")
+
+    if calculate_l0_param and calculate_linf_count:
+        l0_bounds, linf_bounds = _find_candidates_parameters_in_2d_grid(
+            hist.l0_contributions_histogram,
+            hist.linf_contributions_histogram,
+            _find_candidates_constant_relative_step,
+            _find_candidates_constant_relative_step, max_candidates)
+    elif calculate_l0_param and calculate_sum_per_partition_param:
+        l0_bounds, max_sum_per_partition_bounds = (
+            _find_candidates_parameters_in_2d_grid(
+                hist.l0_contributions_histogram,
+                hist.linf_sum_contributions_histogram,
+                _find_candidates_constant_relative_step,
+                _find_candidates_bins_max_values_subsample, max_candidates))
+        min_sum_per_partition_bounds = [0] * len(max_sum_per_partition_bounds)
+    elif calculate_l0_param:
+        l0_bounds = _find_candidates_constant_relative_step(
+            hist.l0_contributions_histogram, max_candidates)
+    elif calculate_linf_count:
+        linf_bounds = _find_candidates_constant_relative_step(
+            hist.linf_contributions_histogram, max_candidates)
+    elif calculate_sum_per_partition_param:
+        max_sum_per_partition_bounds = (
+            _find_candidates_bins_max_values_subsample(
+                hist.linf_sum_contributions_histogram, max_candidates))
+        min_sum_per_partition_bounds = [0] * len(max_sum_per_partition_bounds)
+    else:
+        assert False, "Nothing to tune."
+
+    return data_structures.MultiParameterConfiguration(
+        max_partitions_contributed=l0_bounds,
+        max_contributions_per_partition=linf_bounds,
+        min_sum_per_partition=min_sum_per_partition_bounds,
+        max_sum_per_partition=max_sum_per_partition_bounds)
+
+
+def _find_candidates_parameters_in_2d_grid(
+        hist1: histograms.Histogram, hist2: histograms.Histogram,
+        find_candidates_func1: Callable[[histograms.Histogram, int],
+                                        Sequence[Number]],
+        find_candidates_func2: Callable[[histograms.Histogram, int],
+                                        Sequence[Number]],
+        max_candidates: int) -> Tuple[Sequence[Number], Sequence[Number]]:
+    """Cross-product grid of candidates for two parameters, rebalanced when
+    one parameter has fewer candidates than sqrt(max_candidates)
+    (reference ``:182-233``)."""
+    max_per_parameter = int(math.sqrt(max_candidates))
+    param1_candidates = find_candidates_func1(hist1, max_per_parameter)
+    param2_candidates = find_candidates_func2(hist2, max_per_parameter)
+
+    if (len(param2_candidates) < max_per_parameter and
+            len(param1_candidates) == max_per_parameter):
+        param1_candidates = find_candidates_func1(
+            hist1, int(max_candidates / len(param2_candidates)))
+    elif (len(param1_candidates) < max_per_parameter and
+          len(param2_candidates) == max_per_parameter):
+        param2_candidates = find_candidates_func2(
+            hist2, int(max_candidates / len(param1_candidates)))
+
+    param1_bounds, param2_bounds = [], []
+    for param1 in param1_candidates:
+        for param2 in param2_candidates:
+            param1_bounds.append(param1)
+            param2_bounds.append(param2)
+    return param1_bounds, param2_bounds
+
+
+def _find_candidates_constant_relative_step(histogram: histograms.Histogram,
+                                            max_candidates: int) -> List[int]:
+    """Geometric sequence of candidates from 1 to histogram.max_value
+    (reference ``:236-264``)."""
+    max_value = histogram.max_value()
+    assert max_value >= 1, "max_value has to be >= 1."
+    max_candidates = min(max_candidates, max_value)
+    assert max_candidates > 0, "max_candidates have to be positive"
+    if max_candidates == 1:
+        return [1]
+    step = pow(max_value, 1 / (max_candidates - 1))
+    candidates = [1]
+    accumulated = 1
+    for _ in range(1, max_candidates):
+        previous_candidate = candidates[-1]
+        if previous_candidate >= max_value:
+            break
+        accumulated *= step
+        next_candidate = max(previous_candidate + 1, math.ceil(accumulated))
+        candidates.append(next_candidate)
+    candidates[-1] = max_value
+    return candidates
+
+
+def _find_candidates_bins_max_values_subsample(
+        histogram: histograms.Histogram,
+        max_candidates: int) -> List[float]:
+    """Evenly-spaced subsample of the histogram bins' max values."""
+    max_candidates = min(max_candidates, len(histogram.bins))
+    ids = np.round(np.linspace(0,
+                               len(histogram.bins) - 1,
+                               num=max_candidates)).astype(int)
+    bin_maximums = np.fromiter((b.max for b in histogram.bins), dtype=float)
+    return bin_maximums[ids].tolist()
+
+
+def tune(col,
+         backend: pipeline_backend.PipelineBackend,
+         contribution_histograms: histograms.DatasetHistograms,
+         options: TuneOptions,
+         data_extractors: Union[extractors.DataExtractors,
+                                extractors.PreAggregateExtractors],
+         public_partitions=None):
+    """Tunes parameters: candidates → utility analysis sweep → argmin RMSE.
+
+    For tuning select_partitions set options.aggregate_params.metrics = [].
+
+    Returns:
+        (1-element collection with TuneResult, collection of per-partition
+        utility results).
+    """
+    _check_tune_args(options, public_partitions is not None)
+
+    metric = None
+    if options.aggregate_params.metrics:
+        metric = options.aggregate_params.metrics[0]
+
+    candidates = _find_candidate_parameters(
+        contribution_histograms, options.parameters_to_tune, metric,
+        options.number_of_parameter_candidates)
+
+    utility_analysis_options = data_structures.UtilityAnalysisOptions(
+        epsilon=options.epsilon,
+        delta=options.delta,
+        aggregate_params=options.aggregate_params,
+        multi_param_configuration=candidates,
+        partitions_sampling_prob=options.partitions_sampling_prob,
+        pre_aggregated_data=options.pre_aggregated_data)
+
+    utility_result, per_partition_utility_result = (
+        utility_analysis.perform_utility_analysis(col, backend,
+                                                  utility_analysis_options,
+                                                  data_extractors,
+                                                  public_partitions))
+    use_public_partitions = public_partitions is not None
+
+    utility_result = backend.to_list(utility_result, "To list")
+    utility_result = backend.map(
+        utility_result,
+        lambda result: _convert_utility_analysis_to_tune_result(
+            result, options, candidates, use_public_partitions,
+            contribution_histograms), "To Tune result")
+    return utility_result, per_partition_utility_result
+
+
+def _convert_utility_analysis_to_tune_result(
+        utility_reports: Tuple[metrics.UtilityReport], tune_options:
+        TuneOptions,
+        run_configurations: 'data_structures.MultiParameterConfiguration',
+        use_public_partitions: bool,
+        contribution_histograms: histograms.DatasetHistograms) -> TuneResult:
+    assert len(utility_reports) == run_configurations.size
+    assert (tune_options.function_to_minimize ==
+            MinimizingFunction.ABSOLUTE_ERROR)
+
+    sorted_utility_reports = sorted(utility_reports,
+                                    key=lambda e: e.configuration_index)
+
+    index_best = -1  # not found (select-partitions analysis)
+    if tune_options.aggregate_params.metrics:
+        rmse = [
+            ur.metric_errors[0].absolute_error.rmse
+            for ur in sorted_utility_reports
+        ]
+        index_best = int(np.argmin(rmse))
+
+    return TuneResult(tune_options,
+                      contribution_histograms,
+                      run_configurations,
+                      index_best,
+                      utility_reports=sorted_utility_reports)
+
+
+def _check_tune_args(options: TuneOptions, is_public_partitions: bool):
+    tune_metrics = options.aggregate_params.metrics
+    if not tune_metrics:
+        # Empty metrics means tuning for select_partitions.
+        if is_public_partitions:
+            raise ValueError("Empty metrics means tuning of partition "
+                             "selection but public partitions were provided.")
+    elif len(tune_metrics) > 1:
+        raise ValueError(
+            f"Tuning supports only one metric, but {tune_metrics} given.")
+    elif tune_metrics[0] not in [
+            agg.Metrics.COUNT, agg.Metrics.PRIVACY_ID_COUNT, agg.Metrics.SUM
+    ]:
+        raise ValueError("Tuning is supported only for Count, Privacy id "
+                         f"count and Sum, but {tune_metrics[0]} given.")
+
+    if options.parameters_to_tune.min_sum_per_partition:
+        raise ValueError(
+            "Tuning of min_sum_per_partition is not supported yet.")
+
+    if options.function_to_minimize != MinimizingFunction.ABSOLUTE_ERROR:
+        raise NotImplementedError(
+            f"Only {MinimizingFunction.ABSOLUTE_ERROR} is implemented.")

@@ -1,0 +1,19 @@
+"""Probabilistic-distribution computations.
+
+Capability parity with the reference ``analysis/probability_computations.py``.
+"""
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def compute_sum_laplace_gaussian_quantiles(laplace_b: float,
+                                           gaussian_sigma: float,
+                                           quantiles: Sequence[float],
+                                           num_samples: int) -> List[float]:
+    """Monte-Carlo quantiles of Laplace(b) + N(0, sigma) (reference ``:20-35``)."""
+    samples = np.random.laplace(
+        scale=laplace_b, size=num_samples) + np.random.normal(
+            loc=0, scale=gaussian_sigma, size=num_samples)
+    return np.quantile(samples, quantiles)

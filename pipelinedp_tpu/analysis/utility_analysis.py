@@ -1,0 +1,198 @@
+"""Public API for performing utility analysis.
+
+Capability parity with the reference ``analysis/utility_analysis.py:42-251``:
+per-partition analysis → cross-partition UtilityReports, plus a histogram of
+reports by partition-size bucket (logarithmic [1,2,5]·10^i buckets).
+"""
+
+import bisect
+import copy
+from typing import Any, Iterable, List, Tuple, Union
+
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu import data_extractors as extractors
+from pipelinedp_tpu import pipeline_backend
+from pipelinedp_tpu.analysis import cross_partition_combiners
+from pipelinedp_tpu.analysis import data_structures
+from pipelinedp_tpu.analysis import metrics
+from pipelinedp_tpu.analysis import utility_analysis_engine
+
+
+def _generate_bucket_bounds():
+    result = [0, 1]
+    for i in range(1, 10):
+        result.append(10**i)
+        result.append(2 * 10**i)
+        result.append(5 * 10**i)
+    return tuple(result)
+
+
+# Bucket bounds for the UtilityReport histogram: [0, 1] + [1, 2, 5]*10^i.
+BUCKET_BOUNDS = _generate_bucket_bounds()
+
+
+def perform_utility_analysis(
+        col,
+        backend: pipeline_backend.PipelineBackend,
+        options: 'data_structures.UtilityAnalysisOptions',
+        data_extractors: Union[extractors.DataExtractors,
+                               extractors.PreAggregateExtractors],
+        public_partitions=None):
+    """Performs utility analysis for DP aggregations.
+
+    Returns:
+        A tuple: (collection of metrics.UtilityReport — one per input
+        configuration; collection of ((partition_key, configuration_index),
+        metrics.PerPartitionMetrics)).
+    """
+    budget_accountant = budget_accounting.NaiveBudgetAccountant(
+        total_epsilon=options.epsilon, total_delta=options.delta)
+    engine = utility_analysis_engine.UtilityAnalysisEngine(
+        budget_accountant=budget_accountant, backend=backend)
+    per_partition_result = engine.analyze(col,
+                                          options=options,
+                                          data_extractors=data_extractors,
+                                          public_partitions=public_partitions)
+    # (partition_key, per-partition analysis results)
+    budget_accountant.compute_budgets()
+
+    n_configurations = options.n_configurations
+    per_partition_result = backend.map_values(
+        per_partition_result,
+        lambda value: _pack_per_partition_metrics(value, n_configurations),
+        "Pack per-partition metrics.")
+    # (partition_key, (PerPartitionMetrics, ...))
+    per_partition_result = backend.to_multi_transformable_collection(
+        per_partition_result)
+
+    col = backend.values(per_partition_result, "Drop partition key")
+    col = backend.flat_map(col, _unnest_metrics, "Unnest metrics")
+    # ((configuration_index, bucket), PerPartitionMetrics)
+
+    per_partition_result = backend.flat_map(
+        per_partition_result, lambda kv: (((kv[0], i), result)
+                                          for i, result in enumerate(kv[1])),
+        "Unpack PerPartitionMetrics from list")
+    # ((partition_key, configuration_index), PerPartitionMetrics)
+
+    combiner = cross_partition_combiners.CrossPartitionCombiner(
+        options.aggregate_params.metrics, public_partitions is not None)
+
+    accumulators = backend.map_values(col, combiner.create_accumulator,
+                                      "Create accumulators")
+    accumulators = backend.combine_accumulators_per_key(
+        accumulators, combiner, "Combine cross-partition metrics")
+    cross_partition_metrics = backend.map_values(
+        accumulators, combiner.compute_metrics,
+        "Compute cross-partition metrics")
+    # ((configuration_index, bucket), UtilityReport)
+
+    if public_partitions is None:
+        strategies = data_structures.get_partition_selection_strategy(options)
+
+        def add_partition_selection_strategy(report: metrics.UtilityReport):
+            report = copy.deepcopy(report)
+            report.partitions_info.strategy = strategies[
+                report.configuration_index]
+            return report
+
+        cross_partition_metrics = backend.map_values(
+            cross_partition_metrics, add_partition_selection_strategy,
+            "Add Partition Selection Strategy")
+
+    cross_partition_metrics = backend.map_tuple(
+        cross_partition_metrics, lambda key, value: (key[0], (key[1], value)),
+        "Rekey")
+    cross_partition_metrics = backend.group_by_key(cross_partition_metrics,
+                                                   "Group by configuration")
+    result = backend.map_tuple(cross_partition_metrics,
+                               _group_utility_reports,
+                               "Group utility reports")
+    # (UtilityReport)
+    return result, per_partition_result
+
+
+def _pack_per_partition_metrics(
+        utility_result: List[Any],
+        n_configurations: int) -> Tuple[metrics.PerPartitionMetrics]:
+    """Groups flat per-partition combiner outputs by configuration.
+
+    utility_result = [RawStatistics, config0 results..., config1 results...];
+    each configuration has the same number of results (selection probability
+    float and/or SumMetrics per metric).
+    """
+    n_metrics = len(utility_result) // n_configurations
+
+    raw_statistics = utility_result[0]
+    result = tuple(
+        metrics.PerPartitionMetrics(1, raw_statistics, [])
+        for _ in range(n_configurations))
+
+    for i, metric in enumerate(utility_result[1:]):
+        i_configuration = i // n_metrics
+        ith_result = result[i_configuration]
+        if isinstance(metric, float):  # partition selection probability
+            ith_result.partition_selection_probability_to_keep = metric
+        else:
+            ith_result.metric_errors.append(metric)
+    return result
+
+
+def _get_lower_bound(n: int) -> int:
+    if n < 0:
+        return 0
+    return BUCKET_BOUNDS[bisect.bisect_right(BUCKET_BOUNDS, n) - 1]
+
+
+def _get_upper_bound(n: int) -> int:
+    if n < 0:
+        return 0
+    index = bisect.bisect_right(BUCKET_BOUNDS, n)
+    if index >= len(BUCKET_BOUNDS):
+        return -1
+    return BUCKET_BOUNDS[index]
+
+
+def _unnest_metrics(
+    per_partition: List[metrics.PerPartitionMetrics]
+) -> Iterable[Tuple[Any, metrics.PerPartitionMetrics]]:
+    """Yields each configuration's metrics keyed by (config, None) for the
+    global report and (config, size_bucket) for the histogram."""
+    for i, metric in enumerate(per_partition):
+        yield ((i, None), metric)
+        if per_partition[0].metric_errors:
+            partition_size = per_partition[0].metric_errors[0].sum
+        else:
+            # Select-partitions case.
+            partition_size = per_partition[0].raw_statistics.privacy_id_count
+        bucket = _get_lower_bound(partition_size)
+        yield ((i, bucket), metric)
+
+
+def _group_utility_reports(
+        configuration_index: int,
+        reports: List[Tuple[Any, metrics.UtilityReport]]
+) -> metrics.UtilityReport:
+    """Combines a configuration's global report with its size-bucket reports
+    into one UtilityReport with utility_report_histogram set."""
+    global_report = None
+    histogram_reports = []
+    for lower_bucket_bound, report in reports:
+        report = copy.deepcopy(report)
+        report.configuration_index = configuration_index
+        if lower_bucket_bound is None:
+            global_report = report
+        else:
+            histogram_reports.append((lower_bucket_bound, report))
+    if global_report is None:
+        return None
+    if not histogram_reports:
+        # Select-partitions case.
+        return global_report
+    histogram_reports.sort(key=lambda kv: kv[0])
+    global_report.utility_report_histogram = [
+        metrics.UtilityReportBin(lower_bound, _get_upper_bound(lower_bound),
+                                 report)
+        for lower_bound, report in histogram_reports
+    ]
+    return global_report

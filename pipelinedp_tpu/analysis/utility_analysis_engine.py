@@ -1,0 +1,190 @@
+"""DPEngine subclass for utility analysis.
+
+Capability parity with the reference ``analysis/utility_analysis_engine.py``:
+reuses the DP computation graph from DPEngine, swapping nodes — analysis
+contribution bounder (no bounding, emits aggregates), one combiner set per
+parameter configuration, no-op private partition selection, no annotation.
+"""
+
+from typing import Optional, Union
+
+from pipelinedp_tpu import aggregate_params as agg
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu import combiners as dp_combiners
+from pipelinedp_tpu import contribution_bounders as dp_bounders
+from pipelinedp_tpu import data_extractors as extractors
+from pipelinedp_tpu import dp_engine
+from pipelinedp_tpu import pipeline_backend
+from pipelinedp_tpu.analysis import contribution_bounders as analysis_bounders
+from pipelinedp_tpu.analysis import data_structures
+from pipelinedp_tpu.analysis import per_partition_combiners
+
+
+class UtilityAnalysisEngine(dp_engine.DPEngine):
+    """Performs utility analysis for DP aggregations."""
+
+    def __init__(self, budget_accountant: budget_accounting.BudgetAccountant,
+                 backend: pipeline_backend.PipelineBackend):
+        super().__init__(budget_accountant, backend)
+        self._is_public_partitions = None
+        self._options = None
+
+    def aggregate(self,
+                  col,
+                  params: agg.AggregateParams,
+                  data_extractors: extractors.DataExtractors,
+                  public_partitions=None):
+        raise ValueError("UtilityAnalysisEngine.aggregate can't be called.\n"
+                         "If you'd like to perform utility analysis, use "
+                         "UtilityAnalysisEngine.analyze.\n"
+                         "If you'd like to perform DP computations, use "
+                         "DPEngine.aggregate.")
+
+    def analyze(self,
+                col,
+                options: 'data_structures.UtilityAnalysisOptions',
+                data_extractors: Union[extractors.DataExtractors,
+                                       extractors.PreAggregateExtractors],
+                public_partitions=None):
+        """Utility analysis per partition.
+
+        Returns a collection of (partition_key, per-partition utility
+        metrics) — one flat tuple of results per partition, covering every
+        parameter configuration in 'options'.
+        """
+        _check_utility_analysis_params(options, data_extractors)
+        self._options = options
+        self._is_public_partitions = public_partitions is not None
+        # Build the computation graph via the parent class.
+        result = super().aggregate(col, options.aggregate_params,
+                                   data_extractors, public_partitions)
+        self._is_public_partitions = None
+        self._options = None
+        return result
+
+    def _use_tpu_path(self, params: agg.AggregateParams) -> bool:
+        # The analysis graph swaps combiners/bounders; route through the
+        # generic graph (its per-partition kernels are numpy-vectorized).
+        return False
+
+    def _create_contribution_bounder(
+            self, params: agg.AggregateParams,
+            expects_per_partition_sampling: bool
+    ) -> dp_bounders.ContributionBounder:
+        if self._options.pre_aggregated_data:
+            return analysis_bounders.NoOpContributionBounder()
+        return analysis_bounders.AnalysisContributionBounder(
+            self._options.partitions_sampling_prob)
+
+    def _create_compound_combiner(
+            self, aggregate_params: agg.AggregateParams
+    ) -> dp_combiners.CompoundCombiner:
+        mechanism_type = aggregate_params.noise_kind.convert_to_mechanism_type(
+        )
+        # One budget request for private partition selection and one per
+        # metric — SHARED by all parameter configurations (the analysis
+        # models the same budget split the real run would have).
+        if not self._is_public_partitions:
+            private_partition_selection_budget = (
+                self._budget_accountant.request_budget(
+                    agg.MechanismType.GENERIC,
+                    weight=aggregate_params.budget_weight))
+        budgets = {}
+        for metric in aggregate_params.metrics:
+            budgets[metric] = self._budget_accountant.request_budget(
+                mechanism_type, weight=aggregate_params.budget_weight)
+
+        # Internal combiners: RawStatistics first, then per configuration:
+        # [partition selection?, SUM?, COUNT?, PRIVACY_ID_COUNT?].
+        # Order matters — _pack_per_partition_metrics depends on it.
+        internal_combiners = [per_partition_combiners.RawStatisticsCombiner()]
+        for params in data_structures.get_aggregate_params(self._options):
+            if not self._is_public_partitions:
+                internal_combiners.append(
+                    per_partition_combiners.PartitionSelectionCombiner(
+                        dp_combiners.CombinerParams(
+                            private_partition_selection_budget, params)))
+            if agg.Metrics.SUM in aggregate_params.metrics:
+                internal_combiners.append(
+                    per_partition_combiners.SumCombiner(
+                        dp_combiners.CombinerParams(
+                            budgets[agg.Metrics.SUM], params)))
+            if agg.Metrics.COUNT in aggregate_params.metrics:
+                internal_combiners.append(
+                    per_partition_combiners.CountCombiner(
+                        dp_combiners.CombinerParams(
+                            budgets[agg.Metrics.COUNT], params)))
+            if agg.Metrics.PRIVACY_ID_COUNT in aggregate_params.metrics:
+                internal_combiners.append(
+                    per_partition_combiners.PrivacyIdCountCombiner(
+                        dp_combiners.CombinerParams(
+                            budgets[agg.Metrics.PRIVACY_ID_COUNT], params)))
+
+        return per_partition_combiners.CompoundCombiner(
+            internal_combiners, return_named_tuple=False)
+
+    def _select_private_partitions_internal(
+            self, col, max_partitions_contributed: int,
+            max_rows_per_privacy_id: int,
+            strategy: agg.PartitionSelectionStrategy,
+            pre_threshold: Optional[int]):
+        # Analysis of private partition selection happens in the
+        # PartitionSelectionCombiner; no partitions are dropped here.
+        return col
+
+    def _extract_columns(
+            self, col, data_extractors: Union[
+                extractors.DataExtractors,
+                extractors.PreAggregateExtractors]):
+        if self._options.pre_aggregated_data:
+            # (privacy_id=None, partition_key, preaggregate_data)
+            return self._backend.map(
+                col, lambda row: (None, data_extractors.partition_extractor(
+                    row), data_extractors.preaggregate_extractor(row)),
+                "Extract (partition_key, preaggregate_data)")
+        return super()._extract_columns(col, data_extractors)
+
+    def _check_aggregate_params(self,
+                                col,
+                                params: agg.AggregateParams,
+                                data_extractors,
+                                check_data_extractors: bool = True):
+        # PreAggregateExtractors are checked by _check_utility_analysis_params.
+        super()._check_aggregate_params(col,
+                                        params,
+                                        data_extractors=None,
+                                        check_data_extractors=False)
+
+    def _annotate(self, col, params, budget):
+        # No DP computations are performed — nothing to annotate.
+        return col
+
+
+def _check_utility_analysis_params(
+        options: 'data_structures.UtilityAnalysisOptions',
+        data_extractors: Union[extractors.DataExtractors,
+                               extractors.PreAggregateExtractors]):
+    if options.pre_aggregated_data:
+        if not isinstance(data_extractors, extractors.PreAggregateExtractors):
+            raise ValueError(
+                "options.pre_aggregated_data is set to true but "
+                "PreAggregateExtractors aren't provided. "
+                "PreAggregateExtractors should be specified for "
+                "pre-aggregated data.")
+    elif not isinstance(data_extractors, extractors.DataExtractors):
+        raise ValueError("DataExtractors should be specified for raw data.")
+
+    params = options.aggregate_params
+    if params.custom_combiners is not None:
+        raise NotImplementedError("custom combiners are not supported")
+    supported = {
+        agg.Metrics.COUNT, agg.Metrics.SUM, agg.Metrics.PRIVACY_ID_COUNT
+    }
+    if not set(params.metrics).issubset(supported):
+        not_supported = list(set(params.metrics) - supported)
+        raise NotImplementedError(
+            f"unsupported metric in metrics={not_supported}")
+    if params.contribution_bounds_already_enforced:
+        raise NotImplementedError(
+            "utility analysis when contribution bounds are already enforced "
+            "is not supported")

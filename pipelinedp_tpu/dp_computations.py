@@ -20,6 +20,7 @@ from dataclasses import dataclass
 from typing import Any, List, Optional, Tuple, Union
 
 import numpy as np
+from scipy.special import log_ndtr
 
 from pipelinedp_tpu import aggregate_params
 from pipelinedp_tpu import budget_accounting
@@ -107,7 +108,11 @@ def gaussian_delta(sigma: float, eps: float, l2_sensitivity: float) -> float:
     d = l2_sensitivity
     a = d / (2 * sigma) - eps * sigma / d
     b = -d / (2 * sigma) - eps * sigma / d
-    return _norm_cdf(a) - math.exp(eps) * _norm_cdf(b)
+    # The second term is e^eps * Phi(b) with Phi(b) astronomically small for
+    # large eps — evaluate in log space to avoid math.exp overflow.
+    log_term = eps + log_ndtr(b)
+    second = math.exp(log_term) if log_term < 700 else math.inf
+    return _norm_cdf(a) - second
 
 
 def gaussian_sigma(eps: float,
@@ -620,9 +625,10 @@ class ExponentialMechanism:
               inputs_to_score_col: List[Any],
               scores: Optional[np.ndarray] = None) -> Any:
         """Samples one input with probability proportional to
-        exp(eps*score/(2*sensitivity)). `scores` may carry precomputed
-        (vectorized) scores for all inputs; otherwise score() is called
-        per input."""
+        exp(eps*score/sensitivity) for monotonic scoring functions, and
+        exp(eps*score/(2*sensitivity)) otherwise. `scores` may carry
+        precomputed (vectorized) scores for all inputs; otherwise score()
+        is called per input."""
         probs = self._calculate_probabilities(eps, inputs_to_score_col, scores)
         index = _rng.choice(len(inputs_to_score_col), p=probs)
         return inputs_to_score_col[index]

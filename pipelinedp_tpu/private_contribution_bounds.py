@@ -21,6 +21,10 @@ from pipelinedp_tpu import pipeline_functions
 from pipelinedp_tpu.dataset_histograms.histograms import Histogram
 
 
+# Weight of the noise impact vs the dropped-data impact in the score.
+_IMPACT_NOISE_WEIGHT = 0.5
+
+
 class L0ScoringFunction(dp_computations.ExponentialMechanism.ScoringFunction):
     """Scores max_partitions_contributed candidates (COUNT/PRIVACY_ID_COUNT).
 
@@ -44,9 +48,7 @@ class L0ScoringFunction(dp_computations.ExponentialMechanism.ScoringFunction):
                                     dtype=np.float64)
 
     def score(self, k: int) -> float:
-        impact_noise_weight = 0.5
-        return -(impact_noise_weight * self._l0_impact_noise(k) +
-                 (1 - impact_noise_weight) * self._l0_impact_dropped(k))
+        return float(self.score_all(np.array([k]))[0])
 
     def _max_partitions_contributed_best_upper_bound(self) -> int:
         return min(self._params.max_partitions_contributed_upper_bound,
@@ -80,17 +82,28 @@ class L0ScoringFunction(dp_computations.ExponentialMechanism.ScoringFunction):
         return float(np.sum(np.maximum(capped - k, 0) * self._bin_counts))
 
     def score_all(self, ks: np.ndarray) -> np.ndarray:
-        """Vectorized score for every candidate at once (TPU-first path)."""
+        """Vectorized score for every candidate at once.
+
+        The noise impact scales exactly linearly in k for Laplace (std =
+        sqrt(2)*k/eps) and as sqrt(k) for Gaussian (the analytic sigma is
+        linear in the l2 sensitivity sqrt(k)), so one base calibration at
+        k=1 covers all candidates; the dropped impact for all candidates is
+        one (n_candidates, n_bins) broadcast.
+        """
         ks = np.asarray(ks, dtype=np.float64)
         lowers, counts = self._bin_lowers, self._bin_counts
         capped = np.minimum(lowers,
                             self._max_partitions_contributed_best_upper_bound())
-        # (n_candidates, n_bins) broadcast
         dropped = np.sum(
             np.maximum(capped[None, :] - ks[:, None], 0) * counts[None, :],
             axis=1)
-        noise = np.array([self._l0_impact_noise(int(k)) for k in ks])
-        return -(0.5 * noise + 0.5 * dropped)
+        base_noise = self._l0_impact_noise(1)
+        if self._params.aggregation_noise_kind == agg_params.NoiseKind.LAPLACE:
+            noise = base_noise * ks
+        else:
+            noise = base_noise * np.sqrt(ks)
+        return -(_IMPACT_NOISE_WEIGHT * noise +
+                 (1 - _IMPACT_NOISE_WEIGHT) * dropped)
 
 
 class PrivateL0Calculator:

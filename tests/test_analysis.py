@@ -1,0 +1,416 @@
+"""Tests for the analysis package (modeled on the reference's
+analysis/tests/: data-structure validation, Poisson-binomial, per-partition
+combiners, cross-partition combiners, utility-analysis e2e, tuning e2e,
+pre-aggregation parity, dataset summary)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import analysis
+from pipelinedp_tpu import combiners as dp_combiners
+from pipelinedp_tpu.analysis import (cross_partition_combiners,
+                                     data_structures, metrics,
+                                     per_partition_combiners,
+                                     poisson_binomial)
+from pipelinedp_tpu.budget_accounting import MechanismSpec
+from pipelinedp_tpu.aggregate_params import MechanismType
+from pipelinedp_tpu.dataset_histograms import computing_histograms as ch
+
+BACKEND = pdp.LocalBackend()
+
+DATA = [(uid, f"pk{uid % 3}", 1.0 + (uid % 5)) for uid in range(30)
+        for _ in range(1 + uid % 2)]
+EXTRACTORS = pdp.DataExtractors(privacy_id_extractor=lambda x: x[0],
+                                partition_extractor=lambda x: x[1],
+                                value_extractor=lambda x: x[2])
+
+
+def _agg_params(metrics_list=None, **kwargs):
+    defaults = dict(
+        noise_kind=pdp.NoiseKind.GAUSSIAN,
+        metrics=metrics_list or [pdp.Metrics.COUNT],
+        max_partitions_contributed=1,
+        max_contributions_per_partition=1,
+    )
+    if metrics_list and pdp.Metrics.SUM in metrics_list:
+        defaults.update(min_sum_per_partition=0.0, max_sum_per_partition=5.0)
+    defaults.update(kwargs)
+    return pdp.AggregateParams(**defaults)
+
+
+class TestMultiParameterConfiguration:
+
+    def test_requires_one_attribute(self):
+        with pytest.raises(ValueError, match="at least 1"):
+            data_structures.MultiParameterConfiguration()
+
+    def test_same_length_enforced(self):
+        with pytest.raises(ValueError, match="same length"):
+            data_structures.MultiParameterConfiguration(
+                max_partitions_contributed=[1, 2],
+                max_contributions_per_partition=[1])
+
+    def test_min_max_sum_together(self):
+        with pytest.raises(ValueError, match="both set or both None"):
+            data_structures.MultiParameterConfiguration(
+                max_sum_per_partition=[1.0])
+
+    def test_get_aggregate_params(self):
+        config = data_structures.MultiParameterConfiguration(
+            max_partitions_contributed=[1, 2, 3])
+        params = _agg_params()
+        assert config.size == 3
+        got = [
+            config.get_aggregate_params(params, i).max_partitions_contributed
+            for i in range(3)
+        ]
+        assert got == [1, 2, 3]
+        # blueprint untouched
+        assert params.max_partitions_contributed == 1
+
+
+class TestPoissonBinomial:
+
+    def test_exact_binomial_case(self):
+        # equal ps → binomial pmf
+        pmf = poisson_binomial.compute_pmf([0.5] * 4)
+        expected = np.array([1, 4, 6, 4, 1]) / 16
+        np.testing.assert_allclose(pmf.probabilities, expected, atol=1e-12)
+        assert pmf.start == 0
+
+    def test_exact_sums_to_one(self):
+        rng = np.random.default_rng(5)
+        ps = rng.uniform(0, 1, size=50)
+        pmf = poisson_binomial.compute_pmf(list(ps))
+        assert pmf.probabilities.sum() == pytest.approx(1.0)
+
+    def test_approximation_close_to_exact(self):
+        rng = np.random.default_rng(7)
+        ps = list(rng.uniform(0.2, 0.9, size=200))
+        exact = poisson_binomial.compute_pmf(ps)
+        exp, std, skew = poisson_binomial.compute_exp_std_skewness(ps)
+        approx = poisson_binomial.compute_pmf_approximation(
+            exp, std, skew, len(ps))
+        # Compare overlapping region.
+        for i, p_approx in enumerate(approx.probabilities, approx.start):
+            assert p_approx == pytest.approx(exact.probabilities[i], abs=1e-3)
+
+    def test_approximation_zero_sigma(self):
+        pmf = poisson_binomial.compute_pmf_approximation(5.0, 0.0, 0.0, 10)
+        assert pmf.start == 5
+        np.testing.assert_array_equal(pmf.probabilities, [1.0])
+
+
+def _combiner_params(eps=1e6,
+                     delta=1e-6,
+                     metrics_list=None,
+                     **kwargs) -> dp_combiners.CombinerParams:
+    spec = MechanismSpec(MechanismType.GAUSSIAN)
+    spec.set_eps_delta(eps, delta)
+    return dp_combiners.CombinerParams(spec,
+                                       _agg_params(metrics_list, **kwargs))
+
+
+class TestPerPartitionCombiners:
+
+    def test_sum_combiner_accumulator(self):
+        params = _combiner_params(metrics_list=[pdp.Metrics.SUM],
+                                  max_partitions_contributed=2)
+        combiner = per_partition_combiners.SumCombiner(params)
+        counts = np.array([1, 1, 1])
+        sums = np.array([3.0, 7.0, -1.0])  # clip to [0, 5]
+        n_partitions = np.array([4, 1, 2])
+        acc = combiner.create_accumulator((counts, sums, n_partitions))
+        partition_sum, min_err, max_err, l0_err, l0_var = acc
+        assert partition_sum == pytest.approx(9.0)
+        assert min_err == pytest.approx(1.0)  # -1 → 0
+        assert max_err == pytest.approx(-2.0)  # 7 → 5
+        # keep probs: min(1, 2/4)=0.5, 1, 1 → contributions 3*0.5 dropped
+        assert l0_err == pytest.approx(-(3.0 * 0.5))
+        assert l0_var == pytest.approx(3.0**2 * 0.5 * 0.5)
+
+    def test_count_combiner_uses_counts(self):
+        params = _combiner_params(max_partitions_contributed=1,
+                                  max_contributions_per_partition=2)
+        combiner = per_partition_combiners.CountCombiner(params)
+        counts = np.array([3, 1])
+        sums = np.array([100.0, 100.0])  # ignored
+        n_partitions = np.array([1, 1])
+        acc = combiner.create_accumulator((counts, sums, n_partitions))
+        partition_sum, _, max_err, l0_err, _ = acc
+        assert partition_sum == pytest.approx(4.0)
+        assert max_err == pytest.approx(-1.0)  # 3 clipped to 2
+        assert l0_err == pytest.approx(0.0)
+
+    def test_privacy_id_count_combiner(self):
+        params = _combiner_params()
+        combiner = per_partition_combiners.PrivacyIdCountCombiner(params)
+        counts = np.array([5, 2, 0])
+        acc = combiner.create_accumulator(
+            (counts, np.zeros(3), np.array([1, 1, 1])))
+        assert acc[0] == pytest.approx(2.0)  # indicators: 1+1+0
+
+    def test_partition_selection_combiner_high_eps(self):
+        params = _combiner_params(eps=1e3, delta=1e-4)
+        combiner = per_partition_combiners.PartitionSelectionCombiner(params)
+        counts = np.array([1] * 50)
+        acc = combiner.create_accumulator(
+            (counts, np.zeros(50), np.ones(50, dtype=int)))
+        prob = combiner.compute_metrics(acc)
+        assert prob == pytest.approx(1.0, abs=1e-6)
+
+    def test_merge_switches_to_moments(self):
+        params = _combiner_params()
+        combiner = per_partition_combiners.PartitionSelectionCombiner(params)
+        big = ([0.5] * 80, None)
+        other = ([0.5] * 40, None)
+        probs, moments = combiner.merge_accumulators(big, other)
+        assert probs is None
+        assert moments.count == 120
+        assert moments.expectation == pytest.approx(60.0)
+
+    def test_raw_statistics_combiner(self):
+        combiner = per_partition_combiners.RawStatisticsCombiner()
+        acc = combiner.create_accumulator(
+            (np.array([2, 3, 1]), np.zeros(3), np.ones(3, dtype=int)))
+        assert combiner.compute_metrics(acc) == metrics.RawStatistics(
+            privacy_id_count=3, count=6)
+
+    def test_compound_sparse_to_dense(self):
+        params = _combiner_params()
+        compound = per_partition_combiners.CompoundCombiner(
+            [per_partition_combiners.CountCombiner(params)],
+            return_named_tuple=False)
+        acc = compound.create_accumulator((2, 4.0, 3))
+        assert acc[0] == ([2], [4.0], [3])
+        assert acc[1] is None
+        # merging > 2*n_combiners rows converts to dense (later small sparse
+        # residue may coexist with the dense part until compute_metrics)
+        for i in range(5):
+            acc = compound.merge_accumulators(
+                acc, compound.create_accumulator((1, 1.0, 1)))
+        _, dense = acc
+        assert dense is not None
+        result = compound.compute_metrics(acc)
+        assert len(result) == 1
+        assert result[0].sum == pytest.approx(7.0)  # counts 2+5*1
+
+
+class TestCrossPartitionCombiners:
+
+    def _sum_metrics(self, value=10.0):
+        return metrics.SumMetrics(aggregation=pdp.Metrics.COUNT,
+                                  sum=value,
+                                  clipping_to_min_error=0.0,
+                                  clipping_to_max_error=-2.0,
+                                  expected_l0_bounding_error=-3.0,
+                                  std_l0_bounding_error=2.0,
+                                  std_noise=4.0,
+                                  noise_kind=pdp.NoiseKind.GAUSSIAN)
+
+    def test_data_dropped(self):
+        info = cross_partition_combiners._sum_metrics_to_data_dropped(
+            self._sum_metrics(), 0.5, pdp.Metrics.COUNT)
+        assert info.l0 == pytest.approx(3.0)
+        assert info.linf == pytest.approx(2.0)
+        # survived = 10 - 3 - 2 = 5, dropped half
+        assert info.partition_selection == pytest.approx(2.5)
+
+    def test_value_errors(self):
+        err = cross_partition_combiners._sum_metrics_to_value_error(
+            self._sum_metrics(), keep_prob=1.0, weight=1.0)
+        assert err.mean == pytest.approx(-5.0)
+        assert err.variance == pytest.approx(4.0 + 16.0)
+        assert err.rmse == pytest.approx(np.sqrt(25.0 + 20.0))
+
+    def test_combiner_roundtrip_public(self):
+        combiner = cross_partition_combiners.CrossPartitionCombiner(
+            [pdp.Metrics.COUNT], public_partitions=True)
+        per_partition = metrics.PerPartitionMetrics(
+            1.0, metrics.RawStatistics(3, 6), [self._sum_metrics()])
+        acc = combiner.create_accumulator(per_partition)
+        acc = combiner.merge_accumulators(
+            acc, combiner.create_accumulator(per_partition))
+        report = combiner.compute_metrics(acc)
+        assert report.partitions_info.num_dataset_partitions == 2
+        assert len(report.metric_errors) == 1
+        # two identical partitions → averaged rmse equals single-partition
+        assert report.metric_errors[0].absolute_error.rmse == pytest.approx(
+            np.sqrt(45.0))
+
+
+class TestUtilityAnalysisE2E:
+
+    def test_public_partitions_single_config(self):
+        options = data_structures.UtilityAnalysisOptions(
+            epsilon=1e3,
+            delta=1e-5,
+            aggregate_params=_agg_params(
+                [pdp.Metrics.COUNT],
+                max_partitions_contributed=10,
+                max_contributions_per_partition=10))
+        public = ["pk0", "pk1", "pk2"]
+        reports_col, per_partition_col = analysis.perform_utility_analysis(
+            DATA, BACKEND, options, EXTRACTORS, public_partitions=public)
+        reports = list(reports_col)
+        assert len(reports) == 1
+        report = reports[0]
+        assert report.configuration_index == 0
+        assert report.partitions_info.public_partitions
+        assert report.partitions_info.num_dataset_partitions == 3
+        errors = report.metric_errors[0]
+        # bounds are loose → no contribution-bounding error
+        assert errors.absolute_error.mean == pytest.approx(0.0, abs=1e-9)
+        assert errors.ratio_data_dropped.l0 == pytest.approx(0.0, abs=1e-9)
+        # per-partition output exists for every (pk, config)
+        per_partition = list(per_partition_col)
+        assert len(per_partition) == 3
+        assert all(key[1] == 0 for key, _ in per_partition)
+
+    def test_private_partitions_multi_config(self):
+        config = data_structures.MultiParameterConfiguration(
+            max_partitions_contributed=[1, 5],
+            max_contributions_per_partition=[1, 5])
+        options = data_structures.UtilityAnalysisOptions(
+            epsilon=10,
+            delta=1e-5,
+            aggregate_params=_agg_params([pdp.Metrics.COUNT]),
+            multi_param_configuration=config)
+        reports_col, _ = analysis.perform_utility_analysis(
+            DATA, BACKEND, options, EXTRACTORS)
+        reports = sorted(list(reports_col),
+                         key=lambda r: r.configuration_index)
+        assert [r.configuration_index for r in reports] == [0, 1]
+        for report in reports:
+            assert not report.partitions_info.public_partitions
+            assert report.partitions_info.kept_partitions is not None
+            assert report.partitions_info.strategy is not None
+        # config 1 has looser bounds → less bounding error, more noise
+        drop0 = reports[0].metric_errors[0].ratio_data_dropped
+        drop1 = reports[1].metric_errors[0].ratio_data_dropped
+        assert drop0.l0 + drop0.linf >= drop1.l0 + drop1.linf
+        assert (reports[0].metric_errors[0].noise_std <
+                reports[1].metric_errors[0].noise_std)
+
+    def test_sum_analysis(self):
+        options = data_structures.UtilityAnalysisOptions(
+            epsilon=1e3,
+            delta=1e-5,
+            aggregate_params=_agg_params([pdp.Metrics.SUM],
+                                         max_partitions_contributed=10))
+        reports_col, _ = analysis.perform_utility_analysis(
+            DATA, BACKEND, options, EXTRACTORS,
+            public_partitions=["pk0", "pk1", "pk2"])
+        report = list(reports_col)[0]
+        assert report.metric_errors[0].metric == pdp.Metrics.SUM
+        assert report.utility_report_histogram is not None
+
+    def test_analyze_engine_rejects_aggregate(self):
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=1,
+                                               total_delta=1e-6)
+        engine = analysis.UtilityAnalysisEngine(accountant, BACKEND)
+        with pytest.raises(ValueError, match="can't be called"):
+            engine.aggregate(DATA, _agg_params(), EXTRACTORS)
+
+    def test_pre_aggregated_analysis(self):
+        preagg = list(analysis.preaggregate(DATA, BACKEND, EXTRACTORS))
+        pre_extractors = pdp.PreAggregateExtractors(
+            partition_extractor=lambda row: row[0],
+            preaggregate_extractor=lambda row: row[1])
+        options = data_structures.UtilityAnalysisOptions(
+            epsilon=1e3,
+            delta=1e-5,
+            aggregate_params=_agg_params(
+                [pdp.Metrics.COUNT],
+                max_partitions_contributed=10,
+                max_contributions_per_partition=10),
+            pre_aggregated_data=True)
+        reports_col, _ = analysis.perform_utility_analysis(
+            preagg, BACKEND, options, pre_extractors,
+            public_partitions=["pk0", "pk1", "pk2"])
+        report = list(reports_col)[0]
+        raw_options = dataclasses.replace(options, pre_aggregated_data=False)
+        raw_report = list(
+            analysis.perform_utility_analysis(
+                DATA, BACKEND, raw_options, EXTRACTORS,
+                public_partitions=["pk0", "pk1", "pk2"])[0])[0]
+        assert report.metric_errors[0].absolute_error.rmse == pytest.approx(
+            raw_report.metric_errors[0].absolute_error.rmse)
+
+
+class TestPreAggregation:
+
+    def test_preaggregate_values(self):
+        data = [(1, 'a', 2.0), (1, 'a', 3.0), (1, 'b', 1.0), (2, 'a', 4.0)]
+        ext = pdp.DataExtractors(privacy_id_extractor=lambda x: x[0],
+                                 partition_extractor=lambda x: x[1],
+                                 value_extractor=lambda x: x[2])
+        got = sorted(analysis.preaggregate(data, BACKEND, ext))
+        # (pk, (count, sum, n_partitions, n_contributions))
+        assert got == [('a', (1, 4.0, 1, 1)), ('a', (2, 5.0, 2, 3)),
+                       ('b', (1, 1.0, 2, 3))]
+
+
+class TestParameterTuning:
+
+    def test_constant_relative_step_candidates(self):
+        from pipelinedp_tpu.analysis import parameter_tuning as pt
+        h = ch._frequencies_to_histogram(
+            np.array([1, 10, 100]), np.array([5, 5, 5]),
+            name=__import__(
+                'pipelinedp_tpu.dataset_histograms.histograms',
+                fromlist=['HistogramType']).HistogramType.L0_CONTRIBUTIONS)
+        candidates = pt._find_candidates_constant_relative_step(h, 5)
+        assert candidates[0] == 1
+        assert candidates[-1] == 100
+        assert candidates == sorted(set(candidates))
+
+    def test_tune_e2e_count(self):
+        from pipelinedp_tpu.analysis import parameter_tuning as pt
+        histograms = list(
+            ch.compute_dataset_histograms(DATA, EXTRACTORS, BACKEND))[0]
+        options = pt.TuneOptions(
+            epsilon=10,
+            delta=1e-5,
+            aggregate_params=_agg_params([pdp.Metrics.COUNT]),
+            function_to_minimize=pt.MinimizingFunction.ABSOLUTE_ERROR,
+            parameters_to_tune=pt.ParametersToTune(
+                max_partitions_contributed=True,
+                max_contributions_per_partition=True),
+            number_of_parameter_candidates=9)
+        result_col, _ = pt.tune(DATA, BACKEND, histograms, options,
+                                EXTRACTORS,
+                                public_partitions=["pk0", "pk1", "pk2"])
+        result = list(result_col)[0]
+        assert isinstance(result, pt.TuneResult)
+        n = result.utility_analysis_parameters.size
+        assert 0 <= result.index_best < n
+        assert len(result.utility_reports) == n
+
+    def test_tune_rejects_two_metrics(self):
+        from pipelinedp_tpu.analysis import parameter_tuning as pt
+        options = pt.TuneOptions(
+            epsilon=1,
+            delta=1e-5,
+            aggregate_params=_agg_params(
+                [pdp.Metrics.COUNT, pdp.Metrics.PRIVACY_ID_COUNT]),
+            function_to_minimize=pt.MinimizingFunction.ABSOLUTE_ERROR,
+            parameters_to_tune=pt.ParametersToTune(
+                max_partitions_contributed=True))
+        with pytest.raises(ValueError, match="only one metric"):
+            pt._check_tune_args(options, True)
+
+
+class TestDatasetSummary:
+
+    def test_summary_counts(self):
+        public = ["pk0", "pk1", "pk_unused"]
+        summary = list(
+            analysis.compute_public_partitions_summary(
+                DATA, BACKEND, EXTRACTORS, public))[0]
+        assert summary.num_dataset_public_partitions == 2
+        assert summary.num_dataset_non_public_partitions == 1  # pk2
+        assert summary.num_empty_public_partitions == 1  # pk_unused
