@@ -40,6 +40,12 @@ from pipelinedp_tpu.data_extractors import (
 from pipelinedp_tpu.report_generator import ExplainComputationReport
 from pipelinedp_tpu.combiners import Combiner, CustomCombiner
 from pipelinedp_tpu.dp_engine import DPEngine
+from pipelinedp_tpu.private_collection import (
+    CombinePerKeyParams,
+    PrivateCollection,
+    PrivateCombineFn,
+    make_private,
+)
 from pipelinedp_tpu.pipeline_backend import (
     LocalBackend,
     MultiProcLocalBackend,

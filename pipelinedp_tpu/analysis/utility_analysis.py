@@ -90,13 +90,16 @@ def perform_utility_analysis(
     if public_partitions is None:
         strategies = data_structures.get_partition_selection_strategy(options)
 
-        def add_partition_selection_strategy(report: metrics.UtilityReport):
+        def add_partition_selection_strategy(key, report):
+            # key = (configuration_index, bucket); report.configuration_index
+            # is not populated until _group_utility_reports, so the config
+            # index must come from the key (fixes a reference bug where all
+            # reports get the last configuration's strategy).
             report = copy.deepcopy(report)
-            report.partitions_info.strategy = strategies[
-                report.configuration_index]
-            return report
+            report.partitions_info.strategy = strategies[key[0]]
+            return key, report
 
-        cross_partition_metrics = backend.map_values(
+        cross_partition_metrics = backend.map_tuple(
             cross_partition_metrics, add_partition_selection_strategy,
             "Add Partition Selection Strategy")
 

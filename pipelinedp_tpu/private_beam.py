@@ -1,0 +1,215 @@
+"""Beam-idiomatic private API: PrivatePCollection + private PTransforms.
+
+Mirrors the reference's pipeline_dp/private_beam.py:41-645 API surface
+(MakePrivate, Variance/Mean/Sum/Count/PrivacyIdCount/SelectPartitions,
+Map/FlatMap, PrivateCombineFn + CombinePerKey), delegating the shared
+param-conversion / engine-invocation logic to private_collection.py so the
+Beam layer is only the PTransform plumbing.
+
+Requires apache_beam; importing this module without it raises ImportError.
+"""
+
+from typing import Callable, Optional
+
+import apache_beam as beam
+from apache_beam import pvalue
+from apache_beam.transforms import ptransform
+
+from pipelinedp_tpu import aggregate_params
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu import dp_engine as dp_engine_mod
+from pipelinedp_tpu import data_extractors
+from pipelinedp_tpu import pipeline_backend
+from pipelinedp_tpu import private_collection
+from pipelinedp_tpu.private_collection import (  # re-export (reference parity)
+    CombinePerKeyParams, PrivateCombineFn,)
+
+# Beam requires globally-unique stage names; one shared BeamBackend provides
+# the unique-label generator for all private transforms
+# (reference private_beam.py:26-38).
+_beam_backend = None
+
+
+def _get_beam_backend() -> 'pipeline_backend.BeamBackend':
+    global _beam_backend
+    if _beam_backend is None:
+        _beam_backend = pipeline_backend.BeamBackend()
+    return _beam_backend
+
+
+class PrivatePTransform(ptransform.PTransform):
+    """Abstract base for private PTransforms (reference private_beam.py:41)."""
+
+    def __init__(self, return_anonymized: bool, label: Optional[str] = None):
+        label = _get_beam_backend()._ulg.unique(label)
+        super().__init__(label)
+        self._return_anonymized = return_anonymized
+        self._budget_accountant = None
+
+    def set_additional_parameters(
+            self, budget_accountant: budget_accounting.BudgetAccountant):
+        self._budget_accountant = budget_accountant
+
+    def __rrshift__(self, label):
+        self.label = _get_beam_backend()._ulg.unique(label)
+        return self
+
+    def expand(self, pcol: pvalue.PCollection) -> pvalue.PCollection:
+        raise NotImplementedError()
+
+
+class PrivatePCollection:
+    """Private counterpart of a PCollection: only DP-aggregated data can be
+    extracted, via PrivatePTransforms (reference private_beam.py:71-94)."""
+
+    def __init__(self, pcol: pvalue.PCollection,
+                 budget_accountant: budget_accounting.BudgetAccountant):
+        self._pcol = pcol
+        self._budget_accountant = budget_accountant
+
+    def __or__(self, private_transform: PrivatePTransform):
+        if not isinstance(private_transform, PrivatePTransform):
+            raise TypeError(
+                "private_transform should be of type PrivatePTransform but is "
+                f"{private_transform}")
+        private_transform.set_additional_parameters(
+            budget_accountant=self._budget_accountant)
+        transformed = self._pcol.pipeline.apply(private_transform, self._pcol)
+        if private_transform._return_anonymized:
+            return transformed
+        return PrivatePCollection(transformed, self._budget_accountant)
+
+
+class MakePrivate(PrivatePTransform):
+    """Wraps a PCollection into a PrivatePCollection."""
+
+    def __init__(self,
+                 budget_accountant: budget_accounting.BudgetAccountant,
+                 privacy_id_extractor: Callable,
+                 label: Optional[str] = None):
+        super().__init__(return_anonymized=False, label=label)
+        self._budget_accountant = budget_accountant
+        self._privacy_id_extractor = privacy_id_extractor
+
+    def expand(self, pcol: pvalue.PCollection):
+        backend = _get_beam_backend()
+        pcol = backend.map(pcol, lambda x: (self._privacy_id_extractor(x), x),
+                           "Extract privacy id")
+        return PrivatePCollection(pcol, self._budget_accountant)
+
+
+class _SingleMetricPTransform(PrivatePTransform):
+    """Shared body of the per-metric transforms: delegate to the
+    framework-neutral single-metric aggregation."""
+
+    _METRIC_NAME = None
+
+    def __init__(self,
+                 metric_params,
+                 label: Optional[str] = None,
+                 public_partitions=None,
+                 out_explain_computaton_report=None):
+        super().__init__(return_anonymized=True, label=label)
+        self._metric_params = metric_params
+        self._public_partitions = public_partitions
+        self._explain_computaton_report = out_explain_computaton_report
+
+    def expand(self, pcol: pvalue.PCollection) -> pvalue.PCollection:
+        return private_collection.run_single_metric_aggregation(
+            _get_beam_backend(), self._budget_accountant, pcol,
+            self._metric_params, self._METRIC_NAME, self._public_partitions,
+            self._explain_computaton_report)
+
+
+class Variance(_SingleMetricPTransform):
+    """DP variance per partition (reference private_beam.py:115)."""
+    _METRIC_NAME = 'variance'
+
+
+class Mean(_SingleMetricPTransform):
+    """DP mean per partition (reference private_beam.py:179)."""
+    _METRIC_NAME = 'mean'
+
+
+class Sum(_SingleMetricPTransform):
+    """DP sum per partition (reference private_beam.py:241)."""
+    _METRIC_NAME = 'sum'
+
+
+class Count(_SingleMetricPTransform):
+    """DP count per partition (reference private_beam.py:303)."""
+    _METRIC_NAME = 'count'
+
+
+class PrivacyIdCount(_SingleMetricPTransform):
+    """DP distinct-privacy-id count per partition
+    (reference private_beam.py:367)."""
+    _METRIC_NAME = 'privacy_id_count'
+
+
+class SelectPartitions(PrivatePTransform):
+    """DP partition-key selection (reference private_beam.py:428-452)."""
+
+    def __init__(
+            self,
+            select_partitions_params: aggregate_params.SelectPartitionsParams,
+            partition_extractor: Callable,
+            label: Optional[str] = None):
+        super().__init__(return_anonymized=True, label=label)
+        self._select_partitions_params = select_partitions_params
+        self._partition_extractor = partition_extractor
+
+    def expand(self, pcol: pvalue.PCollection) -> pvalue.PCollection:
+        backend = _get_beam_backend()
+        engine = dp_engine_mod.DPEngine(self._budget_accountant, backend)
+        extractors = data_extractors.DataExtractors(
+            partition_extractor=lambda x: self._partition_extractor(x[1]),
+            privacy_id_extractor=lambda x: x[0])
+        return engine.select_partitions(pcol, self._select_partitions_params,
+                                        extractors)
+
+
+class Map(PrivatePTransform):
+    """Non-anonymizing element transform (reference private_beam.py:455)."""
+
+    def __init__(self, fn: Callable, label: Optional[str] = None):
+        super().__init__(return_anonymized=False, label=label)
+        self._fn = fn
+
+    def expand(self, pcol: pvalue.PCollection):
+        return _get_beam_backend().map_values(pcol, self._fn, "Map")
+
+
+class FlatMap(PrivatePTransform):
+    """Non-anonymizing expansion (reference private_beam.py:469)."""
+
+    def __init__(self, fn: Callable, label: Optional[str] = None):
+        super().__init__(return_anonymized=False, label=label)
+        self._fn = fn
+
+    def expand(self, pcol: pvalue.PCollection):
+
+        def fn(row):
+            key = row[0]
+            for value in self._fn(row[1]):
+                yield key, value
+
+        return _get_beam_backend().flat_map(pcol, fn, "FlatMap")
+
+
+class CombinePerKey(PrivatePTransform):
+    """Custom private combine over (key, value) elements
+    (reference private_beam.py:603-644)."""
+
+    def __init__(self,
+                 combine_fn: PrivateCombineFn,
+                 params: CombinePerKeyParams,
+                 label: Optional[str] = None):
+        super().__init__(return_anonymized=True, label=label)
+        self._combine_fn = combine_fn
+        self._params = params
+
+    def expand(self, pcol: pvalue.PCollection):
+        return private_collection.run_combine_per_key(
+            _get_beam_backend(), self._budget_accountant, pcol,
+            self._combine_fn, self._params)

@@ -1,0 +1,324 @@
+"""Framework-neutral private-collection API (L5).
+
+The reference ships two framework-specific guarded APIs —
+``pipeline_dp/private_beam.py`` (PrivatePCollection + PTransforms) and
+``pipeline_dp/private_spark.py`` (PrivateRDD) — whose bodies are near-identical
+per metric: build ``AggregateParams`` from the convenience params, wrap
+extractors to peel the ``(privacy_id, element)`` pair, call
+``DPEngine.aggregate``, extract the single metric from the result tuple.
+
+The TPU-native design factors that shared logic here once, generic over any
+``PipelineBackend`` (Local, TPU, MultiProc, Beam, Spark). ``PrivateCollection``
+is the guarded container: only DP-aggregated data can leave it.
+``private_beam.py`` / ``private_spark.py`` are thin idiomatic adapters over
+these helpers.
+
+Reference parity: private_beam.py:41-645, private_spark.py:21-383.
+"""
+
+import abc
+import dataclasses
+import typing
+from typing import Any, Callable, Optional
+
+from pipelinedp_tpu import aggregate_params as agg
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu import combiners as dp_combiners
+from pipelinedp_tpu import data_extractors
+from pipelinedp_tpu import dp_engine as dp_engine_mod
+from pipelinedp_tpu import pipeline_backend
+from pipelinedp_tpu import report_generator
+
+
+def _privacy_id_extractor(contribution_bounds_already_enforced: bool):
+    """Privacy ids are unneeded when bounds were enforced upstream
+    (reference private_spark.py:368-374)."""
+    if contribution_bounds_already_enforced:
+        return None
+    return lambda x: x[0]
+
+
+def make_aggregate_params(metric_params, metric: agg.Metric,
+                          **overrides) -> agg.AggregateParams:
+    """Converts a per-metric convenience params dataclass into full
+    AggregateParams for `metric` (reference private_beam.py:272-280 et al.)."""
+    kwargs = dict(
+        noise_kind=metric_params.noise_kind,
+        metrics=[metric],
+        max_partitions_contributed=metric_params.max_partitions_contributed,
+        budget_weight=metric_params.budget_weight,
+        contribution_bounds_already_enforced=getattr(
+            metric_params, 'contribution_bounds_already_enforced', False),
+    )
+    kwargs['max_contributions_per_partition'] = getattr(
+        metric_params, 'max_contributions_per_partition', 1)
+    for field in ('min_value', 'max_value'):
+        if hasattr(metric_params, field):
+            kwargs[field] = getattr(metric_params, field)
+    kwargs.update(overrides)
+    return agg.AggregateParams(**kwargs)
+
+
+def make_pair_extractors(
+        metric_params,
+        needs_value: bool) -> data_extractors.DataExtractors:
+    """DataExtractors over (privacy_id, element) pairs: partition/value
+    extractors from the params apply to element = x[1]."""
+    enforced = getattr(metric_params, 'contribution_bounds_already_enforced',
+                       False)
+    value_extractor = ((lambda x: metric_params.value_extractor(x[1]))
+                       if needs_value else (lambda x: None))
+    return data_extractors.DataExtractors(
+        partition_extractor=lambda x: metric_params.partition_extractor(x[1]),
+        privacy_id_extractor=_privacy_id_extractor(enforced),
+        value_extractor=value_extractor)
+
+
+_METRIC_OF = {
+    'count': agg.Metrics.COUNT,
+    'sum': agg.Metrics.SUM,
+    'mean': agg.Metrics.MEAN,
+    'variance': agg.Metrics.VARIANCE,
+    'privacy_id_count': agg.Metrics.PRIVACY_ID_COUNT,
+}
+
+_NEEDS_VALUE = {'sum', 'mean', 'variance'}
+
+
+def run_single_metric_aggregation(
+        backend: pipeline_backend.PipelineBackend,
+        budget_accountant: budget_accounting.BudgetAccountant,
+        pair_col,
+        metric_params,
+        metric_name: str,
+        public_partitions=None,
+        out_explain_computation_report: Optional[
+            report_generator.ExplainComputationReport] = None):
+    """The shared body of every per-metric L5 transform: aggregate a
+    (privacy_id, element) collection for one metric and unnest the result.
+
+    Returns a collection of (partition_key, metric_value).
+    """
+    metric = _METRIC_OF[metric_name]
+    engine = dp_engine_mod.DPEngine(budget_accountant, backend)
+    overrides = {}
+    if metric_name == 'privacy_id_count':
+        overrides['max_contributions_per_partition'] = 1
+    params = make_aggregate_params(metric_params, metric, **overrides)
+    extractors = make_pair_extractors(metric_params,
+                                      metric_name in _NEEDS_VALUE)
+    dp_result = engine.aggregate(
+        pair_col,
+        params,
+        extractors,
+        public_partitions,
+        out_explain_computation_report=out_explain_computation_report)
+    # dp_result: (partition_key, MetricsTuple); extract the single metric.
+    return backend.map_values(dp_result,
+                              lambda v: getattr(v, metric_name),
+                              f"Extract {metric_name}")
+
+
+class PrivateCombineFn(abc.ABC):
+    """Base class for custom private combine fns (experimental).
+
+    Framework-neutral counterpart of reference private_beam.PrivateCombineFn
+    (private_beam.py:486-543): users implement their own DP mechanism in
+    extract_private_output() and contribution bounding in
+    add_input_for_private_output().
+
+    Warning: an advanced feature that can break DP guarantees if implemented
+    incorrectly.
+    """
+
+    @abc.abstractmethod
+    def create_accumulator(self):
+        """Creates an empty accumulator."""
+
+    @abc.abstractmethod
+    def add_input_for_private_output(self, accumulator, input: Any) -> Any:
+        """Adds an input that contributes to private output; should clip."""
+
+    @abc.abstractmethod
+    def merge_accumulators(self, accumulators):
+        """Merges an iterable of accumulators into one."""
+
+    @abc.abstractmethod
+    def extract_private_output(self, accumulator, budget: Any,
+                               aggregate_params: agg.AggregateParams) -> Any:
+        """Computes the DP output; `budget` is what request_budget returned."""
+
+    @abc.abstractmethod
+    def request_budget(
+            self,
+            budget_accountant: budget_accounting.BudgetAccountant) -> Any:
+        """Requests budget during graph construction; returns serializable
+        budget object(s). Never store the budget_accountant itself."""
+
+
+class _CombineFnCombiner(dp_combiners.CustomCombiner):
+    """Adapts a PrivateCombineFn to the engine's CustomCombiner protocol
+    (reference private_beam.py:546-578)."""
+
+    def __init__(self, private_combine_fn: PrivateCombineFn):
+        self._private_combine_fn = private_combine_fn
+
+    def create_accumulator(self, values):
+        accumulator = self._private_combine_fn.create_accumulator()
+        for v in values:
+            accumulator = (
+                self._private_combine_fn.add_input_for_private_output(
+                    accumulator, v))
+        return accumulator
+
+    def merge_accumulators(self, accumulator1, accumulator2):
+        return self._private_combine_fn.merge_accumulators(
+            [accumulator1, accumulator2])
+
+    def compute_metrics(self, accumulator):
+        return self._private_combine_fn.extract_private_output(
+            accumulator, self._budget, self._aggregate_params)
+
+    def explain_computation(self) -> str:
+        return "Custom private combine fn."
+
+    def request_budget(self,
+                       budget_accountant: budget_accounting.BudgetAccountant):
+        self._budget = self._private_combine_fn.request_budget(
+            budget_accountant)
+
+
+@dataclasses.dataclass
+class CombinePerKeyParams:
+    """Parameters for combine_per_key (reference private_beam.py:581-600)."""
+    max_partitions_contributed: int
+    max_contributions_per_partition: int
+    budget_weight: float = 1
+    public_partitions: typing.Any = None
+
+
+def run_combine_per_key(
+        backend: pipeline_backend.PipelineBackend,
+        budget_accountant: budget_accounting.BudgetAccountant,
+        pair_col,
+        combine_fn: PrivateCombineFn,
+        params: CombinePerKeyParams):
+    """Custom-combiner aggregation over (privacy_id, (partition_key, value))
+    pairs (reference private_beam.py:603-644)."""
+    combiner = _CombineFnCombiner(combine_fn)
+    aggregate_params = agg.AggregateParams(
+        metrics=None,
+        max_partitions_contributed=params.max_partitions_contributed,
+        max_contributions_per_partition=params.max_contributions_per_partition,
+        budget_weight=params.budget_weight,
+        custom_combiners=[combiner])
+    extractors = data_extractors.DataExtractors(
+        privacy_id_extractor=lambda x: x[0],
+        partition_extractor=lambda x: x[1][0],
+        value_extractor=lambda x: x[1][1])
+    engine = dp_engine_mod.DPEngine(budget_accountant, backend)
+    dp_result = engine.aggregate(pair_col, aggregate_params, extractors,
+                                 params.public_partitions)
+    # One custom combiner → 1-tuple per key; unnest.
+    return backend.map_values(dp_result, lambda v: v[0], "Unnest tuple")
+
+
+class PrivateCollection:
+    """Guarded collection: data can only leave via DP aggregations.
+
+    Backend-generic counterpart of reference PrivatePCollection
+    (private_beam.py:71-94) / PrivateRDD (private_spark.py:21-38). Holds
+    (privacy_id, element) pairs plus the budget accountant; every aggregation
+    method charges that accountant.
+    """
+
+    def __init__(self, col, backend: pipeline_backend.PipelineBackend,
+                 budget_accountant: budget_accounting.BudgetAccountant):
+        # Multiple aggregations may be charged against the same collection;
+        # lazy single-pass iterators (LocalBackend) must be made re-iterable.
+        self._col = backend.to_multi_transformable_collection(col)
+        self._backend = backend
+        self._budget_accountant = budget_accountant
+
+    def map(self, fn: Callable) -> 'PrivateCollection':
+        """Transforms elements, keeping privacy ids attached."""
+        col = self._backend.map_values(self._col, fn, "Private Map")
+        return PrivateCollection(col, self._backend, self._budget_accountant)
+
+    def flat_map(self, fn: Callable) -> 'PrivateCollection':
+        """Expands each element, keeping privacy ids attached."""
+
+        def unnest(row):
+            key, value = row
+            for v in fn(value):
+                yield key, v
+
+        col = self._backend.flat_map(self._col, unnest, "Private FlatMap")
+        return PrivateCollection(col, self._backend, self._budget_accountant)
+
+    def count(self, count_params: agg.CountParams, public_partitions=None,
+              out_explain_computation_report=None):
+        return run_single_metric_aggregation(
+            self._backend, self._budget_accountant, self._col, count_params,
+            'count', public_partitions, out_explain_computation_report)
+
+    def sum(self, sum_params: agg.SumParams, public_partitions=None,
+            out_explain_computation_report=None):
+        return run_single_metric_aggregation(
+            self._backend, self._budget_accountant, self._col, sum_params,
+            'sum', public_partitions, out_explain_computation_report)
+
+    def mean(self, mean_params: agg.MeanParams, public_partitions=None,
+             out_explain_computation_report=None):
+        return run_single_metric_aggregation(
+            self._backend, self._budget_accountant, self._col, mean_params,
+            'mean', public_partitions, out_explain_computation_report)
+
+    def variance(self, variance_params: agg.VarianceParams,
+                 public_partitions=None,
+                 out_explain_computation_report=None):
+        return run_single_metric_aggregation(
+            self._backend, self._budget_accountant, self._col,
+            variance_params, 'variance', public_partitions,
+            out_explain_computation_report)
+
+    def privacy_id_count(self,
+                         privacy_id_count_params: agg.PrivacyIdCountParams,
+                         public_partitions=None,
+                         out_explain_computation_report=None):
+        return run_single_metric_aggregation(
+            self._backend, self._budget_accountant, self._col,
+            privacy_id_count_params, 'privacy_id_count', public_partitions,
+            out_explain_computation_report)
+
+    def select_partitions(self, params: agg.SelectPartitionsParams,
+                          partition_extractor: Callable):
+        """DP set of partition keys (reference private_spark.py:340-366)."""
+        engine = dp_engine_mod.DPEngine(self._budget_accountant,
+                                        self._backend)
+        extractors = data_extractors.DataExtractors(
+            partition_extractor=lambda x: partition_extractor(x[1]),
+            privacy_id_extractor=lambda x: x[0])
+        return engine.select_partitions(self._col, params, extractors)
+
+    def combine_per_key(self, combine_fn: PrivateCombineFn,
+                        params: CombinePerKeyParams):
+        """Custom DP aggregation; elements must be (key, value) pairs."""
+        return run_combine_per_key(self._backend, self._budget_accountant,
+                                   self._col, combine_fn, params)
+
+
+def make_private(
+        col,
+        backend: pipeline_backend.PipelineBackend,
+        budget_accountant: budget_accounting.BudgetAccountant,
+        privacy_id_extractor: Optional[Callable] = None) -> PrivateCollection:
+    """Wraps a collection into a PrivateCollection.
+
+    If privacy_id_extractor is None the collection is assumed to already be
+    (privacy_id, element) pairs (reference private_spark.py:32-38).
+    """
+    if privacy_id_extractor is not None:
+        col = backend.map(col, lambda x: (privacy_id_extractor(x), x),
+                          "Extract privacy id")
+    return PrivateCollection(col, backend, budget_accountant)

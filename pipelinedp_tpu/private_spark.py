@@ -1,0 +1,116 @@
+"""Spark-idiomatic private API: PrivateRDD.
+
+Mirrors the reference's pipeline_dp/private_spark.py:21-383 API surface
+(make_private, PrivateRDD.{map,flat_map,count,sum,mean,variance,
+privacy_id_count,select_partitions}), delegating the shared logic to
+private_collection.py.
+
+Requires pyspark; importing this module without it raises ImportError.
+"""
+
+from typing import Callable, Optional
+
+from pyspark import RDD
+
+from pipelinedp_tpu import aggregate_params
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu import data_extractors
+from pipelinedp_tpu import dp_engine as dp_engine_mod
+from pipelinedp_tpu import pipeline_backend
+from pipelinedp_tpu import private_collection
+
+
+class PrivateRDD:
+    """A guarded RDD: only DP-aggregated data can be extracted
+    (reference private_spark.py:21-38). Keeps (privacy_id, element) pairs."""
+
+    def __init__(self, rdd, budget_accountant, privacy_id_extractor=None):
+        if privacy_id_extractor:
+            self._rdd = rdd.map(lambda x: (privacy_id_extractor(x), x))
+        else:
+            # rdd is assumed to already be (privacy_id, element) pairs.
+            self._rdd = rdd
+        self._budget_accountant = budget_accountant
+
+    def _backend(self):
+        return pipeline_backend.SparkRDDBackend(self._rdd.context)
+
+    def map(self, fn: Callable) -> 'PrivateRDD':
+        """Spark map equivalent; privacy ids stay attached."""
+        return make_private(self._rdd.mapValues(fn), self._budget_accountant,
+                            None)
+
+    def flat_map(self, fn: Callable) -> 'PrivateRDD':
+        """Spark flatMap equivalent; privacy ids stay attached."""
+        return make_private(self._rdd.flatMapValues(fn),
+                            self._budget_accountant, None)
+
+    def _single_metric(self, metric_params, metric_name: str,
+                       public_partitions, out_explain_computaton_report):
+        return private_collection.run_single_metric_aggregation(
+            self._backend(), self._budget_accountant, self._rdd,
+            metric_params, metric_name, public_partitions,
+            out_explain_computaton_report)
+
+    def variance(self,
+                 variance_params: aggregate_params.VarianceParams,
+                 public_partitions=None,
+                 out_explain_computaton_report=None) -> RDD:
+        """DP variance per partition (reference private_spark.py:62)."""
+        return self._single_metric(variance_params, 'variance',
+                                   public_partitions,
+                                   out_explain_computaton_report)
+
+    def mean(self,
+             mean_params: aggregate_params.MeanParams,
+             public_partitions=None,
+             out_explain_computaton_report=None) -> RDD:
+        """DP mean per partition (reference private_spark.py:120)."""
+        return self._single_metric(mean_params, 'mean', public_partitions,
+                                   out_explain_computaton_report)
+
+    def sum(self,
+            sum_params: aggregate_params.SumParams,
+            public_partitions=None,
+            out_explain_computaton_report=None) -> RDD:
+        """DP sum per partition (reference private_spark.py:178)."""
+        return self._single_metric(sum_params, 'sum', public_partitions,
+                                   out_explain_computaton_report)
+
+    def count(self,
+              count_params: aggregate_params.CountParams,
+              public_partitions=None,
+              out_explain_computaton_report=None) -> RDD:
+        """DP count per partition (reference private_spark.py:234)."""
+        return self._single_metric(count_params, 'count', public_partitions,
+                                   out_explain_computaton_report)
+
+    def privacy_id_count(self,
+                         privacy_id_count_params: aggregate_params.
+                         PrivacyIdCountParams,
+                         public_partitions=None,
+                         out_explain_computaton_report=None) -> RDD:
+        """DP distinct-privacy-id count (reference private_spark.py:288)."""
+        return self._single_metric(privacy_id_count_params,
+                                   'privacy_id_count', public_partitions,
+                                   out_explain_computaton_report)
+
+    def select_partitions(
+            self, select_partitions_params: aggregate_params.
+            SelectPartitionsParams, partition_extractor: Callable) -> RDD:
+        """DP partition-key selection (reference private_spark.py:340)."""
+        engine = dp_engine_mod.DPEngine(self._budget_accountant,
+                                        self._backend())
+        extractors = data_extractors.DataExtractors(
+            partition_extractor=lambda x: partition_extractor(x[1]),
+            privacy_id_extractor=lambda x: x[0])
+        return engine.select_partitions(self._rdd, select_partitions_params,
+                                        extractors)
+
+
+def make_private(
+        rdd,
+        budget_accountant: budget_accounting.BudgetAccountant,
+        privacy_id_extractor: Optional[Callable] = None) -> PrivateRDD:
+    """Wraps an RDD into a PrivateRDD (reference private_spark.py:377)."""
+    return PrivateRDD(rdd, budget_accountant, privacy_id_extractor)

@@ -295,6 +295,26 @@ class TestUtilityAnalysisE2E:
         assert (reports[0].metric_errors[0].noise_std <
                 reports[1].metric_errors[0].noise_std)
 
+    def test_strategy_sweep_annotates_each_config_with_own_strategy(self):
+        # Regression: reference annotates every report with the LAST config's
+        # strategy (configuration_index is unset when the annotation runs).
+        strategies = [
+            pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC,
+            pdp.PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING,
+        ]
+        config = data_structures.MultiParameterConfiguration(
+            partition_selection_strategy=strategies)
+        options = data_structures.UtilityAnalysisOptions(
+            epsilon=10,
+            delta=1e-5,
+            aggregate_params=_agg_params([pdp.Metrics.COUNT]),
+            multi_param_configuration=config)
+        reports_col, _ = analysis.perform_utility_analysis(
+            DATA, BACKEND, options, EXTRACTORS)
+        reports = sorted(list(reports_col),
+                         key=lambda r: r.configuration_index)
+        assert [r.partitions_info.strategy for r in reports] == strategies
+
     def test_sum_analysis(self):
         options = data_structures.UtilityAnalysisOptions(
             epsilon=1e3,

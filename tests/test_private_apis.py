@@ -1,0 +1,219 @@
+"""Tests for the L5 private-collection APIs (PrivateCollection + adapters).
+
+Mirrors the reference test approach for private_beam/private_spark
+(tests/private_beam_test.py, tests/private_spark_test.py): huge-epsilon
+determinism + public partitions for value checks, plus guarded-container
+semantics (map/flat_map keep privacy ids).
+"""
+
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import private_collection
+
+HUGE_EPS = 1e7
+
+
+def make_backend(name):
+    if name == "local":
+        return pdp.LocalBackend(seed=7)
+    return pdp.TPUBackend(noise_seed=7)
+
+
+BACKENDS = ["local", "tpu"]
+
+# rows: (uid, city, spend)
+ROWS = [
+    ("u1", "NY", 1.0),
+    ("u1", "NY", 2.0),
+    ("u1", "SF", 3.0),
+    ("u2", "NY", 4.0),
+    ("u2", "SF", 1.0),
+    ("u3", "NY", 2.0),
+]
+
+
+def _private(backend, accountant):
+    return pdp.make_private(ROWS, backend, accountant,
+                            privacy_id_extractor=lambda r: r[0])
+
+
+@pytest.mark.parametrize("backend_name", BACKENDS)
+class TestPrivateCollectionMetrics:
+
+    def _run(self, backend_name, method, params_cls, needs_values=True,
+             **extra):
+        backend = make_backend(backend_name)
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-5)
+        pcol = _private(backend, accountant)
+        kwargs = dict(
+            max_partitions_contributed=4,
+            partition_extractor=lambda r: r[1],
+            **extra,
+        )
+        if needs_values:
+            kwargs.update(min_value=0.0, max_value=10.0,
+                          value_extractor=lambda r: r[2])
+        params = params_cls(**kwargs)
+        result = getattr(pcol, method)(params,
+                                       public_partitions=["NY", "SF"])
+        accountant.compute_budgets()
+        return dict(result)
+
+    def test_count(self, backend_name):
+        got = self._run(backend_name, "count", pdp.CountParams,
+                        needs_values=False,
+                        noise_kind=pdp.NoiseKind.LAPLACE,
+                        max_contributions_per_partition=4)
+        assert got["NY"] == pytest.approx(4, abs=0.1)
+        assert got["SF"] == pytest.approx(2, abs=0.1)
+
+    def test_sum(self, backend_name):
+        got = self._run(backend_name, "sum", pdp.SumParams,
+                        max_contributions_per_partition=4)
+        assert got["NY"] == pytest.approx(9.0, abs=0.1)
+        assert got["SF"] == pytest.approx(4.0, abs=0.1)
+
+    def test_mean(self, backend_name):
+        got = self._run(backend_name, "mean", pdp.MeanParams,
+                        max_contributions_per_partition=4)
+        assert got["NY"] == pytest.approx(9.0 / 4, abs=0.1)
+        assert got["SF"] == pytest.approx(2.0, abs=0.1)
+
+    def test_variance(self, backend_name):
+        got = self._run(backend_name, "variance", pdp.VarianceParams,
+                        max_contributions_per_partition=4)
+        # NY values 1,2,4,2 → var 1.1875
+        assert got["NY"] == pytest.approx(1.1875, abs=0.3)
+
+    def test_privacy_id_count(self, backend_name):
+        got = self._run(backend_name, "privacy_id_count",
+                        pdp.PrivacyIdCountParams, needs_values=False,
+                        noise_kind=pdp.NoiseKind.LAPLACE)
+        assert got["NY"] == pytest.approx(3, abs=0.1)
+        assert got["SF"] == pytest.approx(2, abs=0.1)
+
+
+@pytest.mark.parametrize("backend_name", BACKENDS)
+class TestPrivateCollectionTransforms:
+
+    def test_map_keeps_privacy_ids(self, backend_name):
+        backend = make_backend(backend_name)
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-5)
+        pcol = _private(backend, accountant).map(lambda r:
+                                                 (r[0], r[1], r[2] * 2))
+        result = pcol.sum(
+            pdp.SumParams(max_partitions_contributed=4,
+                          max_contributions_per_partition=4,
+                          min_value=0.0,
+                          max_value=20.0,
+                          partition_extractor=lambda r: r[1],
+                          value_extractor=lambda r: r[2]),
+            public_partitions=["NY"])
+        accountant.compute_budgets()
+        got = dict(result)
+        assert got["NY"] == pytest.approx(18.0, abs=0.1)
+
+    def test_flat_map_keeps_privacy_ids(self, backend_name):
+        backend = make_backend(backend_name)
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-5)
+        pcol = _private(backend, accountant).flat_map(lambda r: [r, r])
+        result = pcol.count(
+            pdp.CountParams(noise_kind=pdp.NoiseKind.LAPLACE,
+                            max_partitions_contributed=4,
+                            max_contributions_per_partition=10,
+                            partition_extractor=lambda r: r[1]),
+            public_partitions=["NY"])
+        accountant.compute_budgets()
+        got = dict(result)
+        assert got["NY"] == pytest.approx(8, abs=0.1)
+
+    def test_select_partitions(self, backend_name):
+        backend = make_backend(backend_name)
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-5)
+        pcol = _private(backend, accountant)
+        got = pcol.select_partitions(
+            pdp.SelectPartitionsParams(max_partitions_contributed=2),
+            partition_extractor=lambda r: r[1])
+        accountant.compute_budgets()
+        assert sorted(got) == ["NY", "SF"]
+
+
+class _SumCombineFn(private_collection.PrivateCombineFn):
+    """Toy custom combine fn: clipped sum + Laplace noise via the budget."""
+
+    def create_accumulator(self):
+        return 0.0
+
+    def add_input_for_private_output(self, accumulator, value):
+        return accumulator + min(max(value, 0.0), 5.0)
+
+    def merge_accumulators(self, accumulators):
+        return sum(accumulators)
+
+    def extract_private_output(self, accumulator, budget, aggregate_params):
+        # huge-eps test: return the (near-noiseless) clipped sum
+        assert budget.eps > 0
+        return accumulator
+
+    def request_budget(self, budget_accountant):
+        return budget_accountant.request_budget(pdp.MechanismType.LAPLACE)
+
+
+@pytest.mark.parametrize("backend_name", BACKENDS)
+def test_combine_per_key_custom_fn(backend_name):
+    backend = make_backend(backend_name)
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                           total_delta=1e-5)
+    # elements: (uid, (key, value))
+    pairs = [(r[0], (r[1], r[2])) for r in ROWS]
+    pcol = pdp.make_private(pairs, backend, accountant)
+    got = pcol.combine_per_key(
+        _SumCombineFn(),
+        pdp.CombinePerKeyParams(max_partitions_contributed=4,
+                                max_contributions_per_partition=4,
+                                public_partitions=["NY", "SF"]))
+    accountant.compute_budgets()
+    got = dict(got)
+    assert got["NY"] == pytest.approx(9.0, abs=0.01)
+    assert got["SF"] == pytest.approx(4.0, abs=0.01)
+
+
+@pytest.mark.parametrize("backend_name", BACKENDS)
+def test_multiple_aggregations_on_same_collection(backend_name):
+    # Regression: the (privacy_id, element) collection must be re-iterable —
+    # the second aggregation used to see an exhausted generator.
+    backend = make_backend(backend_name)
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                           total_delta=1e-5)
+    pcol = _private(backend, accountant)
+    sum_res = pcol.sum(
+        pdp.SumParams(max_partitions_contributed=4,
+                      max_contributions_per_partition=4,
+                      min_value=0.0, max_value=10.0,
+                      partition_extractor=lambda r: r[1],
+                      value_extractor=lambda r: r[2]),
+        public_partitions=["NY"])
+    count_res = pcol.count(
+        pdp.CountParams(noise_kind=pdp.NoiseKind.LAPLACE,
+                        max_partitions_contributed=4,
+                        max_contributions_per_partition=4,
+                        partition_extractor=lambda r: r[1]),
+        public_partitions=["NY"])
+    accountant.compute_budgets()
+    assert dict(sum_res)["NY"] == pytest.approx(9.0, abs=0.1)
+    assert dict(count_res)["NY"] == pytest.approx(4, abs=0.1)
+
+
+def test_beam_adapter_requires_beam():
+    pytest.importorskip("apache_beam")
+    from pipelinedp_tpu import private_beam  # noqa: F401
+
+
+def test_spark_adapter_requires_spark():
+    pytest.importorskip("pyspark")
+    from pipelinedp_tpu import private_spark  # noqa: F401
