@@ -36,6 +36,32 @@ def seed_mechanism_rng(seed: Optional[int]) -> None:
     _rng = np.random.default_rng(seed)
 
 
+# Secure-noise mode: host-side mechanisms sample snapped discrete noise from
+# the native integer-only samplers (pipelinedp_tpu/native) instead of numpy
+# floating-point draws — the counterpart of the reference's PyDP secure
+# noise (SURVEY.md §2.4 row 1). Off by default: distributionally identical,
+# but slower, and unavailable if the C++ library cannot be built.
+_secure_noise = False
+
+
+def use_secure_noise(enable: bool = True) -> None:
+    """Enables snapped secure noise for host-side additive mechanisms.
+
+    Raises RuntimeError if the native library is unavailable."""
+    global _secure_noise
+    if enable:
+        from pipelinedp_tpu import native
+        if not native.available():
+            raise RuntimeError(
+                "Secure noise requires the native DP primitives library "
+                "(pipelinedp_tpu/native), which failed to build/load.")
+    _secure_noise = enable
+
+
+def secure_noise_enabled() -> bool:
+    return _secure_noise
+
+
 @dataclass
 class ScalarNoiseParams:
     """Parameters for computing DP sum, count, mean, variance."""
@@ -154,13 +180,23 @@ def compute_sigma(eps: float, delta: float, l2_sensitivity: float) -> float:
 
 def apply_laplace_mechanism(value: float, eps: float, l1_sensitivity: float):
     """value + Laplace(b = l1_sensitivity / eps) (reference :120-133)."""
+    if _secure_noise:
+        from pipelinedp_tpu import native
+        return float(
+            native.secure_laplace_add(np.asarray([float(value)]),
+                                      l1_sensitivity / eps)[0])
     return value + _rng.laplace(0, l1_sensitivity / eps)
 
 
 def apply_gaussian_mechanism(value: float, eps: float, delta: float,
                              l2_sensitivity: float):
     """value + N(0, sigma^2) with analytic sigma (reference :136-152)."""
-    return value + _rng.normal(0, gaussian_sigma(eps, delta, l2_sensitivity))
+    sigma = gaussian_sigma(eps, delta, l2_sensitivity)
+    if _secure_noise:
+        from pipelinedp_tpu import native
+        return float(
+            native.secure_gaussian_add(np.asarray([float(value)]), sigma)[0])
+    return value + _rng.normal(0, sigma)
 
 
 def _add_random_noise(value: float, eps: float, delta: float,
@@ -385,6 +421,11 @@ class LaplaceMechanism(AdditiveMechanism):
         return LaplaceMechanism(1 / b, l1_sensitivity)
 
     def add_noise(self, value: Union[int, float]) -> float:
+        if _secure_noise:
+            from pipelinedp_tpu import native
+            return float(
+                native.secure_laplace_add(np.asarray([float(value)]),
+                                          self.noise_parameter)[0])
         return float(value) + _rng.laplace(0, self.noise_parameter)
 
     @property
@@ -442,6 +483,11 @@ class GaussianMechanism(AdditiveMechanism):
                                  l2_sensitivity)
 
     def add_noise(self, value: Union[int, float]) -> float:
+        if _secure_noise:
+            from pipelinedp_tpu import native
+            return float(
+                native.secure_gaussian_add(np.asarray([float(value)]),
+                                           self._sigma)[0])
         return float(value) + _rng.normal(0, self._sigma)
 
     @property

@@ -1,0 +1,462 @@
+// Native DP primitives: secure noise sampling, analytic Gaussian
+// calibration, and partition-selection closed forms.
+//
+// The reference delegates these to Google's differential-privacy C++ library
+// through PyDP (SURVEY.md §2.4): secure snapped Laplace noise
+// (pipeline_dp/dp_computations.py:131-133), analytic Gaussian sigma
+// (dp_computations.py:117), and truncated-geometric / thresholding partition
+// selection (pipeline_dp/partition_selection.py:29-44). This library is the
+// TPU build's native equivalent, exposed over a plain C ABI consumed via
+// ctypes (pipelinedp_tpu/native/__init__.py).
+//
+// Secure noise design: integer-only samplers from Canonne, Kamath &
+// Steinke, "The Discrete Gaussian for Differential Privacy" (NeurIPS 2020),
+// Algorithms 1-3 — Bernoulli(exp(-γ)) from coin flips, discrete Laplace,
+// discrete Gaussian — on a power-of-two granularity grid. No floating-point
+// arithmetic participates in sampling, which removes the classic
+// floating-point attack on naive Laplace (Mironov 2012) that the reference's
+// C++ core also defends against ("snapping"). Randomness comes from the OS
+// CSPRNG (getrandom/urandom), buffered; a deterministic xoshiro256** mode
+// exists for tests only.
+//
+// Build: g++ -O3 -shared -fPIC (see Makefile). No external dependencies.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+#if defined(__linux__)
+#include <sys/random.h>
+#else
+#include <cstdio>
+#endif
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Randomness: buffered OS CSPRNG, with a test-only deterministic mode.
+// ---------------------------------------------------------------------------
+
+constexpr size_t kBufBytes = 1 << 16;
+
+thread_local unsigned char g_buf[kBufBytes];
+thread_local size_t g_buf_pos = kBufBytes;
+thread_local bool g_test_mode = false;
+thread_local uint64_t g_test_state[4];
+
+uint64_t rotl(uint64_t x, int k) { return (x << k) | (x >> (64 - k)); }
+
+// xoshiro256** — test mode only, never used for real DP noise.
+uint64_t test_next() {
+  uint64_t* s = g_test_state;
+  const uint64_t result = rotl(s[1] * 5, 7) * 9;
+  const uint64_t t = s[1] << 17;
+  s[2] ^= s[0];
+  s[3] ^= s[1];
+  s[1] ^= s[2];
+  s[0] ^= s[3];
+  s[2] ^= t;
+  s[3] = rotl(s[3], 45);
+  return result;
+}
+
+void refill_secure() {
+#if defined(__linux__)
+  size_t got = 0;
+  while (got < kBufBytes) {
+    ssize_t r = getrandom(g_buf + got, kBufBytes - got, 0);
+    if (r > 0) got += static_cast<size_t>(r);
+  }
+#else
+  FILE* f = std::fopen("/dev/urandom", "rb");
+  if (f) {
+    size_t got = std::fread(g_buf, 1, kBufBytes, f);
+    (void)got;
+    std::fclose(f);
+  }
+#endif
+  g_buf_pos = 0;
+}
+
+uint64_t rand_u64() {
+  if (g_test_mode) return test_next();
+  if (g_buf_pos + 8 > kBufBytes) refill_secure();
+  uint64_t v;
+  std::memcpy(&v, g_buf + g_buf_pos, 8);
+  g_buf_pos += 8;
+  return v;
+}
+
+// Uniform integer in [0, bound) without modulo bias (rejection).
+uint64_t uniform_below(uint64_t bound) {
+  if (bound <= 1) return 0;
+  const uint64_t limit = UINT64_MAX - UINT64_MAX % bound;
+  uint64_t r;
+  do {
+    r = rand_u64();
+  } while (r >= limit);
+  return r % bound;
+}
+
+using u128 = unsigned __int128;
+
+u128 rand_u128() {
+  return (static_cast<u128>(rand_u64()) << 64) | rand_u64();
+}
+
+u128 uniform_below_128(u128 bound) {
+  if (bound <= 1) return 0;
+  const u128 kMax = ~static_cast<u128>(0);
+  const u128 limit = kMax - kMax % bound;
+  u128 r;
+  do {
+    r = rand_u128();
+  } while (r >= limit);
+  return r % bound;
+}
+
+// Exact Bernoulli(a/b) for a <= b (128-bit rationals).
+bool bernoulli_frac(u128 a, u128 b) {
+  if (a >= b) return true;
+  return uniform_below_128(b) < a;
+}
+
+// Keep b small enough that b * k cannot overflow 128 bits inside
+// bernoulli_exp_le1's loop (k stays tiny with overwhelming probability, but
+// correctness must not depend on that). Precision loss is <= 2^-96.
+void normalize_frac(u128* a, u128* b) {
+  const u128 kLimit = static_cast<u128>(1) << 96;
+  while (*b >= kLimit) {
+    *a >>= 1;
+    *b >>= 1;
+  }
+}
+
+// CKS20 Algorithm 1 (gamma <= 1): Bernoulli(exp(-a/b)).
+bool bernoulli_exp_le1(u128 a, u128 b) {
+  normalize_frac(&a, &b);
+  uint64_t k = 1;
+  for (;;) {
+    if (!bernoulli_frac(a, b * k)) break;
+    ++k;
+  }
+  return (k & 1) == 1;
+}
+
+// CKS20 Algorithm 1 (general gamma = a/b >= 0): Bernoulli(exp(-a/b)).
+bool bernoulli_exp(u128 a, u128 b) {
+  while (a > b) {  // peel off exp(-1) factors
+    if (!bernoulli_exp_le1(1, 1)) return false;
+    a -= b;
+  }
+  return bernoulli_exp_le1(a, b);
+}
+
+// CKS20 Algorithm 2: discrete Laplace, P(z) proportional to exp(-|z| s / t).
+int64_t discrete_laplace(uint64_t t, uint64_t s) {
+  for (;;) {
+    const uint64_t u = uniform_below(t);
+    if (!bernoulli_exp_le1(u, t)) continue;
+    uint64_t v = 0;
+    while (bernoulli_exp_le1(1, 1)) ++v;
+    const uint64_t x = u + t * v;
+    const uint64_t y = x / s;
+    const bool sign = (rand_u64() & 1) != 0;
+    if (sign && y == 0) continue;
+    return sign ? -static_cast<int64_t>(y) : static_cast<int64_t>(y);
+  }
+}
+
+// CKS20 Algorithm 3: discrete Gaussian with variance sigma2 = num/den.
+int64_t discrete_gaussian(uint64_t sigma2_num, uint64_t sigma2_den) {
+  // t = floor(sigma) + 1
+  const double sigma =
+      std::sqrt(static_cast<double>(sigma2_num) /
+                static_cast<double>(sigma2_den));
+  const uint64_t t = static_cast<uint64_t>(std::floor(sigma)) + 1;
+  for (;;) {
+    const int64_t y = discrete_laplace(t, 1);
+    const uint64_t ay = static_cast<uint64_t>(y < 0 ? -y : y);
+    // gamma = (|y| - sigma2/t)^2 / (2 sigma2)
+    //       = (|y| t den - num)^2 / (2 num den t^2)
+    const u128 ytd = static_cast<u128>(ay) * t * sigma2_den;
+    const u128 diff = ytd > sigma2_num ? ytd - sigma2_num : sigma2_num - ytd;
+    const u128 gnum = diff * diff;
+    const u128 gden = static_cast<u128>(2) * sigma2_num * sigma2_den * t * t;
+    if (bernoulli_exp(gnum, gden)) return y;
+  }
+}
+
+// Power-of-two granularity g = 2^(ceil(log2(scale)) - bits).
+double granularity(double scale, int bits) {
+  int e;
+  std::frexp(scale, &e);  // scale = m * 2^e, m in [0.5, 1)
+  return std::ldexp(1.0, e - bits);
+}
+
+// ---------------------------------------------------------------------------
+// Normal-distribution helpers (for calibration / thresholding closed forms).
+// ---------------------------------------------------------------------------
+
+double norm_cdf(double x) { return 0.5 * std::erfc(-x / std::sqrt(2.0)); }
+
+// log Phi(x), stable for very negative x (asymptotic series).
+double log_ndtr(double x) {
+  if (x > -10.0) return std::log(norm_cdf(x));
+  const double x2 = x * x;
+  // Phi(x) ~ phi(x)/(-x) * (1 - 1/x^2 + 3/x^4 - 15/x^6 + 105/x^8)
+  const double series =
+      1.0 - 1.0 / x2 + 3.0 / (x2 * x2) - 15.0 / (x2 * x2 * x2) +
+      105.0 / (x2 * x2 * x2 * x2);
+  return -0.5 * x2 - 0.5 * std::log(2.0 * M_PI) - std::log(-x) +
+         std::log(series);
+}
+
+// Phi^{-1}(p) via Acklam's rational approximation + one Halley refinement.
+double norm_ppf(double p) {
+  static const double a[] = {-3.969683028665376e+01, 2.209460984245205e+02,
+                             -2.759285104469687e+02, 1.383577518672690e+02,
+                             -3.066479806614716e+01, 2.506628277459239e+00};
+  static const double b[] = {-5.447609879822406e+01, 1.615858368580409e+02,
+                             -1.556989798598866e+02, 6.680131188771972e+01,
+                             -1.328068155288572e+01};
+  static const double c[] = {-7.784894002430293e-03, -3.223964580411365e-01,
+                             -2.400758277161838e+00, -2.549732539343734e+00,
+                             4.374664141464968e+00,  2.938163982698783e+00};
+  static const double d[] = {7.784695709041462e-03, 3.224671290700398e-01,
+                             2.445134137142996e+00, 3.754408661907416e+00};
+  const double plow = 0.02425, phigh = 1 - plow;
+  double x;
+  if (p < plow) {
+    const double q = std::sqrt(-2 * std::log(p));
+    x = (((((c[0] * q + c[1]) * q + c[2]) * q + c[3]) * q + c[4]) * q + c[5]) /
+        ((((d[0] * q + d[1]) * q + d[2]) * q + d[3]) * q + 1);
+  } else if (p <= phigh) {
+    const double q = p - 0.5, r = q * q;
+    x = (((((a[0] * r + a[1]) * r + a[2]) * r + a[3]) * r + a[4]) * r + a[5]) *
+        q /
+        (((((b[0] * r + b[1]) * r + b[2]) * r + b[3]) * r + b[4]) * r + 1);
+  } else {
+    const double q = std::sqrt(-2 * std::log(1 - p));
+    x = -(((((c[0] * q + c[1]) * q + c[2]) * q + c[3]) * q + c[4]) * q +
+          c[5]) /
+        ((((d[0] * q + d[1]) * q + d[2]) * q + d[3]) * q + 1);
+  }
+  // Halley refinement against erfc for full double precision.
+  const double e = norm_cdf(x) - p;
+  const double u = e * std::sqrt(2.0 * M_PI) * std::exp(x * x / 2.0);
+  x = x - u / (1 + x * u / 2);
+  return x;
+}
+
+}  // namespace
+
+extern "C" {
+
+// --- RNG control -----------------------------------------------------------
+
+void dpn_seed_test_rng(uint64_t seed) {
+  // splitmix64 expansion of the seed into xoshiro state.
+  uint64_t z = seed;
+  for (int i = 0; i < 4; ++i) {
+    z += 0x9e3779b97f4a7c15ULL;
+    uint64_t t = z;
+    t = (t ^ (t >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    t = (t ^ (t >> 27)) * 0x94d049bb133111ebULL;
+    g_test_state[i] = t ^ (t >> 31);
+  }
+  g_test_mode = true;
+}
+
+void dpn_use_secure_rng() {
+  g_test_mode = false;
+  g_buf_pos = kBufBytes;  // force refill
+}
+
+// --- Secure noise ----------------------------------------------------------
+
+// Adds snapped discrete-Laplace noise with the given scale to each value:
+// out[i] = g * (round(values[i]/g) + Z_i), Z_i ~ DLap on the granularity
+// grid, g = 2^(ceil log2 scale) * 2^-40.
+void dpn_secure_laplace_add(const double* values, double* out, int64_t n,
+                            double scale) {
+  const double g = granularity(scale, 40);
+  // scale/g in [2^39, 2^40]; rational approximation t/s with s = 2^20.
+  const uint64_t s = static_cast<uint64_t>(1) << 20;
+  const uint64_t t =
+      static_cast<uint64_t>(std::llround(scale / g * static_cast<double>(s)));
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t z = discrete_laplace(t, s);
+    const double snapped = std::nearbyint(values[i] / g);
+    out[i] = g * (snapped + static_cast<double>(z));
+  }
+}
+
+// Adds snapped discrete-Gaussian noise with the given stddev:
+// g = 2^(ceil log2 sigma) * 2^-20 (so sigma/g ~ 2^20 keeps the CKS
+// rationals inside 128-bit arithmetic).
+void dpn_secure_gaussian_add(const double* values, double* out, int64_t n,
+                             double sigma) {
+  const double g = granularity(sigma, 20);
+  const double si = sigma / g;  // in [2^19, 2^20]
+  const uint64_t den = static_cast<uint64_t>(1) << 20;
+  const uint64_t num =
+      static_cast<uint64_t>(std::llround(si * si * static_cast<double>(den)));
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t z = discrete_gaussian(num, den);
+    const double snapped = std::nearbyint(values[i] / g);
+    out[i] = g * (snapped + static_cast<double>(z));
+  }
+}
+
+// Raw discrete samplers (granularity-1 grid), for tests and host tooling.
+void dpn_discrete_laplace(uint64_t t, uint64_t s, int64_t* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) out[i] = discrete_laplace(t, s);
+}
+
+void dpn_discrete_gaussian(uint64_t sigma2_num, uint64_t sigma2_den,
+                           int64_t* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = discrete_gaussian(sigma2_num, sigma2_den);
+}
+
+// --- Analytic Gaussian calibration (Balle & Wang 2018) --------------------
+
+double dpn_gaussian_delta(double sigma, double eps, double l2) {
+  const double a = l2 / (2 * sigma) - eps * sigma / l2;
+  const double b = -l2 / (2 * sigma) - eps * sigma / l2;
+  const double log_term = eps + log_ndtr(b);
+  const double second = log_term < 700 ? std::exp(log_term) : INFINITY;
+  return norm_cdf(a) - second;
+}
+
+double dpn_gaussian_sigma(double eps, double delta, double l2) {
+  double hi = l2 * std::sqrt(2 * std::log(1.25 / delta)) / eps + 1e-12;
+  while (dpn_gaussian_delta(hi, eps, l2) > delta) hi *= 2;
+  double lo = hi;
+  while (dpn_gaussian_delta(lo, eps, l2) < delta && lo > 1e-300) lo /= 2;
+  for (int i = 0; i < 200; ++i) {
+    const double mid = (lo + hi) / 2;
+    if (dpn_gaussian_delta(mid, eps, l2) > delta)
+      lo = mid;
+    else
+      hi = mid;
+    if (hi - lo <= 1e-12 * hi) break;
+  }
+  return hi;
+}
+
+// --- Partition selection closed forms --------------------------------------
+// Semantics match pipelinedp_tpu/partition_selection.py (the Python/JAX
+// reference implementations); pre_threshold < 0 means "none".
+
+namespace {
+int64_t shift_pre_threshold(int64_t count, int64_t pre_threshold) {
+  return pre_threshold < 0 ? count : count - (pre_threshold - 1);
+}
+}  // namespace
+
+void dpn_truncated_geometric_prob_keep(double eps, double delta, int64_t l0,
+                                       int64_t pre_threshold,
+                                       const int64_t* counts, double* out,
+                                       int64_t n) {
+  const double eps1 = eps / static_cast<double>(l0);
+  const double d1 = delta / static_cast<double>(l0);
+  const double tanh_half = std::tanh(eps1 / 2);
+  const int64_t n_cross =
+      1 + static_cast<int64_t>(
+              std::floor(std::log1p(tanh_half * (1.0 - d1) / d1) / eps1));
+  const double log_d1 = std::log(d1);
+  const double log_denom = std::log1p(-std::exp(-eps1));
+  auto phase1 = [&](double m) {
+    const double log_pi = log_d1 + (m - 1.0) * eps1 +
+                          std::log1p(-std::exp(-m * eps1)) - log_denom;
+    return std::exp(std::fmin(log_pi, 0.0));
+  };
+  const double pi_cross = phase1(static_cast<double>(n_cross));
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t c = shift_pre_threshold(counts[i], pre_threshold);
+    if (c <= 0) {
+      out[i] = 0.0;
+      continue;
+    }
+    double p;
+    if (c <= n_cross) {
+      p = std::fmin(phase1(static_cast<double>(c)), 1.0);
+    } else {
+      const double k = static_cast<double>(c - n_cross);
+      const double decay = std::exp(-k * eps1);
+      const double geo =
+          std::exp(-eps1) * (1.0 - decay) / (1.0 - std::exp(-eps1));
+      const double q = decay * (1.0 - pi_cross) - d1 * geo;
+      p = 1.0 - std::fmax(q, 0.0);
+    }
+    out[i] = std::fmin(std::fmax(p, 0.0), 1.0);
+  }
+}
+
+double dpn_laplace_threshold(double eps, double delta, int64_t l0) {
+  const double b = static_cast<double>(l0) / eps;
+  const double delta_p =
+      -std::expm1(std::log1p(-delta) / static_cast<double>(l0));
+  if (delta_p <= 0.5) return 1.0 - b * std::log(2 * delta_p);
+  return 1.0 + b * std::log(2 - 2 * delta_p);
+}
+
+void dpn_laplace_prob_keep(double eps, double delta, int64_t l0,
+                           int64_t pre_threshold, const int64_t* counts,
+                           double* out, int64_t n) {
+  const double b = static_cast<double>(l0) / eps;
+  const double threshold = dpn_laplace_threshold(eps, delta, l0);
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t c = shift_pre_threshold(counts[i], pre_threshold);
+    if (c <= 0) {
+      out[i] = 0.0;
+      continue;
+    }
+    const double z = (static_cast<double>(c) - threshold) / b;
+    out[i] = z >= 0 ? 1.0 - 0.5 * std::exp(-z) : 0.5 * std::exp(z);
+  }
+}
+
+// Writes {sigma, threshold} for Gaussian thresholding.
+void dpn_gaussian_thresholding_params(double eps, double delta, int64_t l0,
+                                      double* sigma_out,
+                                      double* threshold_out) {
+  const double noise_delta = delta / 2;
+  const double threshold_delta = delta - noise_delta;
+  const double sigma = dpn_gaussian_sigma(
+      eps, noise_delta, std::sqrt(static_cast<double>(l0)));
+  const double delta_p =
+      -std::expm1(std::log1p(-threshold_delta) / static_cast<double>(l0));
+  *sigma_out = sigma;
+  *threshold_out = 1.0 + sigma * norm_ppf(1.0 - delta_p);
+}
+
+void dpn_gaussian_prob_keep(double eps, double delta, int64_t l0,
+                            int64_t pre_threshold, const int64_t* counts,
+                            double* out, int64_t n) {
+  double sigma, threshold;
+  dpn_gaussian_thresholding_params(eps, delta, l0, &sigma, &threshold);
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t c = shift_pre_threshold(counts[i], pre_threshold);
+    if (c <= 0) {
+      out[i] = 0.0;
+      continue;
+    }
+    const double z = (threshold - static_cast<double>(c)) / sigma;
+    out[i] = 0.5 * std::erfc(z / std::sqrt(2.0));
+  }
+}
+
+// Samples keep decisions from precomputed probabilities (secure RNG).
+void dpn_sample_keep(const double* probs, uint8_t* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    // 53-bit uniform in [0, 1)
+    const double u =
+        static_cast<double>(rand_u64() >> 11) * 0x1.0p-53;
+    out[i] = u < probs[i] ? 1 : 0;
+  }
+}
+
+}  // extern "C"

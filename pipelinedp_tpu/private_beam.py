@@ -11,7 +11,6 @@ Requires apache_beam; importing this module without it raises ImportError.
 
 from typing import Callable, Optional
 
-import apache_beam as beam
 from apache_beam import pvalue
 from apache_beam.transforms import ptransform
 
@@ -108,11 +107,16 @@ class _SingleMetricPTransform(PrivatePTransform):
                  metric_params,
                  label: Optional[str] = None,
                  public_partitions=None,
-                 out_explain_computaton_report=None):
+                 out_explain_computaton_report=None,
+                 out_explain_computation_report=None):
+        # Both kwarg spellings accepted: the misspelled one is reference
+        # parity (private_beam.py:122), the correct one matches
+        # DPEngine.aggregate and PrivateCollection.
         super().__init__(return_anonymized=True, label=label)
         self._metric_params = metric_params
         self._public_partitions = public_partitions
-        self._explain_computaton_report = out_explain_computaton_report
+        self._explain_computaton_report = (out_explain_computation_report or
+                                           out_explain_computaton_report)
 
     def expand(self, pcol: pvalue.PCollection) -> pvalue.PCollection:
         return private_collection.run_single_metric_aggregation(

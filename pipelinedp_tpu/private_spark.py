@@ -46,54 +46,68 @@ class PrivateRDD:
                             self._budget_accountant, None)
 
     def _single_metric(self, metric_params, metric_name: str,
-                       public_partitions, out_explain_computaton_report):
+                       public_partitions, out_explain_computaton_report,
+                       out_explain_computation_report):
+        # Both kwarg spellings accepted: the misspelled one is reference
+        # parity (private_spark.py:67 et al.), the correct one matches
+        # DPEngine.aggregate and PrivateCollection.
+        report = out_explain_computation_report or out_explain_computaton_report
         return private_collection.run_single_metric_aggregation(
             self._backend(), self._budget_accountant, self._rdd,
-            metric_params, metric_name, public_partitions,
-            out_explain_computaton_report)
+            metric_params, metric_name, public_partitions, report)
 
     def variance(self,
                  variance_params: aggregate_params.VarianceParams,
                  public_partitions=None,
-                 out_explain_computaton_report=None) -> RDD:
+                 out_explain_computaton_report=None,
+                 out_explain_computation_report=None) -> RDD:
         """DP variance per partition (reference private_spark.py:62)."""
         return self._single_metric(variance_params, 'variance',
                                    public_partitions,
-                                   out_explain_computaton_report)
+                                   out_explain_computaton_report,
+                                   out_explain_computation_report)
 
     def mean(self,
              mean_params: aggregate_params.MeanParams,
              public_partitions=None,
-             out_explain_computaton_report=None) -> RDD:
+             out_explain_computaton_report=None,
+             out_explain_computation_report=None) -> RDD:
         """DP mean per partition (reference private_spark.py:120)."""
         return self._single_metric(mean_params, 'mean', public_partitions,
-                                   out_explain_computaton_report)
+                                   out_explain_computaton_report,
+                                   out_explain_computation_report)
 
     def sum(self,
             sum_params: aggregate_params.SumParams,
             public_partitions=None,
-            out_explain_computaton_report=None) -> RDD:
+            out_explain_computaton_report=None,
+            out_explain_computation_report=None) -> RDD:
         """DP sum per partition (reference private_spark.py:178)."""
         return self._single_metric(sum_params, 'sum', public_partitions,
-                                   out_explain_computaton_report)
+                                   out_explain_computaton_report,
+                                   out_explain_computation_report)
 
     def count(self,
               count_params: aggregate_params.CountParams,
               public_partitions=None,
-              out_explain_computaton_report=None) -> RDD:
+              out_explain_computaton_report=None,
+              out_explain_computation_report=None) -> RDD:
         """DP count per partition (reference private_spark.py:234)."""
         return self._single_metric(count_params, 'count', public_partitions,
-                                   out_explain_computaton_report)
+                                   out_explain_computaton_report,
+                                   out_explain_computation_report)
 
     def privacy_id_count(self,
                          privacy_id_count_params: aggregate_params.
                          PrivacyIdCountParams,
                          public_partitions=None,
-                         out_explain_computaton_report=None) -> RDD:
+                         out_explain_computaton_report=None,
+                         out_explain_computation_report=None) -> RDD:
         """DP distinct-privacy-id count (reference private_spark.py:288)."""
         return self._single_metric(privacy_id_count_params,
                                    'privacy_id_count', public_partitions,
-                                   out_explain_computaton_report)
+                                   out_explain_computaton_report,
+                                   out_explain_computation_report)
 
     def select_partitions(
             self, select_partitions_params: aggregate_params.
