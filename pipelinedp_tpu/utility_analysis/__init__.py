@@ -1,0 +1,21 @@
+"""Legacy utility-analysis helpers: data peeker + sketch engine.
+
+Counterpart of the reference's top-level ``utility_analysis/`` package
+(SURVEY.md §2.3, last four rows): partition-sampled sketches, raw sampling,
+true (non-DP) aggregation, and approximate DP aggregation directly on
+sketches. The reference's ``raw_accumulator.py`` is dead code (imports a
+removed module) and is deliberately not reproduced.
+
+The modern analysis stack lives in ``pipelinedp_tpu.analysis``; these tools
+remain for notebook-style interactive parameter exploration.
+"""
+
+from pipelinedp_tpu.utility_analysis.data_peeker import (
+    DataPeeker,
+    SampleParams,
+)
+from pipelinedp_tpu.utility_analysis.peeker_engine import (
+    PeekerEngine,
+    aggregate_sketch_true,
+)
+from pipelinedp_tpu.utility_analysis import non_private_combiners

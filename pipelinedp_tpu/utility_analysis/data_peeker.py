@@ -1,0 +1,141 @@
+"""Data peeker: partition-sampled sketches and raw aggregates for tuning.
+
+Counterpart of reference utility_analysis/data_peeker.py:71-270. These are
+NOT DP operations — outputs contain raw data and exist solely to explore a
+dataset while choosing aggregation parameters; nothing they produce should be
+released.
+
+The sketch format is (partition_key, per_user_aggregated_value,
+partition_count): one entry per unique (partition_key, privacy_id), where
+partition_count is how many (sampled) partitions that privacy id touches.
+PeekerEngine consumes these sketches for fast approximate DP aggregation.
+"""
+
+import dataclasses
+import functools
+from typing import Any, Optional, Sequence
+
+from pipelinedp_tpu import data_extractors as data_extractors_mod
+from pipelinedp_tpu import pipeline_backend
+from pipelinedp_tpu.utility_analysis import non_private_combiners
+
+
+@dataclasses.dataclass(frozen=True)
+class SampleParams:
+    """Sampling configuration (reference data_peeker.py:48-51)."""
+    number_of_sampled_partitions: int
+    metrics: Optional[Sequence] = None
+
+
+def _extract_fn(extractors: data_extractors_mod.DataExtractors, row):
+    return (extractors.privacy_id_extractor(row),
+            extractors.partition_extractor(row),
+            extractors.value_extractor(row))
+
+
+class DataPeeker:
+    """Sampling / sketching / true-aggregation helpers
+    (reference data_peeker.py:71-270)."""
+
+    def __init__(self, backend: pipeline_backend.PipelineBackend):
+        self._be = backend
+
+    def _sample_partitions(self, col, n_partitions: int):
+        """(pk, (pid, v)) rows → same rows restricted to n sampled pks."""
+        col = self._be.group_by_key(col, "Group by pk")
+        col = self._be.map_tuple(col, lambda pk, pid_v_seq:
+                                 (1, (pk, list(pid_v_seq))),
+                                 "Rekey to (1, (pk, rows))")
+        col = self._be.sample_fixed_per_key(col, n_partitions,
+                                            "Sample partitions")
+        col = self._be.flat_map(col, lambda kv: kv[1], "Extract sampled")
+        # col: (pk, [(pid, v)])
+        return col
+
+    def sketch(self, input_data, params: SampleParams,
+               data_extractors: data_extractors_mod.DataExtractors):
+        """Builds (partition_key, value, partition_count) sketches over a
+        partition sample (reference data_peeker.py:78-180).
+
+        Only a single COUNT or SUM metric is supported — the sketch stores
+        one scalar per (pk, pid)."""
+        if params.metrics is None:
+            raise ValueError("Must provide aggregation metrics for sketch.")
+        from pipelinedp_tpu.aggregate_params import Metrics
+        if len(params.metrics) != 1 or params.metrics[0] not in (
+                Metrics.SUM, Metrics.COUNT):
+            raise ValueError("Sketch only supports a single aggregation and "
+                             "it must be COUNT or SUM.")
+        combiner = non_private_combiners.create_compound_combiner(
+            params.metrics)
+
+        col = self._be.map(input_data,
+                           functools.partial(_extract_fn, data_extractors),
+                           "Extract (pid, pk, value)")
+        col = self._be.map_tuple(col, lambda pid, pk, v: (pk, (pid, v)),
+                                 "Rekey to (pk, (pid, value))")
+        col = self._sample_partitions(col,
+                                      params.number_of_sampled_partitions)
+
+        def unnest(kv):
+            pk, pid_v_list = kv
+            return [((pk, pid), v) for pid, v in pid_v_list]
+
+        col = self._be.flat_map(col, unnest, "Flatten to ((pk, pid), value)")
+        col = self._be.group_by_key(col, "Group by (pk, pid)")
+        col = self._be.map_values(col, combiner.create_accumulator,
+                                  "Aggregate per (pk, pid)")
+        # ((pk, pid), (scalar_acc,))
+        col = self._be.map_tuple(
+            col, lambda pk_pid, acc: (pk_pid[1], (pk_pid[0], acc[0])),
+            "Rekey to (pid, (pk, value))")
+        col = self._be.group_by_key(col, "Group by privacy id")
+
+        def flatten_with_partition_count(kv):
+            _, pk_value_list = kv
+            pk_value_list = list(pk_value_list)
+            partition_count = len(set(pk for pk, _ in pk_value_list))
+            return [(pk, value, partition_count)
+                    for pk, value in pk_value_list]
+
+        return self._be.flat_map(col, flatten_with_partition_count,
+                                 "Flatten to (pk, value, partition_count)")
+
+    def sample(self, input_data, params: SampleParams,
+               data_extractors: data_extractors_mod.DataExtractors):
+        """Returns all (pid, pk, value) rows of a sample of partitions
+        (reference data_peeker.py:182-223)."""
+        col = self._be.map(input_data,
+                           functools.partial(_extract_fn, data_extractors),
+                           "Extract (pid, pk, value)")
+        col = self._be.map_tuple(col, lambda pid, pk, v: (pk, (pid, v)),
+                                 "Rekey to (pk, (pid, value))")
+        col = self._sample_partitions(col,
+                                      params.number_of_sampled_partitions)
+
+        def expand(kv):
+            pk, pid_v_list = kv
+            return [(pid, pk, v) for pid, v in pid_v_list]
+
+        return self._be.flat_map(col, expand, "Expand to (pid, pk, value)")
+
+    def aggregate_true(self, col, params: SampleParams,
+                       data_extractors: data_extractors_mod.DataExtractors):
+        """Raw per-partition aggregates, no noise, no bounding
+        (reference data_peeker.py:225-270)."""
+        combiner = non_private_combiners.create_compound_combiner(
+            params.metrics)
+        col = self._be.map(col,
+                           functools.partial(_extract_fn, data_extractors),
+                           "Extract (pid, pk, value)")
+        col = self._be.map_tuple(col, lambda pid, pk, v: ((pid, pk), v),
+                                 "Rekey to ((pid, pk), value)")
+        col = self._be.group_by_key(col, "Group by (pid, pk)")
+        col = self._be.map_values(col, combiner.create_accumulator,
+                                  "Aggregate per (pid, pk)")
+        col = self._be.map_tuple(col, lambda pid_pk, acc: (pid_pk[1], acc),
+                                 "Drop privacy id")
+        col = self._be.combine_accumulators_per_key(
+            col, combiner, "Combine accumulators per partition")
+        return self._be.map_values(col, combiner.compute_metrics,
+                                   "Compute raw metrics")

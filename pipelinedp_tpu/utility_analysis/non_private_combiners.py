@@ -1,0 +1,185 @@
+"""Raw (non-DP) combiners for utility analysis ground truth.
+
+Counterpart of reference utility_analysis/non_private_combiners.py:28-213:
+plain count/sum/privacy-id-count/mean/variance combiners plus a compound
+combiner, used by the data peeker to compute true aggregates that DP results
+are compared against.
+"""
+
+from collections import namedtuple
+from typing import Iterable, List, Sized, Tuple
+
+from pipelinedp_tpu import combiners as dp_combiners
+from pipelinedp_tpu.aggregate_params import Metrics
+
+
+class RawCountCombiner(dp_combiners.Combiner):
+    """Non-private count; accumulator is the element count."""
+    AccumulatorType = int
+
+    def create_accumulator(self, values: Sized) -> int:
+        return len(values)
+
+    def merge_accumulators(self, count1: int, count2: int) -> int:
+        return count1 + count2
+
+    def compute_metrics(self, count: int) -> float:
+        return count
+
+    def metrics_names(self) -> List[str]:
+        return ['non_private_count']
+
+    def explain_computation(self):
+        return "Raw count (no DP)."
+
+
+class RawPrivacyIdCountCombiner(dp_combiners.Combiner):
+    """Non-private distinct-privacy-id count (1 per grouped unit)."""
+    AccumulatorType = int
+
+    def create_accumulator(self, values: Sized) -> int:
+        return 1 if values else 0
+
+    def merge_accumulators(self, acc1: int, acc2: int) -> int:
+        return acc1 + acc2
+
+    def compute_metrics(self, acc: int) -> float:
+        return acc
+
+    def metrics_names(self) -> List[str]:
+        return ['non_private_privacy_id_count']
+
+    def explain_computation(self):
+        return "Raw privacy-id count (no DP)."
+
+
+class RawSumCombiner(dp_combiners.Combiner):
+    """Non-private sum."""
+    AccumulatorType = float
+
+    def create_accumulator(self, values: Iterable[float]) -> float:
+        return sum(values)
+
+    def merge_accumulators(self, sum1: float, sum2: float) -> float:
+        return sum1 + sum2
+
+    def compute_metrics(self, acc: float) -> float:
+        return acc
+
+    def metrics_names(self) -> List[str]:
+        return ['non_private_sum']
+
+    def explain_computation(self):
+        return "Raw sum (no DP)."
+
+
+MeanTuple = namedtuple('MeanTuple', ['count', 'sum', 'mean'])
+
+
+class RawMeanCombiner(dp_combiners.Combiner):
+    """Non-private mean (returns count/sum/mean)."""
+    AccumulatorType = Tuple[int, float]
+
+    def create_accumulator(self, values: Iterable[float]):
+        values = list(values)
+        return len(values), sum(values)
+
+    def merge_accumulators(self, acc1, acc2):
+        return acc1[0] + acc2[0], acc1[1] + acc2[1]
+
+    def compute_metrics(self, acc) -> MeanTuple:
+        count, total = acc
+        return MeanTuple(count=count,
+                         sum=total,
+                         mean=total / count if count else None)
+
+    def metrics_names(self) -> List[str]:
+        return ['non_private_mean']
+
+    def explain_computation(self):
+        return "Raw mean (no DP)."
+
+
+VarianceTuple = namedtuple('VarianceTuple',
+                           ['count', 'sum', 'mean', 'variance'])
+
+
+class RawVarianceCombiner(dp_combiners.Combiner):
+    """Non-private population variance (returns count/sum/mean/variance)."""
+    AccumulatorType = Tuple[int, float, float]
+
+    def create_accumulator(self, values: Iterable[float]):
+        values = list(values)
+        return (len(values), sum(values), sum(v * v for v in values))
+
+    def merge_accumulators(self, acc1, acc2):
+        return (acc1[0] + acc2[0], acc1[1] + acc2[1], acc1[2] + acc2[2])
+
+    def compute_metrics(self, acc) -> VarianceTuple:
+        count, total, sum_squares = acc
+        if not count:
+            return VarianceTuple(count=0, sum=total, mean=None, variance=None)
+        mean = total / count
+        return VarianceTuple(count=count,
+                             sum=total,
+                             mean=mean,
+                             variance=sum_squares / count - mean * mean)
+
+    def metrics_names(self) -> List[str]:
+        return ['non_private_variance']
+
+    def explain_computation(self):
+        return "Raw variance (no DP)."
+
+
+class CompoundCombiner(dp_combiners.Combiner):
+    """Delegating compound of raw combiners; accumulator is a tuple of the
+    child accumulators (reference non_private_combiners.py:155-197)."""
+
+    AccumulatorType = Tuple
+
+    def __init__(self, combiners: Iterable[dp_combiners.Combiner]):
+        self._combiners = list(combiners)
+        self._metrics_to_compute = []
+        for combiner in self._combiners:
+            self._metrics_to_compute.extend(combiner.metrics_names())
+        if len(self._metrics_to_compute) != len(set(self._metrics_to_compute)):
+            raise ValueError(
+                f"two combiners in {combiners} cannot compute the same "
+                "metrics")
+
+    def create_accumulator(self, values) -> Tuple:
+        return tuple(c.create_accumulator(values) for c in self._combiners)
+
+    def merge_accumulators(self, acc1: Tuple, acc2: Tuple) -> Tuple:
+        return tuple(
+            c.merge_accumulators(a1, a2)
+            for c, a1, a2 in zip(self._combiners, acc1, acc2))
+
+    def compute_metrics(self, acc: Tuple) -> list:
+        return [
+            c.compute_metrics(a) for c, a in zip(self._combiners, acc)
+        ]
+
+    def metrics_names(self) -> List[str]:
+        return list(self._metrics_to_compute)
+
+    def explain_computation(self):
+        return [c.explain_computation() for c in self._combiners]
+
+
+def create_compound_combiner(metrics) -> CompoundCombiner:
+    """Builds a compound of raw combiners for the requested metrics
+    (reference non_private_combiners.py:200-213)."""
+    combiners = []
+    if Metrics.COUNT in metrics:
+        combiners.append(RawCountCombiner())
+    if Metrics.SUM in metrics:
+        combiners.append(RawSumCombiner())
+    if Metrics.PRIVACY_ID_COUNT in metrics:
+        combiners.append(RawPrivacyIdCountCombiner())
+    if Metrics.MEAN in metrics:
+        combiners.append(RawMeanCombiner())
+    if Metrics.VARIANCE in metrics:
+        combiners.append(RawVarianceCombiner())
+    return CompoundCombiner(combiners)

@@ -1,0 +1,125 @@
+"""Tests for the legacy utility-analysis (peeker) package."""
+
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.utility_analysis import (DataPeeker, PeekerEngine,
+                                             SampleParams,
+                                             aggregate_sketch_true,
+                                             non_private_combiners)
+
+HUGE_EPS = 1e7
+
+# rows: (uid, partition, value)
+ROWS = [
+    ("u1", "pk0", 1.0),
+    ("u1", "pk0", 2.0),
+    ("u1", "pk1", 3.0),
+    ("u2", "pk0", 4.0),
+    ("u2", "pk1", 1.0),
+    ("u3", "pk0", 2.0),
+]
+
+EXTRACTORS = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                partition_extractor=lambda r: r[1],
+                                value_extractor=lambda r: r[2])
+
+
+def backend():
+    return pdp.LocalBackend(seed=3)
+
+
+class TestNonPrivateCombiners:
+
+    def test_compound_count_sum(self):
+        combiner = non_private_combiners.create_compound_combiner(
+            [pdp.Metrics.COUNT, pdp.Metrics.SUM])
+        acc1 = combiner.create_accumulator([1.0, 2.0])
+        acc2 = combiner.create_accumulator([3.0])
+        merged = combiner.merge_accumulators(acc1, acc2)
+        assert combiner.compute_metrics(merged) == [3, 6.0]
+
+    def test_mean_variance(self):
+        combiner = non_private_combiners.create_compound_combiner(
+            [pdp.Metrics.MEAN, pdp.Metrics.VARIANCE])
+        acc = combiner.create_accumulator([1.0, 2.0, 3.0])
+        mean_t, var_t = combiner.compute_metrics(acc)
+        assert mean_t.mean == pytest.approx(2.0)
+        assert var_t.variance == pytest.approx(2.0 / 3)
+
+    def test_duplicate_metrics_rejected(self):
+        with pytest.raises(ValueError, match="same"):
+            non_private_combiners.CompoundCombiner(
+                [non_private_combiners.RawCountCombiner(),
+                 non_private_combiners.RawCountCombiner()])
+
+
+class TestDataPeeker:
+
+    def test_sketch_count(self):
+        peeker = DataPeeker(backend())
+        params = SampleParams(number_of_sampled_partitions=10,
+                              metrics=[pdp.Metrics.COUNT])
+        sketches = sorted(peeker.sketch(ROWS, params, EXTRACTORS))
+        # one sketch per (pk, pid): u1 contributes to pk0(2 rows),pk1(1);
+        # u2 to pk0(1),pk1(1); u3 to pk0(1)
+        assert sketches == sorted([("pk0", 2, 2), ("pk1", 1, 2),
+                                   ("pk0", 1, 2), ("pk1", 1, 2),
+                                   ("pk0", 1, 1)])
+
+    def test_sketch_requires_single_count_or_sum(self):
+        peeker = DataPeeker(backend())
+        with pytest.raises(ValueError, match="COUNT or SUM"):
+            list(
+                peeker.sketch(
+                    ROWS,
+                    SampleParams(number_of_sampled_partitions=1,
+                                 metrics=[pdp.Metrics.MEAN]), EXTRACTORS))
+
+    def test_sample_restricts_partitions(self):
+        peeker = DataPeeker(backend())
+        params = SampleParams(number_of_sampled_partitions=1)
+        sampled = list(peeker.sample(ROWS, params, EXTRACTORS))
+        pks = set(pk for _, pk, _ in sampled)
+        assert len(pks) == 1
+        # all rows of the sampled partition are present
+        want = [r for r in ROWS if r[1] in pks]
+        assert sorted(sampled) == sorted(want)
+
+    def test_aggregate_true(self):
+        peeker = DataPeeker(backend())
+        params = SampleParams(number_of_sampled_partitions=10,
+                              metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM])
+        got = dict(peeker.aggregate_true(ROWS, params, EXTRACTORS))
+        assert got["pk0"] == [4, 9.0]
+        assert got["pk1"] == [2, 4.0]
+
+
+class TestPeekerEngine:
+
+    def test_aggregate_sketch_true(self):
+        sketches = [("pk0", 2, 2), ("pk0", 1, 2), ("pk1", 3, 1)]
+        got = dict(
+            aggregate_sketch_true(backend(), sketches, pdp.Metrics.SUM))
+        assert got == {"pk0": 3, "pk1": 3}
+        got_count = dict(
+            aggregate_sketch_true(backend(), sketches, pdp.Metrics.COUNT))
+        assert got_count == {"pk0": 2, "pk1": 1}
+
+    def test_aggregate_sketches_dp(self):
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-4)
+        engine = PeekerEngine(accountant, backend())
+        # 3 users in pk0 (values 2,1,2), 2 in pk1
+        sketches = [("pk0", 2, 2), ("pk0", 1, 2), ("pk0", 2, 1),
+                    ("pk1", 1, 2), ("pk1", 1, 2)]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     noise_kind=pdp.NoiseKind.LAPLACE,
+                                     max_partitions_contributed=2,
+                                     max_contributions_per_partition=3)
+        result = engine.aggregate_sketches(sketches, params)
+        accountant.compute_budgets()
+        got = dict(result)
+        # huge eps → everything kept, counts ≈ clipped per-user counts summed
+        assert got["pk0"].count == pytest.approx(5, abs=0.1)
+        assert got["pk1"].count == pytest.approx(2, abs=0.1)
