@@ -1,0 +1,84 @@
+"""Parameter tuning on restaurant data: histograms -> candidates -> tuned DP.
+
+Counterpart of the reference's
+examples/restaurant_visits/run_without_frameworks_dp_parameter_tuning.py:
+compute dataset contribution histograms, tune contribution bounds for a DP
+COUNT with the utility-analysis sweep, then run the aggregation with the
+recommended parameters.
+
+Usage:
+    python run_parameter_tuning.py [--epsilon 1.0]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import analysis
+from pipelinedp_tpu.analysis import parameter_tuning
+from pipelinedp_tpu.dataset_histograms import computing_histograms
+from examples import synthetic_data
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rows", type=int, default=5_000)
+    parser.add_argument("--epsilon", type=float, default=1.0)
+    parser.add_argument("--delta", type=float, default=1e-6)
+    args = parser.parse_args()
+
+    visits = synthetic_data.generate_restaurant_visits(args.rows)
+    backend = pdp.LocalBackend()
+    extractors = pdp.DataExtractors(
+        privacy_id_extractor=lambda v: v.user_id,
+        partition_extractor=lambda v: v.day,
+        value_extractor=lambda v: 1)
+
+    # 1. Contribution histograms of the dataset.
+    histograms = list(
+        computing_histograms.compute_dataset_histograms(
+            visits, extractors, backend))[0]
+    print("dataset: l0 contributions q(0.9) =",
+          histograms.l0_contributions_histogram.quantiles([0.9]))
+
+    # 2. Tune contribution bounds for a DP COUNT.
+    tune_options = parameter_tuning.TuneOptions(
+        epsilon=args.epsilon,
+        delta=args.delta,
+        aggregate_params=pdp.AggregateParams(
+            noise_kind=pdp.NoiseKind.LAPLACE,
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1),
+        function_to_minimize=parameter_tuning.MinimizingFunction.ABSOLUTE_ERROR,
+        parameters_to_tune=parameter_tuning.ParametersToTune(
+            max_partitions_contributed=True,
+            max_contributions_per_partition=True))
+    tune_result, _ = parameter_tuning.tune(visits, backend, histograms,
+                                           tune_options, extractors,
+                                           public_partitions=list(range(7)))
+    tune_result = list(tune_result)[0]
+    best = tune_result.utility_analysis_parameters.get_aggregate_params(
+        tune_options.aggregate_params, tune_result.index_best)
+    print("recommended: max_partitions_contributed =",
+          best.max_partitions_contributed,
+          " max_contributions_per_partition =",
+          best.max_contributions_per_partition)
+
+    # 3. Run the DP aggregation with the tuned parameters.
+    budget_accountant = pdp.NaiveBudgetAccountant(total_epsilon=args.epsilon,
+                                                  total_delta=args.delta)
+    engine = pdp.DPEngine(budget_accountant, backend)
+    result = engine.aggregate(visits, best, extractors,
+                              public_partitions=list(range(7)))
+    budget_accountant.compute_budgets()
+    for day, metrics in sorted(result):
+        print(f"day {day}: dp_count={metrics.count:.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""Synthetic dataset generators for the examples.
+
+The reference examples use the Netflix prize data (movie_view_ratings) and a
+restaurant-visits CSV (examples/restaurant_visits/restaurants_week_data.csv).
+Neither dataset ships here; these generators produce the same row shapes so
+every example is runnable out of the box.
+"""
+
+import dataclasses
+import numpy as np
+
+
+@dataclasses.dataclass
+class MovieView:
+    """One movie view: same shape as the reference's parsed Netflix rows
+    (examples/movie_view_ratings/common_utils.py)."""
+    user_id: int
+    movie_id: int
+    rating: int
+
+
+@dataclasses.dataclass
+class RestaurantVisit:
+    """One restaurant visit (examples/restaurant_visits data schema)."""
+    user_id: int
+    day: int
+    spent_money: float
+    spent_minutes: int
+
+
+def generate_movie_views(n_rows: int = 100_000,
+                         n_users: int = 10_000,
+                         n_movies: int = 500,
+                         seed: int = 0):
+    """Zipf-ish movie popularity, uniform users, ratings 1..5."""
+    rng = np.random.default_rng(seed)
+    users = rng.integers(0, n_users, n_rows)
+    movies = (rng.zipf(1.3, n_rows) - 1) % n_movies
+    ratings = rng.integers(1, 6, n_rows)
+    return [
+        MovieView(int(u), int(m), int(r))
+        for u, m, r in zip(users, movies, ratings)
+    ]
+
+
+def generate_restaurant_visits(n_rows: int = 5_000,
+                               n_users: int = 300,
+                               n_days: int = 7,
+                               seed: int = 0):
+    rng = np.random.default_rng(seed)
+    users = rng.integers(0, n_users, n_rows)
+    days = rng.integers(0, n_days, n_rows)
+    money = np.round(rng.gamma(3.0, 8.0, n_rows), 2)
+    minutes = rng.integers(10, 120, n_rows)
+    return [
+        RestaurantVisit(int(u), int(d), float(m), int(t))
+        for u, d, m, t in zip(users, days, money, minutes)
+    ]

@@ -1,0 +1,24 @@
+"""Smoke tests: every example must run end to end."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXAMPLES = [
+    ["examples/movie_view_ratings/run_local.py", "--rows", "5000"],
+    ["examples/restaurant_visits/run_private_api.py", "--rows", "1000"],
+    ["examples/restaurant_visits/run_parameter_tuning.py", "--rows", "1000"],
+]
+
+
+@pytest.mark.parametrize("cmd", EXAMPLES, ids=lambda c: c[0])
+def test_example_runs(cmd):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable] + cmd, cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert proc.stdout.strip(), "example produced no output"
