@@ -55,4 +55,15 @@ from pipelinedp_tpu.pipeline_backend import (
     Annotator,
 )
 
+# Beam/Spark backends exist only when the corresponding framework is
+# importable (reference exports them unconditionally from
+# pipeline_dp/__init__.py:36-39 because it hard-depends on both).
+from pipelinedp_tpu import pipeline_backend as _pb
+
+if hasattr(_pb, 'BeamBackend'):
+    from pipelinedp_tpu.pipeline_backend import BeamBackend
+if hasattr(_pb, 'SparkRDDBackend'):
+    from pipelinedp_tpu.pipeline_backend import SparkRDDBackend
+del _pb
+
 __version__ = '0.1.0'
