@@ -4,6 +4,7 @@ The device engine operates on columnar arrays:
     pid:    int32[n]  contiguous privacy-unit ids (vocab-encoded)
     pk:     int32[n]  partition ids in [0, n_partitions); -1 = dropped row
     values: float[n]  scalar contribution values
+            (or float[n, d] for vector-valued aggregations, e.g. VECTOR_SUM)
 
 The host keeps the string-key vocabularies (partition id <-> original key),
 which is exactly the host/device split called for in SURVEY.md §5: the
@@ -26,7 +27,7 @@ class EncodedData:
     """Columnar dataset + decode vocabularies."""
     pid: np.ndarray  # int32[n]
     pk: np.ndarray  # int32[n], -1 marks rows in no (public) partition
-    values: np.ndarray  # float64[n]
+    values: np.ndarray  # float64[n] (or float64[n, d] for vector values)
     partition_vocab: List[Any]  # partition id -> original partition key
     n_privacy_ids: int
 

@@ -236,6 +236,25 @@ def _clip_vector(vec: np.ndarray, max_norm: float, norm_kind: NormKind):
         f"Vector Norm of kind '{kind}' is not supported.")
 
 
+def vector_noise_std(noise_params: AdditiveVectorNoiseParams) -> float:
+    """Per-coordinate noise stddev of add_noise_vector.
+
+    Shared by the host combiner path and the fused TPU kernel
+    (executor.compute_noise_stds) so the two can never diverge on
+    calibration.
+    """
+    if noise_params.noise_kind == NoiseKind.LAPLACE:
+        l1 = compute_l1_sensitivity(noise_params.l0_sensitivity,
+                                    noise_params.linf_sensitivity)
+        return math.sqrt(2.0) * l1 / noise_params.eps_per_coordinate
+    if noise_params.noise_kind == NoiseKind.GAUSSIAN:
+        l2 = compute_l2_sensitivity(noise_params.l0_sensitivity,
+                                    noise_params.linf_sensitivity)
+        return gaussian_sigma(noise_params.eps_per_coordinate,
+                              noise_params.delta_per_coordinate, l2)
+    raise ValueError("Noise kind must be either Laplace or Gaussian.")
+
+
 def add_noise_vector(vec: np.ndarray, noise_params: AdditiveVectorNoiseParams):
     """Clips `vec` to the norm ball and noises each coordinate
     (reference :198-230)."""

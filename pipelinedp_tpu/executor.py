@@ -41,7 +41,7 @@ from pipelinedp_tpu import columnar
 from pipelinedp_tpu import combiners as dp_combiners
 from pipelinedp_tpu import dp_computations
 from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
-                                             Metrics, NoiseKind)
+                                             Metrics, NoiseKind, NormKind)
 from pipelinedp_tpu.ops import noise as noise_ops
 from pipelinedp_tpu.ops import segment_ops
 from pipelinedp_tpu.ops import selection_ops
@@ -70,7 +70,8 @@ def _partition_segment_sum(data, seg_ids, num_segments: int):
         return jax.ops.segment_sum(data, seg_ids, num_segments)
     partials = jax.vmap(
         lambda d, s: jax.ops.segment_sum(d, s, num_segments))(
-            data.reshape(chunks, -1), seg_ids.reshape(chunks, -1))
+            data.reshape((chunks, -1) + data.shape[1:]),
+            seg_ids.reshape(chunks, -1))
     return partials.sum(axis=0)
 
 
@@ -105,18 +106,24 @@ class KernelConfig:
     max_rows_per_privacy_id: int
     plan: Tuple[MetricPlanEntry, ...]
     degenerate_range: bool  # min_value == max_value
+    # Vector-sum mode: values are (n, vector_size) rows; the final
+    # per-partition vector is clipped to the norm ball and noised
+    # per-coordinate (reference combiners.py:742-788 semantics).
+    vector_size: int = 0  # 0 = scalar values
+    vector_max_norm: float = 0.0
+    vector_norm_kind: Optional[NormKind] = None
 
 
 SUPPORTED_COLUMNAR_METRICS = (Metrics.COUNT, Metrics.PRIVACY_ID_COUNT,
-                              Metrics.SUM, Metrics.MEAN, Metrics.VARIANCE)
+                              Metrics.SUM, Metrics.MEAN, Metrics.VARIANCE,
+                              Metrics.VECTOR_SUM)
 
 
 def supports(params: AggregateParams) -> bool:
     """Whether the fused columnar path can run this aggregation."""
     if params.custom_combiners:
         return False
-    if any(m.is_percentile or m == Metrics.VECTOR_SUM
-           for m in params.metrics):
+    if any(m.is_percentile for m in params.metrics):
         return False
     return True
 
@@ -146,6 +153,8 @@ def build_plan(
                 m for m in ('count', 'sum', 'mean') if m in names
             ]
             plan.append(MetricPlanEntry('variance', tuple(outputs), 3))
+        elif isinstance(child, dp_combiners.VectorSumCombiner):
+            plan.append(MetricPlanEntry('vector_sum', ('vector_sum',), 1))
         else:
             raise NotImplementedError(
                 f"Combiner {type(child).__name__} has no columnar lowering")
@@ -173,6 +182,10 @@ def compute_noise_stds(compound: dp_combiners.CompoundCombiner,
             stds.append(mech.sum_mechanism.std)
         elif isinstance(child, dp_combiners.VarianceCombiner):
             stds.extend(_variance_stds(child, params))
+        elif isinstance(child, dp_combiners.VectorSumCombiner):
+            stds.append(
+                dp_computations.vector_noise_std(
+                    child._params.additive_vector_noise_params))
         else:
             raise NotImplementedError(type(child))
     return np.asarray(stds, dtype=np.float64)
@@ -223,13 +236,20 @@ def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
     if cfg.bounds_enforced:
         # No privacy ids: each row is its own contribution group.
         row_mask = valid
+        seg_pk = pk_sent
+        part_count = _count_segment_sum(row_mask, seg_pk, P + 1)[:P]
+        if cfg.vector_size:
+            vcontrib = jnp.where(row_mask[:, None], values, 0.0)
+            part_vsum = _partition_segment_sum(vcontrib, seg_pk, P + 1)[:P]
+            return dict(count=part_count,
+                        vsum=part_vsum,
+                        pid_count=part_count,
+                        row_count=part_count)
         clipped = jnp.clip(values, min_v,
                            max_v) if cfg.clip_per_value else values
         contrib = jnp.where(row_mask, clipped, 0.0)
         if cfg.clip_pair_sum:
             contrib = jnp.clip(contrib, min_s, max_s)
-        seg_pk = pk_sent
-        part_count = _count_segment_sum(row_mask, seg_pk, P + 1)[:P]
         part_sum = _partition_segment_sum(contrib, seg_pk, P + 1)[:P]
         ncontrib = jnp.where(row_mask, clipped - mid, 0.0)
         part_nsum = _partition_segment_sum(ncontrib, seg_pk, P + 1)[:P]
@@ -255,18 +275,22 @@ def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
         row_mask = svalid & (rank < cfg.linf)
     else:
         row_mask = svalid
-    clipped = jnp.clip(sval, min_v, max_v) if cfg.clip_per_value else sval
-    contrib = jnp.where(row_mask, clipped, 0.0)
 
     # --- Per-(pid, pk) accumulators. ---
     maskf = row_mask.astype(f)
     pair_count = segment_ops.segment_sum(maskf, pair_id, n)
-    pair_sum = segment_ops.segment_sum(contrib, pair_id, n)
-    if cfg.clip_pair_sum:
-        pair_sum = jnp.clip(pair_sum, min_s, max_s)
-    ncontrib = jnp.where(row_mask, clipped - mid, 0.0)
-    pair_nsum = segment_ops.segment_sum(ncontrib, pair_id, n)
-    pair_nsum2 = segment_ops.segment_sum(ncontrib * ncontrib, pair_id, n)
+    if cfg.vector_size:
+        vcontrib = jnp.where(row_mask[:, None], sval, 0.0)
+        pair_vsum = segment_ops.segment_sum(vcontrib, pair_id, n)
+    else:
+        clipped = jnp.clip(sval, min_v, max_v) if cfg.clip_per_value else sval
+        contrib = jnp.where(row_mask, clipped, 0.0)
+        pair_sum = segment_ops.segment_sum(contrib, pair_id, n)
+        if cfg.clip_pair_sum:
+            pair_sum = jnp.clip(pair_sum, min_s, max_s)
+        ncontrib = jnp.where(row_mask, clipped - mid, 0.0)
+        pair_nsum = segment_ops.segment_sum(ncontrib, pair_id, n)
+        pair_nsum2 = segment_ops.segment_sum(ncontrib * ncontrib, pair_id, n)
     pair_pk = segment_ops.segment_constant(spk, pair_id, n)
     pair_pid = segment_ops.segment_constant(spid, pair_id, n)
     pair_valid = segment_ops.segment_sum(svalid.astype(jnp.int32), pair_id,
@@ -288,17 +312,38 @@ def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
     seg_pk = jnp.where(keep_l0, pair_pk, P).astype(i32)
     keepf = keep_l0.astype(f)
     part_count = _partition_segment_sum(pair_count * keepf, seg_pk, P + 1)[:P]
+    part_pid_count = _count_segment_sum(keep_l0, seg_pk, P + 1)[:P]
+    if cfg.vector_size:
+        part_vsum = _partition_segment_sum(pair_vsum * keepf[:, None], seg_pk,
+                                           P + 1)[:P]
+        return dict(count=part_count,
+                    vsum=part_vsum,
+                    pid_count=part_pid_count,
+                    row_count=part_pid_count)
     part_sum = _partition_segment_sum(pair_sum * keepf, seg_pk, P + 1)[:P]
     part_nsum = _partition_segment_sum(pair_nsum * keepf, seg_pk, P + 1)[:P]
     part_nsum2 = _partition_segment_sum(pair_nsum2 * keepf, seg_pk,
                                         P + 1)[:P]
-    part_pid_count = _count_segment_sum(keep_l0, seg_pk, P + 1)[:P]
     return dict(count=part_count,
                 sum=part_sum,
                 nsum=part_nsum,
                 nsum2=part_nsum2,
                 pid_count=part_pid_count,
                 row_count=part_pid_count)
+
+
+def _clip_rows_to_norm_ball(vecs, max_norm: float, norm_kind: NormKind):
+    """Row-wise vector clipping, matching dp_computations._clip_vector."""
+    kind = norm_kind.value
+    if kind == "linf":
+        return jnp.clip(vecs, -max_norm, max_norm)
+    if kind in ("l1", "l2"):
+        order = int(kind[-1])
+        norms = jnp.linalg.norm(vecs, ord=order, axis=-1, keepdims=True)
+        # norm == 0 -> vector is all-zero; scale value is then irrelevant.
+        scale = jnp.minimum(1.0, max_norm / jnp.where(norms > 0, norms, 1.0))
+        return vecs * scale
+    raise NotImplementedError(f"Vector Norm of kind '{kind}' is not supported")
 
 
 def finalize(cols, min_v, mid, stds: jnp.ndarray, final_key: jax.Array,
@@ -348,6 +393,11 @@ def finalize(cols, min_v, mid, stds: jnp.ndarray, final_key: jax.Array,
                 outputs['count'] = dp_count
             if 'sum' in entry.outputs:
                 outputs['sum'] = dp_mean * dp_count
+        elif entry.kind == 'vector_sum':
+            clipped_vsum = _clip_rows_to_norm_ball(cols['vsum'],
+                                                   cfg.vector_max_norm,
+                                                   cfg.vector_norm_kind)
+            outputs['vector_sum'] = noised(clipped_vsum, std_offset, 0)
         elif entry.kind == 'variance':
             dp_count = noised(cols['count'], std_offset, 0)
             denom = jnp.maximum(1.0, dp_count)
@@ -389,8 +439,9 @@ def make_kernel_config(
         selection_params: Optional[selection_ops.SelectionParams]
 ) -> KernelConfig:
     """Builds the static kernel config from aggregation parameters."""
-    clip_per_value = params.bounds_per_contribution_are_set
-    clip_pair_sum = params.bounds_per_partition_are_set
+    vector = Metrics.VECTOR_SUM in (params.metrics or [])
+    clip_per_value = params.bounds_per_contribution_are_set and not vector
+    clip_pair_sum = params.bounds_per_partition_are_set and not vector
     max_rows = 1
     if params.contribution_bounds_already_enforced:
         max_rows = (params.max_contributions or
@@ -412,7 +463,10 @@ def make_kernel_config(
         selection=selection_params,
         max_rows_per_privacy_id=max_rows,
         plan=build_plan(compound),
-        degenerate_range=degenerate)
+        degenerate_range=degenerate,
+        vector_size=(params.vector_size or 0) if vector else 0,
+        vector_max_norm=(params.vector_max_norm or 0.0) if vector else 0.0,
+        vector_norm_kind=params.vector_norm_kind if vector else None)
 
 
 def kernel_scalars(params: AggregateParams):
@@ -443,7 +497,10 @@ def pad_rows(encoded: columnar.EncodedData):
     pad = n_pad - n
     pid = np.concatenate([encoded.pid, np.zeros(pad, np.int32)])
     pk = np.concatenate([encoded.pk, np.full(pad, -1, np.int32)])
-    values = np.concatenate([encoded.values, np.zeros(pad, np.float64)])
+    values = np.concatenate([
+        encoded.values,
+        np.zeros((pad,) + encoded.values.shape[1:], np.float64)
+    ])
     valid = np.concatenate([encoded.valid, np.zeros(pad, bool)])
     return pid, pk, values, valid
 
@@ -500,6 +557,11 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
 
     def generator():
         encoded = columnar.encode(rows, data_extractors, public_list)
+        if Metrics.VECTOR_SUM in (params.metrics or []):
+            expected = (params.vector_size,)
+            got = encoded.values.shape[1:]
+            if got != expected:
+                raise TypeError(f"Shape mismatch: {got} != {expected}")
         selection_params = None
         if private:
             selection_params = selection_ops.selection_params_from_host(
@@ -552,7 +614,11 @@ def decode_results(outputs, keep, partition_vocab: Sequence[Any],
         if idx >= n_real:
             continue  # padding partitions beyond the vocabulary
         values = tuple(
-            float(outputs_np[name][idx]) for name in field_order)
+            # Vector-valued columns (e.g. vector_sum) decode to ndarrays,
+            # scalars to floats — matching the generic combiner outputs.
+            (np.asarray(outputs_np[name][idx], dtype=np.float64)
+             if outputs_np[name].ndim > 1 else float(outputs_np[name][idx]))
+            for name in field_order)
         yield (partition_vocab[idx],
                dp_combiners._create_named_tuple_instance(
                    "MetricsTuple", tuple(field_order), values))

@@ -50,7 +50,7 @@ def shard_rows_by_pid(pid: np.ndarray, pk: np.ndarray, values: np.ndarray,
 
     out_pid = np.zeros(n_out, dtype=pid.dtype)
     out_pk = np.full(n_out, -1, dtype=pk.dtype)
-    out_values = np.zeros(n_out, dtype=values.dtype)
+    out_values = np.zeros((n_out,) + values.shape[1:], dtype=values.dtype)
     out_valid = np.zeros(n_out, dtype=bool)
 
     offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])

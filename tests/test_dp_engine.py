@@ -225,7 +225,8 @@ class TestAggregatePublicPartitions:
                                   public_partitions=["A"])
         assert "percentile_50" in result["A"]._fields
 
-    def test_vector_sum_local(self):
+    @pytest.mark.parametrize("backend_name", BACKENDS)
+    def test_vector_sum(self, backend_name):
         rows = [("u1", "A", np.array([1.0, 2.0])),
                 ("u2", "A", np.array([3.0, 4.0]))]
         params = pdp.AggregateParams(metrics=[pdp.Metrics.VECTOR_SUM],
@@ -234,10 +235,63 @@ class TestAggregatePublicPartitions:
                                      vector_norm_kind=pdp.NormKind.Linf,
                                      vector_max_norm=10.0,
                                      vector_size=2)
-        result, _ = run_aggregate("local", rows, params,
+        result, _ = run_aggregate(backend_name, rows, params,
                                   public_partitions=["A"])
         np.testing.assert_allclose(result["A"].vector_sum, [4.0, 6.0],
                                    atol=0.1)
+
+    @pytest.mark.parametrize("backend_name", BACKENDS)
+    @pytest.mark.parametrize("norm_kind,expected", [
+        (pdp.NormKind.Linf, [2.0, -2.0]),
+        (pdp.NormKind.L1, [5.0 * 4 / 10, -5.0 * 6 / 10]),
+        (pdp.NormKind.L2, [5.0 * 4 / math.sqrt(52), -5.0 * 6 / math.sqrt(52)]),
+    ])
+    def test_vector_sum_norm_clipping(self, backend_name, norm_kind, expected):
+        # The final per-partition vector [4, -6] exceeds every ball of
+        # radius 5/2 and must be projected (reference combiners.py:742-788:
+        # clipping applies to the aggregated vector).
+        rows = [("u1", "A", np.array([1.0, -2.0])),
+                ("u2", "A", np.array([3.0, -4.0]))]
+        max_norm = 2.0 if norm_kind == pdp.NormKind.Linf else 5.0
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.VECTOR_SUM],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     vector_norm_kind=norm_kind,
+                                     vector_max_norm=max_norm,
+                                     vector_size=2)
+        result, _ = run_aggregate(backend_name, rows, params,
+                                  public_partitions=["A"])
+        np.testing.assert_allclose(result["A"].vector_sum, expected, atol=0.1)
+
+    @pytest.mark.parametrize("backend_name", BACKENDS)
+    def test_vector_sum_with_count_and_private_selection(self, backend_name):
+        rows = [(f"u{i}", "big", np.array([1.0, 2.0, 3.0]))
+                for i in range(1000)]
+        rows += [("lonely", "small", np.array([1.0, 1.0, 1.0]))]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.VECTOR_SUM, pdp.Metrics.COUNT],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            vector_norm_kind=pdp.NormKind.Linf,
+            vector_max_norm=5000.0,
+            vector_size=3)
+        result, _ = run_aggregate(backend_name, rows, params,
+                                  total_delta=1e-5)
+        assert "small" not in result
+        np.testing.assert_allclose(result["big"].vector_sum,
+                                   [1000.0, 2000.0, 3000.0], rtol=1e-3)
+        assert result["big"].count == pytest.approx(1000, abs=0.1)
+
+    def test_vector_sum_shape_mismatch_tpu(self):
+        rows = [("u1", "A", np.array([1.0, 2.0, 3.0]))]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.VECTOR_SUM],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     vector_norm_kind=pdp.NormKind.Linf,
+                                     vector_max_norm=10.0,
+                                     vector_size=2)
+        with pytest.raises(TypeError, match="Shape mismatch"):
+            run_aggregate("tpu", rows, params, public_partitions=["A"])
 
 
 class TestPrivatePartitionSelection:

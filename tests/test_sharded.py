@@ -116,6 +116,24 @@ class TestShardedEngineParity:
             assert actual[pk].mean == pytest.approx(expected[pk].mean,
                                                     abs=0.01)
 
+    def test_vector_sum_sharded(self):
+        mesh = make_mesh(n_devices=8)
+        rows = [("u%d" % (i % 50), "pk%d" % (i % 3),
+                 np.array([float(i % 5), 1.0])) for i in range(300)]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.VECTOR_SUM],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=100,
+                                     vector_norm_kind=pdp.NormKind.Linf,
+                                     vector_max_norm=1000.0,
+                                     vector_size=2)
+        public = ["pk0", "pk1", "pk2"]
+        expected = _aggregate(pdp.LocalBackend(seed=0), rows, params, public)
+        actual = _aggregate(pdp.TPUBackend(mesh=mesh, noise_seed=4), rows,
+                            params, public)
+        for pk in public:
+            np.testing.assert_allclose(actual[pk].vector_sum,
+                                       expected[pk].vector_sum, atol=0.1)
+
 
 class TestMultiProcBackend:
 
