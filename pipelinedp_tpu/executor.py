@@ -112,6 +112,14 @@ class KernelConfig:
     vector_size: int = 0  # 0 = scalar values
     vector_max_norm: float = 0.0
     vector_norm_kind: Optional[NormKind] = None
+    # Percentile mode: DP quantiles from a per-partition dense hierarchical
+    # histogram — the device form of ops/quantile_tree.DenseQuantileTree
+    # (leaf scatter-add = add_entries, psum = merge, per-level noise +
+    # vectorized descent = compute_quantiles).
+    quantiles: Tuple[float, ...] = ()
+    tree_height: int = 0
+    branching: int = 0
+    quantile_chunk: int = 0  # partitions per histogram chunk (memory bound)
 
 
 SUPPORTED_COLUMNAR_METRICS = (Metrics.COUNT, Metrics.PRIVACY_ID_COUNT,
@@ -123,8 +131,9 @@ def supports(params: AggregateParams) -> bool:
     """Whether the fused columnar path can run this aggregation."""
     if params.custom_combiners:
         return False
-    if any(m.is_percentile for m in params.metrics):
-        return False
+    if (Metrics.VECTOR_SUM in params.metrics and
+            any(m.is_percentile for m in params.metrics)):
+        return False  # degenerate combination; generic path decides
     return True
 
 
@@ -155,6 +164,9 @@ def build_plan(
             plan.append(MetricPlanEntry('variance', tuple(outputs), 3))
         elif isinstance(child, dp_combiners.VectorSumCombiner):
             plan.append(MetricPlanEntry('vector_sum', ('vector_sum',), 1))
+        elif isinstance(child, dp_combiners.QuantileCombiner):
+            plan.append(
+                MetricPlanEntry('quantiles', tuple(child.metrics_names()), 1))
         else:
             raise NotImplementedError(
                 f"Combiner {type(child).__name__} has no columnar lowering")
@@ -186,6 +198,14 @@ def compute_noise_stds(compound: dp_combiners.CompoundCombiner,
             stds.append(
                 dp_computations.vector_noise_std(
                     child._params.additive_vector_noise_params))
+        elif isinstance(child, dp_combiners.QuantileCombiner):
+            from pipelinedp_tpu.ops import quantile_tree as qt_ops
+            stds.append(
+                qt_ops.per_level_noise_std(
+                    child._params.eps, child._params.delta,
+                    params.max_partitions_contributed,
+                    params.max_contributions_per_partition,
+                    child._tree_height, params.noise_kind))
         else:
             raise NotImplementedError(type(child))
     return np.asarray(stds, dtype=np.float64)
@@ -203,14 +223,23 @@ def _variance_stds(child: dp_combiners.VarianceCombiner,
             params.max_value, params.noise_kind))
 
 
+def _leaf_indices(values, min_v, max_v, n_leaves: int):
+    """Quantile-tree leaf index per value (DenseQuantileTree._leaf_index)."""
+    span = max_v - min_v
+    frac = (values - min_v) / jnp.where(span > 0, span, 1.0)
+    return jnp.clip((frac * n_leaves).astype(jnp.int32), 0, n_leaves - 1)
+
+
 def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
                     valid: jnp.ndarray, min_v, max_v, min_s, max_s, mid,
                     rows_key: jax.Array, cfg: KernelConfig):
     """Phase 1: contribution bounding + per-partition partial columns.
 
     Runs per shard on the multi-chip path (each privacy unit's rows must be
-    co-located on one shard). Returns a dict of f[P] dense columns:
-    count / sum / nsum / nsum2 / pid_count / row_count.
+    co-located on one shard). Returns (cols, qrows): a dict of f[P] dense
+    columns (count / sum / nsum / nsum2 / pid_count / row_count) plus, in
+    percentile mode, the bounded row stream (pk, tree_leaf, keep) feeding the
+    per-partition quantile histograms (None otherwise).
     """
     f = _ftype()
     n = pid.shape[0]
@@ -244,7 +273,7 @@ def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
             return dict(count=part_count,
                         vsum=part_vsum,
                         pid_count=part_count,
-                        row_count=part_count)
+                        row_count=part_count), None
         clipped = jnp.clip(values, min_v,
                            max_v) if cfg.clip_per_value else values
         contrib = jnp.where(row_mask, clipped, 0.0)
@@ -255,12 +284,17 @@ def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
         part_nsum = _partition_segment_sum(ncontrib, seg_pk, P + 1)[:P]
         part_nsum2 = _partition_segment_sum(ncontrib * ncontrib, seg_pk,
                                             P + 1)[:P]
+        qrows = None
+        if cfg.quantiles:
+            leaf = _leaf_indices(values, min_v, max_v,
+                                 cfg.branching**cfg.tree_height)
+            qrows = (seg_pk, leaf, row_mask)
         return dict(count=part_count,
                     sum=part_sum,
                     nsum=part_nsum,
                     nsum2=part_nsum2,
                     pid_count=part_count,
-                    row_count=part_count)
+                    row_count=part_count), qrows
 
     # --- Linf bounding: random rank within (pid, pk). ---
     rand = jax.random.uniform(key_linf, (n,))
@@ -319,17 +353,25 @@ def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
         return dict(count=part_count,
                     vsum=part_vsum,
                     pid_count=part_pid_count,
-                    row_count=part_pid_count)
+                    row_count=part_pid_count), None
     part_sum = _partition_segment_sum(pair_sum * keepf, seg_pk, P + 1)[:P]
     part_nsum = _partition_segment_sum(pair_nsum * keepf, seg_pk, P + 1)[:P]
     part_nsum2 = _partition_segment_sum(pair_nsum2 * keepf, seg_pk,
                                         P + 1)[:P]
+    qrows = None
+    if cfg.quantiles:
+        # Row-level keep: the row survived Linf sampling AND its (pid, pk)
+        # pair survived L0 bounding.
+        keep_row = row_mask & keep_l0[pair_id]
+        leaf = _leaf_indices(sval, min_v, max_v,
+                             cfg.branching**cfg.tree_height)
+        qrows = (spk, leaf, keep_row)
     return dict(count=part_count,
                 sum=part_sum,
                 nsum=part_nsum,
                 nsum2=part_nsum2,
                 pid_count=part_pid_count,
-                row_count=part_pid_count)
+                row_count=part_pid_count), qrows
 
 
 def _clip_rows_to_norm_ball(vecs, max_norm: float, norm_kind: NormKind):
@@ -398,6 +440,8 @@ def finalize(cols, min_v, mid, stds: jnp.ndarray, final_key: jax.Array,
                                                    cfg.vector_max_norm,
                                                    cfg.vector_norm_kind)
             outputs['vector_sum'] = noised(clipped_vsum, std_offset, 0)
+        elif entry.kind == 'quantiles':
+            pass  # computed from the row stream by quantile_outputs()
         elif entry.kind == 'variance':
             dp_count = noised(cols['count'], std_offset, 0)
             denom = jnp.maximum(1.0, dp_count)
@@ -421,14 +465,157 @@ def finalize(cols, min_v, mid, stds: jnp.ndarray, final_key: jax.Array,
     return outputs, keep, part_row_count
 
 
+def quantile_std_index(plan: Tuple[MetricPlanEntry, ...]) -> int:
+    """Index of the quantile entry's noise std within the stds array."""
+    offset = 0
+    for entry in plan:
+        if entry.kind == 'quantiles':
+            return offset
+        offset += entry.n_stds
+    raise ValueError("plan has no quantiles entry")
+
+
+def _descend_quantiles(noisy_levels, min_v, max_v, cfg: KernelConfig):
+    """Vectorized root-to-leaf descent over a chunk of noisy trees.
+
+    Device mirror of DenseQuantileTree._single_quantile + the monotonicity
+    enforcement of compute_quantiles; vmapped over partitions (axis 0 of
+    every level array) and unrolled over the static tree height.
+    """
+    B, h = cfg.branching, cfg.tree_height
+    L = B**h
+    f = _ftype()
+    C = noisy_levels[0].shape[0]
+    mid_value = min_v + (max_v - min_v) / 2
+
+    results = []
+    for q in cfg.quantiles:
+        children = jnp.maximum(noisy_levels[0], 0.0)  # (C, B): root's kids
+        total = children.sum(axis=-1)
+        target = q * total
+        node = jnp.zeros(C, dtype=jnp.int32)
+        for level in range(1, h + 1):
+            if level > 1:
+                idxs = node[:, None] * B + jnp.arange(B, dtype=jnp.int32)
+                children = jnp.maximum(
+                    jnp.take_along_axis(noisy_levels[level - 1], idxs,
+                                        axis=1), 0.0)
+            cum = jnp.cumsum(children, axis=-1)
+            # searchsorted(cum, target, side='left'), clamped to B-1.
+            child = jnp.minimum(
+                jnp.sum(cum < target[:, None], axis=-1).astype(jnp.int32),
+                B - 1)
+            before = jnp.where(
+                child > 0,
+                jnp.take_along_axis(cum,
+                                    jnp.maximum(child - 1, 0)[:, None],
+                                    axis=1)[:, 0], 0.0)
+            target = target - before
+            node = node * B + child  # node == 0 at level 1
+            if level < h:
+                child_mass = jnp.take_along_axis(children, child[:, None],
+                                                 axis=1)[:, 0]
+                nidx = node[:, None] * B + jnp.arange(B, dtype=jnp.int32)
+                sub = jnp.maximum(
+                    jnp.take_along_axis(noisy_levels[level], nidx, axis=1),
+                    0.0).sum(axis=-1)
+                target = target / jnp.maximum(child_mass, 1e-12) * sub
+        leaf_width = (max_v - min_v) / L
+        leaf_lo = min_v + node.astype(f) * leaf_width
+        leaf_count = jnp.maximum(
+            jnp.take_along_axis(noisy_levels[h - 1], node[:, None],
+                                axis=1)[:, 0], 1e-12)
+        frac = jnp.clip(target / leaf_count, 0.0, 1.0)
+        value = jnp.clip(leaf_lo + frac * leaf_width, min_v, max_v)
+        results.append(jnp.where(total <= 0, mid_value, value))
+    stacked = jnp.stack(results, axis=-1)  # (C, n_q)
+
+    # Monotonicity in quantile order (compute_quantiles' cummax).
+    order = np.argsort(np.asarray(cfg.quantiles), kind="stable")
+    inverse = np.argsort(order, kind="stable")
+    mono = jax.lax.cummax(stacked[:, order], axis=1)
+    return mono[:, inverse]
+
+
+def quantile_outputs(qrows, min_v, max_v, stds, key: jax.Array,
+                     cfg: KernelConfig, psum_axis: Optional[str] = None):
+    """Per-partition DP quantiles from the bounded row stream.
+
+    Builds the dense per-partition tree histograms chunk-by-chunk over the
+    partition axis (bounding peak memory at quantile_chunk * n_leaves),
+    noises every tree node with the per-level-calibrated std, and descends.
+    On the multi-chip path the chunk histograms are psum'd over the mesh —
+    the device form of quantile-tree merge — and noise/descent run
+    replicated (same key on every shard).
+
+    Compute/memory trade-off: every chunk rescans the full row stream, so
+    histogram work is O(n_rows * ceil(P / quantile_chunk)). With the default
+    tree (65536 leaves) one chunk covers 512 partitions — a single pass for
+    typical percentile workloads; beyond that, memory stays bounded at the
+    cost of extra passes.
+    """
+    row_pk, row_leaf, row_keep = qrows
+    B, h = cfg.branching, cfg.tree_height
+    L = B**h
+    P = cfg.n_partitions
+    C = cfg.quantile_chunk
+    n_chunks = -(-P // C)
+    f = _ftype()
+    std = stds[quantile_std_index(cfg.plan)].astype(f)
+    plan_names = next(e.outputs for e in cfg.plan if e.kind == 'quantiles')
+
+    def chunk_fn(c):
+        base = c * C
+        rel = row_pk - base
+        in_chunk = row_keep & (rel >= 0) & (rel < C)
+        idx = jnp.where(in_chunk, rel * L + row_leaf, C * L)
+        # i32 accumulation: on the f32 TPU path a float scatter-add would
+        # silently saturate at 2^24 rows per (partition, leaf) cell.
+        hist = jax.ops.segment_sum(in_chunk.astype(jnp.int32), idx,
+                                   num_segments=C * L + 1)[:C * L]
+        hist = hist.astype(f).reshape(C, L)
+        if psum_axis is not None:
+            hist = jax.lax.psum(hist, psum_axis)
+        # Clean per-level counts (level l has B^l nodes), then noise.
+        counts = [hist]
+        for level in range(h - 1, 0, -1):
+            counts.append(counts[-1].reshape(C, B**level, B).sum(axis=-1))
+        counts.reverse()  # counts[l-1] : (C, B^l)
+        ckey = jax.random.fold_in(key, c)
+        noisy = [
+            counts[l] + noise_ops.additive_noise(
+                jax.random.fold_in(ckey, l), counts[l].shape, std,
+                cfg.noise_kind) for l in range(h)
+        ]
+        return _descend_quantiles(noisy, min_v, max_v, cfg)
+
+    if n_chunks == 1:
+        per_partition = chunk_fn(jnp.int32(0))[:P]
+    else:
+        per_partition = jax.lax.map(chunk_fn,
+                                    jnp.arange(n_chunks,
+                                               dtype=jnp.int32)).reshape(
+                                                   n_chunks * C, -1)[:P]
+    return {
+        name: per_partition[:, j].astype(f)
+        for j, name in enumerate(plan_names)
+    }
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def aggregate_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
                      stds, rng_key, cfg: KernelConfig):
     """Single-device fused program: partial_columns + finalize."""
     rows_key, final_key = jax.random.split(rng_key, 2)
-    cols = partial_columns(pid, pk, values, valid, min_v, max_v, min_s, max_s,
-                           mid, rows_key, cfg)
-    return finalize(cols, min_v, mid, stds, final_key, cfg)
+    cols, qrows = partial_columns(pid, pk, values, valid, min_v, max_v, min_s,
+                                  max_s, mid, rows_key, cfg)
+    outputs, keep, row_count = finalize(cols, min_v, mid, stds, final_key,
+                                        cfg)
+    if cfg.quantiles:
+        qkey = jax.random.fold_in(rng_key, 7919)
+        outputs.update(
+            quantile_outputs(qrows, min_v, max_v, stds, qkey, cfg))
+    return outputs, keep, row_count
 
 
 def make_kernel_config(
@@ -448,6 +635,24 @@ def make_kernel_config(
                     params.max_contributions_per_partition or 1)
     degenerate = (params.min_value is not None and
                   params.min_value == params.max_value)
+    quantiles: Tuple[float, ...] = ()
+    tree_height = branching = quantile_chunk = 0
+    quantile_combiners = [
+        c for c in compound.combiners
+        if isinstance(c, dp_combiners.QuantileCombiner)
+    ]
+    if quantile_combiners:
+        qc = quantile_combiners[0]
+        if degenerate:
+            raise ValueError("max_value must be > min_value")
+        quantiles = tuple(qc._quantiles_to_compute)
+        tree_height = qc._tree_height
+        branching = qc._branching_factor
+        # Chunk the partition axis so one chunk's leaf histogram stays under
+        # ~2^25 elements (128 MiB in f32) regardless of n_partitions; each
+        # extra chunk costs another pass over the row stream.
+        n_leaves = branching**tree_height
+        quantile_chunk = max(1, min(n_partitions, (1 << 25) // n_leaves))
     return KernelConfig(
         n_partitions=n_partitions,
         linf=params.max_contributions_per_partition or 0,
@@ -466,7 +671,11 @@ def make_kernel_config(
         degenerate_range=degenerate,
         vector_size=(params.vector_size or 0) if vector else 0,
         vector_max_norm=(params.vector_max_norm or 0.0) if vector else 0.0,
-        vector_norm_kind=params.vector_norm_kind if vector else None)
+        vector_norm_kind=params.vector_norm_kind if vector else None,
+        quantiles=quantiles,
+        tree_height=tree_height,
+        branching=branching,
+        quantile_chunk=quantile_chunk)
 
 
 def kernel_scalars(params: AggregateParams):

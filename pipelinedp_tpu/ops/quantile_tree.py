@@ -32,6 +32,25 @@ DEFAULT_BRANCHING_FACTOR = 16
 _MAGIC = b"QTR1"
 
 
+def per_level_noise_std(eps: float, delta: float, l0: int, linf: int,
+                        height: int, noise_kind: NoiseKind) -> float:
+    """Per-node noise stddev with the (eps, delta) budget split equally
+    across the `height` tree levels.
+
+    Shared by the host tree (_noisy_counts) and the fused TPU kernel
+    (executor.compute_noise_stds) so their calibration can never diverge.
+    """
+    eps_level = eps / height
+    if noise_kind == NoiseKind.LAPLACE:
+        b = (l0 * linf) / eps_level
+        return math.sqrt(2.0) * b
+    if noise_kind == NoiseKind.GAUSSIAN:
+        delta_level = delta / height
+        return dp_computations.gaussian_sigma(eps_level, delta_level,
+                                              math.sqrt(l0) * linf)
+    raise ValueError(f"Unsupported noise kind {noise_kind}")
+
+
 class DenseQuantileTree:
     """Mergeable quantile sketch over [min_value, max_value]."""
 
@@ -125,18 +144,14 @@ class DenseQuantileTree:
         partition's tree and l0 partitions, so per-level sensitivities are
         l1 = l0*linf, l2 = sqrt(l0)*linf.
         """
-        eps_level = eps / self.height
+        std = per_level_noise_std(eps, delta, l0, linf, self.height,
+                                  noise_kind)
         noisy = np.empty_like(self.counts)
         if noise_kind == NoiseKind.LAPLACE:
-            b = (l0 * linf) / eps_level
-            noise = rng.laplace(0.0, b, size=self.counts.shape)
-        elif noise_kind == NoiseKind.GAUSSIAN:
-            delta_level = delta / self.height
-            sigma = dp_computations.gaussian_sigma(eps_level, delta_level,
-                                                   math.sqrt(l0) * linf)
-            noise = rng.normal(0.0, sigma, size=self.counts.shape)
+            noise = rng.laplace(0.0, std / math.sqrt(2.0),
+                                size=self.counts.shape)
         else:
-            raise ValueError(f"Unsupported noise kind {noise_kind}")
+            noise = rng.normal(0.0, std, size=self.counts.shape)
         np.add(self.counts, noise, out=noisy)
         return noisy
 

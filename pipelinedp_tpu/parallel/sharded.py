@@ -73,11 +73,20 @@ def _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
         rows_key, final_key = jax.random.split(key_r, 2)
         # Distinct sampling randomness per shard; identical finalize key.
         shard_rows_key = jax.random.fold_in(rows_key, shard_idx)
-        cols = executor.partial_columns(pid_s, pk_s, values_s, valid_s, min_v,
-                                        max_v, min_s, max_s, mid,
-                                        shard_rows_key, cfg)
+        cols, qrows = executor.partial_columns(pid_s, pk_s, values_s, valid_s,
+                                               min_v, max_v, min_s, max_s,
+                                               mid, shard_rows_key, cfg)
         cols = jax.tree.map(lambda x: jax.lax.psum(x, SHARD_AXIS), cols)
-        return executor.finalize(cols, min_v, mid, stds_r, final_key, cfg)
+        outputs, keep, row_count = executor.finalize(cols, min_v, mid, stds_r,
+                                                     final_key, cfg)
+        if cfg.quantiles:
+            # Chunk histograms are psum'd inside quantile_outputs (tree
+            # merge over the mesh); noise + descent replicated via key_r.
+            qkey = jax.random.fold_in(key_r, 7919)
+            outputs.update(
+                executor.quantile_outputs(qrows, min_v, max_v, stds_r, qkey,
+                                          cfg, psum_axis=SHARD_AXIS))
+        return outputs, keep, row_count
 
     fn = jax.shard_map(per_shard,
                        mesh=mesh,

@@ -212,18 +212,53 @@ class TestAggregatePublicPartitions:
         assert result["A"].percentile_50 == pytest.approx(4.5, abs=1.0)
         assert result["A"].percentile_90 == pytest.approx(9.0, abs=1.0)
 
-    def test_percentile_on_tpu_backend_falls_back(self):
-        # Percentiles are not columnar yet; TPU backend should still work
-        # through the generic path.
-        rows = [("u%d" % i, "A", float(i % 10)) for i in range(50)]
-        params = pdp.AggregateParams(metrics=[pdp.Metrics.PERCENTILE(50)],
+    @pytest.mark.parametrize("backend_name", BACKENDS)
+    def test_percentile_parity(self, backend_name):
+        # Identical data through the generic combiner path and the fused
+        # device tree; huge eps makes both converge to the true quantiles.
+        rows = [("u%d" % i, "pk%d" % (i % 3), float(i % 100))
+                for i in range(600)]
+        params = pdp.AggregateParams(metrics=[
+            pdp.Metrics.PERCENTILE(10),
+            pdp.Metrics.PERCENTILE(50),
+            pdp.Metrics.PERCENTILE(90),
+        ],
                                      max_partitions_contributed=1,
                                      max_contributions_per_partition=1,
                                      min_value=0.0,
-                                     max_value=10.0)
-        result, _ = run_aggregate("tpu", rows, params,
-                                  public_partitions=["A"])
-        assert "percentile_50" in result["A"]._fields
+                                     max_value=100.0)
+        result, _ = run_aggregate(backend_name, rows, params,
+                                  public_partitions=["pk0", "pk1", "pk2"])
+        for pk in result:
+            r = result[pk]
+            assert r.percentile_10 == pytest.approx(10.0, abs=2.0)
+            assert r.percentile_50 == pytest.approx(50.0, abs=2.0)
+            assert r.percentile_90 == pytest.approx(90.0, abs=2.0)
+            assert r.percentile_10 <= r.percentile_50 <= r.percentile_90
+
+    def test_percentile_with_sum_and_private_selection_tpu(self):
+        rows = [("u%d" % i, "big", float(i % 10)) for i in range(1000)]
+        rows += [("lonely", "small", 3.0)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50), pdp.Metrics.SUM],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_value=0.0,
+            max_value=10.0)
+        result, _ = run_aggregate("tpu", rows, params, total_delta=1e-5)
+        assert "small" not in result
+        assert result["big"].percentile_50 == pytest.approx(4.5, abs=1.0)
+        assert result["big"].sum == pytest.approx(4500.0, abs=1.0)
+
+    def test_percentile_degenerate_range_raises_tpu(self):
+        rows = [("u1", "A", 1.0)]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.PERCENTILE(50)],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     min_value=1.0,
+                                     max_value=1.0)
+        with pytest.raises(ValueError, match="max_value must be > min_value"):
+            run_aggregate("tpu", rows, params, public_partitions=["A"])
 
     @pytest.mark.parametrize("backend_name", BACKENDS)
     def test_vector_sum(self, backend_name):

@@ -1,0 +1,124 @@
+"""Unit tests for the device quantile kernel (executor.quantile_outputs)
+against the host DenseQuantileTree on identical data.
+
+Uses a small tree (branching 4, height 2 -> 16 leaves) so the multi-chunk
+lax.map path is exercised with a handful of partitions.
+"""
+
+import jax.numpy as jnp
+import jax.random
+import numpy as np
+import pytest
+
+from pipelinedp_tpu import executor
+from pipelinedp_tpu.aggregate_params import NoiseKind
+from pipelinedp_tpu.ops import quantile_tree
+
+
+def _make_cfg(n_partitions, quantiles, chunk, branching=4, height=2):
+    plan = (executor.MetricPlanEntry('quantiles',
+                                     tuple(f"q{i}"
+                                           for i in range(len(quantiles))),
+                                     1),)
+    return executor.KernelConfig(n_partitions=n_partitions,
+                                 linf=0,
+                                 l0=0,
+                                 total_bound=0,
+                                 sample_per_partition=False,
+                                 clip_per_value=False,
+                                 clip_pair_sum=False,
+                                 bounds_enforced=True,
+                                 noise_kind=NoiseKind.LAPLACE,
+                                 private_selection=False,
+                                 selection=None,
+                                 max_rows_per_privacy_id=1,
+                                 plan=plan,
+                                 degenerate_range=False,
+                                 quantiles=tuple(quantiles),
+                                 tree_height=height,
+                                 branching=branching,
+                                 quantile_chunk=chunk)
+
+
+MIN_V, MAX_V = 0.0, 16.0
+
+
+def _device_quantiles(values_per_partition, quantiles, chunk):
+    P = len(values_per_partition)
+    pks, leaves = [], []
+    for p, vals in enumerate(values_per_partition):
+        for v in vals:
+            pks.append(p)
+            leaves.append(v)
+    cfg = _make_cfg(P, quantiles, chunk)
+    n_leaves = cfg.branching**cfg.tree_height
+    leaf_idx = np.clip(
+        ((np.asarray(leaves, dtype=np.float64) - MIN_V) / (MAX_V - MIN_V) *
+         n_leaves).astype(np.int32), 0, n_leaves - 1)
+    qrows = (jnp.asarray(pks, dtype=jnp.int32), jnp.asarray(leaf_idx),
+             jnp.ones(len(pks), dtype=bool))
+    stds = jnp.asarray([1e-9])
+    out = executor.quantile_outputs(qrows, MIN_V, MAX_V, stds,
+                                    jax.random.PRNGKey(0), cfg)
+    return np.stack(
+        [np.asarray(out[f"q{i}"]) for i in range(len(quantiles))], axis=1)
+
+
+def _host_quantiles(values, quantiles):
+    tree = quantile_tree.DenseQuantileTree(MIN_V, MAX_V, height=2,
+                                           branching_factor=4)
+    tree.add_entries(values)
+    return tree.compute_quantiles(1e9, 1e-5, 1, 1, list(quantiles),
+                                  NoiseKind.LAPLACE,
+                                  rng=np.random.default_rng(0))
+
+
+# Note: bimodal counts are deliberately unbalanced (9 vs 11) — an exact tie
+# at a subtree boundary makes the descent direction noise-driven on both the
+# host and the device, which is correct DP behavior but untestable.
+PARTITIONS = [
+    [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0],
+    [0.5] * 9 + [15.5] * 11,
+    [10.0],
+    list(np.linspace(0.1, 15.9, 100)),
+    [3.3] * 7,
+]
+
+
+@pytest.mark.parametrize("chunk", [1, 2, 5])
+def test_matches_host_tree(chunk):
+    qs = [0.1, 0.5, 0.9]
+    device = _device_quantiles(PARTITIONS, qs, chunk)
+    for p, vals in enumerate(PARTITIONS):
+        host = _host_quantiles(vals, qs)
+        np.testing.assert_allclose(device[p], host, atol=1e-3,
+                                   err_msg=f"partition {p}")
+
+
+def test_chunked_equals_unchunked():
+    qs = [0.25, 0.75]
+    np.testing.assert_allclose(_device_quantiles(PARTITIONS, qs, 2),
+                               _device_quantiles(PARTITIONS, qs, 5),
+                               atol=1e-3)
+
+
+def test_empty_partition_stays_in_range():
+    # An empty tree's quantile is noise-driven (like the host path); it must
+    # still be a finite value inside [min, max] and not disturb neighbors.
+    device = _device_quantiles([[], [5.0] * 20], [0.5], 2)
+    assert MIN_V <= device[0][0] <= MAX_V
+    assert np.isfinite(device[0][0])
+    assert device[1][0] == pytest.approx(5.5, abs=0.2)
+
+
+def test_monotone_across_unsorted_quantiles():
+    device = _device_quantiles(PARTITIONS, [0.9, 0.1, 0.5], 5)
+    for p in range(len(PARTITIONS)):
+        assert device[p][1] <= device[p][2] <= device[p][0]
+
+
+def test_noise_std_shared_with_host():
+    # The kernel's std comes from the same helper the host tree uses.
+    std = quantile_tree.per_level_noise_std(2.0, 1e-6, 3, 4, 4,
+                                            NoiseKind.LAPLACE)
+    assert std == pytest.approx(np.sqrt(2.0) * (3 * 4) / (2.0 / 4))

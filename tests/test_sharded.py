@@ -116,6 +116,22 @@ class TestShardedEngineParity:
             assert actual[pk].mean == pytest.approx(expected[pk].mean,
                                                     abs=0.01)
 
+    def test_percentile_sharded(self):
+        # Values spread across shards must merge into one global tree.
+        mesh = make_mesh(n_devices=8)
+        rows = [("u%d" % i, "A", float(i % 100)) for i in range(800)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(25),
+                     pdp.Metrics.PERCENTILE(75)],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_value=0.0,
+            max_value=100.0)
+        result = _aggregate(pdp.TPUBackend(mesh=mesh, noise_seed=5), rows,
+                            params, ["A"])
+        assert result["A"].percentile_25 == pytest.approx(25.0, abs=2.0)
+        assert result["A"].percentile_75 == pytest.approx(75.0, abs=2.0)
+
     def test_vector_sum_sharded(self):
         mesh = make_mesh(n_devices=8)
         rows = [("u%d" % (i % 50), "pk%d" % (i % 3),
