@@ -94,15 +94,19 @@ def main():
                                          min_s, max_s, mid, jnp.asarray(stds),
                                          jax.random.fold_in(k, 2), cfg)
 
-    # Warmup / compile.
+    # Warmup / compile. Synchronization is a host fetch of one output
+    # scalar, NOT block_until_ready: under remote-tunneled devices the
+    # latter can return at dispatch time and overstate throughput.
     outputs, keep, _ = step(key)
-    jax.block_until_ready(outputs)
+    _ = float(outputs["count"][0])
 
     n_chunks = max(1, args.rows // chunk)
     start = time.perf_counter()
+    results = []
     for i in range(n_chunks):
-        outputs, keep, _ = step(jax.random.fold_in(key, i))
-    jax.block_until_ready(outputs)
+        results.append(step(jax.random.fold_in(key, i)))
+    for outputs, keep, _ in results:
+        _ = float(outputs["count"][0])  # forces each chunk's execution
     elapsed = time.perf_counter() - start
 
     total_rows = n_chunks * chunk

@@ -6,16 +6,19 @@ private partition selection and noise run as ONE jit-compiled XLA program
 over columnar arrays:
 
     rows (pid, pk, value)
-      -> sort by (pid, pk, u)            # u ~ U(0,1): random ranks
-      -> Linf bounding: rank < max_contributions_per_partition
-      -> per-(pid,pk) accumulators       # segment sums: count/sum/nsum/nsum2
-      -> sort pairs by (pid, u')         # L0 bounding: rank < l0
-      -> per-partition dense columns     # segment sums into [0, P)
-      -> DP partition selection          # closed-form keep probs + Bernoulli
-      -> noise, metric formulas          # vectorized, stds are traced inputs
+      -> sort by (pid, pair_hash, pk, u)  # ONE payload-carrying sort
+      -> Linf bounding: row rank < max_contributions_per_partition
+      -> L0 bounding: pair rank < l0      # scans over hash-ordered pairs
+      -> sort by kept-pk                  # partition grouping
+      -> per-partition dense columns      # cumsum-diff at boundaries
+      -> DP partition selection           # closed-form keep probs + Bernoulli
+      -> noise, metric formulas           # vectorized, stds are traced inputs
 
-The three shuffles of the reference (SURVEY.md §3.1) become two lexsorts and
-one scatter — no host round-trips, no per-partition C++ calls.
+The three shuffles of the reference (SURVEY.md §3.1) become two
+payload-carrying sorts with scan-based ranking in between — no gathers, no
+scatters, no host round-trips, no per-partition C++ calls (TPU scatters and
+gathers at 33M-row scale cost ~0.3-0.5s each; sorts with payloads ~0.3s
+total, scans ~ms).
 
 The program is split in two phases so the multi-chip path
 (parallel/sharded.py) can insert a psum between them:
@@ -49,36 +52,6 @@ from pipelinedp_tpu.ops import selection_ops
 
 def _ftype():
     return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-
-
-def _partition_segment_sum(data, seg_ids, num_segments: int):
-    """Float segment-sum into the (small) partition axis.
-
-    On the f64 path this is a plain segment sum. On the f32 path (real TPU —
-    no f64 hardware) a flat scatter-add accrues O(n) sequential rounding bias
-    on hot partitions, which can reach the order of the DP noise; chunking
-    into B independent scatters followed by a tree reduction over B cuts the
-    bias to O(n/B + B) at the cost of a (B, num_segments) intermediate.
-    """
-    if jax.config.jax_enable_x64:
-        return jax.ops.segment_sum(data, seg_ids, num_segments)
-    n = data.shape[0]
-    chunks = 1
-    while chunks < 256 and (n % (chunks * 2) == 0) and n // (chunks * 2) >= 64:
-        chunks *= 2
-    if chunks == 1:
-        return jax.ops.segment_sum(data, seg_ids, num_segments)
-    partials = jax.vmap(
-        lambda d, s: jax.ops.segment_sum(d, s, num_segments))(
-            data.reshape((chunks, -1) + data.shape[1:]),
-            seg_ids.reshape(chunks, -1))
-    return partials.sum(axis=0)
-
-
-def _count_segment_sum(mask, seg_ids, num_segments: int):
-    """Exact integer segment count (i32 accumulate, cast to float)."""
-    return jax.ops.segment_sum(mask.astype(jnp.int32), seg_ids,
-                               num_segments).astype(_ftype())
 
 
 @dataclass(frozen=True)
@@ -230,6 +203,34 @@ def _leaf_indices(values, min_v, max_v, n_leaves: int):
     return jnp.clip((frac * n_leaves).astype(jnp.int32), 0, n_leaves - 1)
 
 
+def _hash_mix(x: jnp.ndarray) -> jnp.ndarray:
+    """murmur3 finalizer: uint32 -> well-mixed uint32."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _pair_hash(pid, pk, key: jax.Array) -> jnp.ndarray:
+    """Salted uniform hash of (pid, pk) — the per-pair sampling rank.
+
+    Ranking a privacy unit's pairs by this hash is a uniform permutation of
+    its partitions (counter-based analogue of the reference's RNG sampling,
+    contribution_bounders.py:87-92), with no second sort and no scatter.
+    """
+    salts = jax.random.bits(key, (2,), jnp.uint32)
+    h = _hash_mix(pid.astype(jnp.uint32) * jnp.uint32(0x9E3779B9) + salts[0])
+    return _hash_mix(h ^ _hash_mix(pk.astype(jnp.uint32) + salts[1]))
+
+
+def _sort_rows(keys, payloads):
+    """One lax.sort carrying payload columns (no post-sort gathers)."""
+    out = jax.lax.sort(tuple(keys) + tuple(payloads), num_keys=len(keys))
+    return out[:len(keys)], out[len(keys):]
+
+
 def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
                     valid: jnp.ndarray, min_v, max_v, min_s, max_s, mid,
                     rows_key: jax.Array, cfg: KernelConfig):
@@ -240,6 +241,18 @@ def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
     columns (count / sum / nsum / nsum2 / pid_count / row_count) plus, in
     percentile mode, the bounded row stream (pk, tree_leaf, keep) feeding the
     per-partition quantile histograms (None otherwise).
+
+    TPU-shaped plan (scatter/gather-free hot path):
+      1. ONE payload-carrying sort by (pid, pair_hash, pk, row_rand). Pairs
+         are then contiguous, ordered within each pid by a salted uniform
+         hash — so cross-partition (L0) bounding is just "pair rank < l0",
+         computed with scans; Linf bounding is "row rank < linf" within the
+         pair. No pair slots are materialized, no scatter-back.
+      2. ONE payload-carrying sort by kept-partition id, then per-partition
+         reductions as cumsum differences at searchsorted boundaries —
+         counts are exact integers, float sums use a chunked cumsum to
+         bound f32 rounding bias.
+    The reference's three shuffles (SURVEY.md §3.1) cost two sorts total.
     """
     f = _ftype()
     n = pid.shape[0]
@@ -248,130 +261,128 @@ def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
     values = values.astype(f)
     key_total, key_linf, key_l0 = jax.random.split(rows_key, 3)
 
+    vector = bool(cfg.vector_size)
+    need_sum = any(e.kind == 'sum' for e in cfg.plan)
+    need_nsum = any(e.kind in ('mean', 'variance') for e in cfg.plan)
+    need_nsum2 = any(e.kind == 'variance' for e in cfg.plan)
+
     pk_sent = jnp.where(valid, pk, P).astype(i32)
     pid_sent = jnp.where(valid, pid, jnp.iinfo(i32).max).astype(i32)
 
-    if cfg.total_bound and not cfg.bounds_enforced:
-        # Total-contribution bounding: uniform <=K subset of each pid's rows.
-        rand0 = jax.random.uniform(key_total, (n,))
-        order0 = jnp.lexsort((rand0, pid_sent))
-        new_pid0 = segment_ops.boundary_mask(pid_sent[order0])
-        _, rank0 = segment_ops.segment_starts_and_ids(new_pid0)
-        keep0 = jnp.zeros(n, bool).at[order0].set(rank0 < cfg.total_bound)
-        valid = valid & keep0
-        pk_sent = jnp.where(valid, pk, P).astype(i32)
-        pid_sent = jnp.where(valid, pid, jnp.iinfo(i32).max).astype(i32)
+    def value_cols(vals):
+        return [vals[:, d] for d in range(cfg.vector_size)] if vector \
+            else [vals]
+
+    def from_cols(cols_):
+        return jnp.stack(cols_, axis=1) if vector else cols_[0]
 
     if cfg.bounds_enforced:
-        # No privacy ids: each row is its own contribution group.
-        row_mask = valid
-        seg_pk = pk_sent
-        part_count = _count_segment_sum(row_mask, seg_pk, P + 1)[:P]
-        if cfg.vector_size:
-            vcontrib = jnp.where(row_mask[:, None], values, 0.0)
-            part_vsum = _partition_segment_sum(vcontrib, seg_pk, P + 1)[:P]
-            return dict(count=part_count,
-                        vsum=part_vsum,
-                        pid_count=part_count,
-                        row_count=part_count), None
-        clipped = jnp.clip(values, min_v,
-                           max_v) if cfg.clip_per_value else values
-        contrib = jnp.where(row_mask, clipped, 0.0)
-        if cfg.clip_pair_sum:
-            contrib = jnp.clip(contrib, min_s, max_s)
-        part_sum = _partition_segment_sum(contrib, seg_pk, P + 1)[:P]
-        ncontrib = jnp.where(row_mask, clipped - mid, 0.0)
-        part_nsum = _partition_segment_sum(ncontrib, seg_pk, P + 1)[:P]
-        part_nsum2 = _partition_segment_sum(ncontrib * ncontrib, seg_pk,
-                                            P + 1)[:P]
-        qrows = None
-        if cfg.quantiles:
-            leaf = _leaf_indices(values, min_v, max_v,
-                                 cfg.branching**cfg.tree_height)
-            qrows = (seg_pk, leaf, row_mask)
-        return dict(count=part_count,
-                    sum=part_sum,
-                    nsum=part_nsum,
-                    nsum2=part_nsum2,
-                    pid_count=part_count,
-                    row_count=part_count), qrows
-
-    # --- Linf bounding: random rank within (pid, pk). ---
-    rand = jax.random.uniform(key_linf, (n,))
-    order = jnp.lexsort((rand, pk_sent, pid_sent))
-    spid = pid_sent[order]
-    spk = pk_sent[order]
-    sval = values[order]
-    svalid = valid[order]
-    new_pair = segment_ops.boundary_mask(spid, spk)
-    pair_id, rank = segment_ops.segment_starts_and_ids(new_pair)
-    if cfg.sample_per_partition and cfg.linf:
-        row_mask = svalid & (rank < cfg.linf)
+        # No privacy ids: every row is its own contribution group; no
+        # bounding sorts — straight to the partition reduction.
+        spk, sval, new_pair = pk_sent, values, valid
+        keep_row = valid
+        pair_start = keep_row
     else:
-        row_mask = svalid
+        pid_in, pk_in, vcols_in, valid_in = (pid_sent, pk_sent,
+                                             value_cols(values), valid)
+        if cfg.total_bound:
+            # Total-contribution bounding: uniform <=K subset of each pid's
+            # rows, ranked by one sort over (pid, rand).
+            rand0 = jax.random.uniform(key_total, (n,))
+            (spid0, _), pay0 = _sort_rows([pid_in, rand0],
+                                          [pk_in] + vcols_in + [valid_in])
+            new_pid0 = segment_ops.boundary_mask(spid0)
+            _, rank0 = segment_ops.segment_starts_and_ids(new_pid0)
+            valid0 = pay0[-1] & (rank0 < cfg.total_bound)
+            pid_in = jnp.where(valid0, spid0, jnp.iinfo(i32).max)
+            pk_in = jnp.where(valid0, pay0[0], P)
+            vcols_in = list(pay0[1:-1])
+            valid_in = valid0
 
-    # --- Per-(pid, pk) accumulators. ---
-    maskf = row_mask.astype(f)
-    pair_count = segment_ops.segment_sum(maskf, pair_id, n)
-    if cfg.vector_size:
-        vcontrib = jnp.where(row_mask[:, None], sval, 0.0)
-        pair_vsum = segment_ops.segment_sum(vcontrib, pair_id, n)
-    else:
-        clipped = jnp.clip(sval, min_v, max_v) if cfg.clip_per_value else sval
-        contrib = jnp.where(row_mask, clipped, 0.0)
-        pair_sum = segment_ops.segment_sum(contrib, pair_id, n)
-        if cfg.clip_pair_sum:
-            pair_sum = jnp.clip(pair_sum, min_s, max_s)
-        ncontrib = jnp.where(row_mask, clipped - mid, 0.0)
-        pair_nsum = segment_ops.segment_sum(ncontrib, pair_id, n)
-        pair_nsum2 = segment_ops.segment_sum(ncontrib * ncontrib, pair_id, n)
-    pair_pk = segment_ops.segment_constant(spk, pair_id, n)
-    pair_pid = segment_ops.segment_constant(spid, pair_id, n)
-    pair_valid = segment_ops.segment_sum(svalid.astype(jnp.int32), pair_id,
-                                         n) > 0
+        # The one bounding sort: (pid, pair_hash, pk, row_rand) + payloads.
+        hpair = _pair_hash(pid_in, pk_in, key_l0)
+        rand = jax.random.uniform(key_linf, (n,))
+        (spid, _, spk, _), pay = _sort_rows([pid_in, hpair, pk_in, rand],
+                                            vcols_in + [valid_in])
+        sval = from_cols(pay[:-1])
+        svalid = pay[-1]
+        new_pair = segment_ops.boundary_mask(spid, spk)
+        _, rank = segment_ops.segment_starts_and_ids(new_pair)
+        if cfg.sample_per_partition and cfg.linf:
+            row_mask = svalid & (rank < cfg.linf)
+        else:
+            row_mask = svalid
+        if cfg.l0:
+            new_pid = segment_ops.boundary_mask(spid)
+            pair_rank = segment_ops.segment_rank_of_segments(new_pair, new_pid)
+            keep_row = row_mask & (pair_rank < cfg.l0)  # pair_rank is 0-based
+        else:
+            keep_row = row_mask
+        pair_start = new_pair & keep_row
 
-    # --- L0 bounding: random rank of pairs within pid. ---
-    if cfg.l0:
-        rand2 = jax.random.uniform(key_l0, (n,))
-        pair_pid_key = jnp.where(pair_valid, pair_pid, jnp.iinfo(i32).max)
-        order2 = jnp.lexsort((rand2, pair_pid_key))
-        new_pid2 = segment_ops.boundary_mask(pair_pid_key[order2])
-        _, prank = segment_ops.segment_starts_and_ids(new_pid2)
-        keep_l0 = jnp.zeros(n, bool).at[order2].set(prank < cfg.l0)
-        keep_l0 = keep_l0 & pair_valid
-    else:
-        keep_l0 = pair_valid
-
-    # --- Per-partition dense columns. ---
-    seg_pk = jnp.where(keep_l0, pair_pk, P).astype(i32)
-    keepf = keep_l0.astype(f)
-    part_count = _partition_segment_sum(pair_count * keepf, seg_pk, P + 1)[:P]
-    part_pid_count = _count_segment_sum(keep_l0, seg_pk, P + 1)[:P]
-    if cfg.vector_size:
-        part_vsum = _partition_segment_sum(pair_vsum * keepf[:, None], seg_pk,
-                                           P + 1)[:P]
-        return dict(count=part_count,
-                    vsum=part_vsum,
-                    pid_count=part_pid_count,
-                    row_count=part_pid_count), None
-    part_sum = _partition_segment_sum(pair_sum * keepf, seg_pk, P + 1)[:P]
-    part_nsum = _partition_segment_sum(pair_nsum * keepf, seg_pk, P + 1)[:P]
-    part_nsum2 = _partition_segment_sum(pair_nsum2 * keepf, seg_pk,
-                                        P + 1)[:P]
     qrows = None
     if cfg.quantiles:
-        # Row-level keep: the row survived Linf sampling AND its (pid, pk)
-        # pair survived L0 bounding.
-        keep_row = row_mask & keep_l0[pair_id]
         leaf = _leaf_indices(sval, min_v, max_v,
                              cfg.branching**cfg.tree_height)
         qrows = (spk, leaf, keep_row)
-    return dict(count=part_count,
-                sum=part_sum,
-                nsum=part_nsum,
-                nsum2=part_nsum2,
+
+    # --- Contribution columns (Linf value/pair-sum clipping regimes). ---
+    if vector:
+        vcontrib = jnp.where(keep_row[:, None], sval, 0.0)
+        reduce_cols = {'v%d' % d: vcontrib[:, d]
+                       for d in range(cfg.vector_size)}
+    else:
+        clipped = jnp.clip(sval, min_v, max_v) if cfg.clip_per_value else sval
+        contrib = jnp.where(keep_row, clipped, 0.0)
+        if cfg.clip_pair_sum:
+            if cfg.bounds_enforced:
+                contrib = jnp.clip(contrib, min_s, max_s)
+            else:
+                # Per-(pid, pk) sum clipping: pair totals via cumsum
+                # differences at pair boundaries, re-emitted once per pair.
+                c = segment_ops.chunked_cumsum(contrib)
+                cpad = jnp.concatenate([jnp.zeros(1, c.dtype), c])
+                starts_row = segment_ops.segment_start_positions(new_pair)
+                ends_row = segment_ops.next_segment_start(new_pair)
+                pair_total = cpad[ends_row] - cpad[starts_row]
+                contrib = jnp.where(pair_start,
+                                    jnp.clip(pair_total, min_s, max_s), 0.0)
+        reduce_cols = {}
+        if need_sum:
+            reduce_cols['sum'] = contrib
+        if need_nsum:
+            ncontrib = jnp.where(keep_row, clipped - mid, 0.0)
+            reduce_cols['nsum'] = ncontrib
+            if need_nsum2:
+                reduce_cols['nsum2'] = ncontrib * ncontrib
+
+    # --- Partition reduction: sort by kept-pk, cumsum-diff at boundaries. --
+    key2 = jnp.where(keep_row, spk, P).astype(i32)
+    names = list(reduce_cols)
+    (spk2,), pay2 = _sort_rows([key2],
+                               [pair_start.astype(i32)] +
+                               [reduce_cols[m] for m in names])
+    starts = jnp.searchsorted(spk2, jnp.arange(P + 1, dtype=i32),
+                              side='left').astype(i32)
+
+    def seg_reduce(col):
+        cpad = jnp.concatenate(
+            [jnp.zeros(1, col.dtype),
+             segment_ops.chunked_cumsum(col)])
+        return (cpad[starts[1:]] - cpad[starts[:-1]]).astype(f)
+
+    part_count = (starts[1:] - starts[:-1]).astype(f)
+    part_pid_count = seg_reduce(pay2[0])
+    cols = dict(count=part_count,
                 pid_count=part_pid_count,
-                row_count=part_pid_count), qrows
+                row_count=part_pid_count)
+    reduced = {m: seg_reduce(pay2[1 + j]) for j, m in enumerate(names)}
+    if vector:
+        cols['vsum'] = jnp.stack(
+            [reduced['v%d' % d] for d in range(cfg.vector_size)], axis=1)
+    else:
+        cols.update(reduced)
+    return cols, qrows
 
 
 def _clip_rows_to_norm_ball(vecs, max_norm: float, norm_kind: NormKind):

@@ -42,18 +42,56 @@ def boundary_mask(*sorted_keys) -> jnp.ndarray:
     return mask
 
 
-def segment_sum(data, segment_ids, num_segments: int):
-    """Sorted segment sum wrapper."""
-    return jax.ops.segment_sum(data,
-                               segment_ids,
-                               num_segments=num_segments,
-                               indices_are_sorted=True)
+def segment_rank_of_segments(new_segment, new_group):
+    """0-based rank of each row's *segment* within its enclosing *group*.
+
+    Both masks are over the same sorted order; every group boundary must also
+    be a segment boundary. Pure scans (cumsum + cummax) — no sort, no
+    scatter. This is how cross-partition (L0) bounding ranks a privacy
+    unit's (pid, pk) pairs without materializing pair slots.
+    """
+    seg_ordinal = jnp.cumsum(new_segment.astype(jnp.int32))  # 1-based
+    group_base = jax.lax.cummax(
+        jnp.where(new_group, seg_ordinal, 0))
+    return seg_ordinal - group_base
 
 
-def segment_constant(data, segment_ids, num_segments: int):
-    """Per-segment value of a column that is constant within each segment
-    (e.g. the pid/pk key columns a segment was grouped by)."""
-    return jax.ops.segment_max(data,
-                               segment_ids,
-                               num_segments=num_segments,
-                               indices_are_sorted=True)
+def segment_start_positions(new_segment):
+    """Per row, the index of its segment's first row (cummax fill)."""
+    n = new_segment.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    return jax.lax.cummax(jnp.where(new_segment, idx, 0))
+
+
+def next_segment_start(new_segment):
+    """Per row, the index of the NEXT segment's first row (n if none).
+
+    Suffix-min of boundary positions strictly after each row.
+    """
+    n = new_segment.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    nb = jnp.where(new_segment, idx, n)
+    shifted = jnp.concatenate([nb[1:], jnp.full((1,), n, dtype=jnp.int32)])
+    return jnp.flip(jax.lax.cummin(jnp.flip(shifted)))
+
+
+def chunked_cumsum(x):
+    """Cumulative sum with bounded f32 rounding bias.
+
+    A flat f32 cumsum accrues O(n) sequential rounding error; summing within
+    B chunks and offsetting by the (small) chunk-total prefix keeps the error
+    at O(n/B + B). Exact passthrough on integer or f64 inputs.
+    """
+    if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.float64:
+        return jnp.cumsum(x)
+    n = x.shape[0]
+    chunks = 1
+    while chunks < 256 and (n % (chunks * 2) == 0) and n // (chunks * 2) >= 64:
+        chunks *= 2
+    if chunks == 1:
+        return jnp.cumsum(x)
+    xr = x.reshape(chunks, -1)
+    cs = jnp.cumsum(xr, axis=1)
+    totals = cs[:, -1]
+    offsets = jnp.cumsum(totals) - totals
+    return (cs + offsets[:, None]).reshape(n)
