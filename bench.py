@@ -111,6 +111,20 @@ def main():
 
     total_rows = n_chunks * chunk
     records_per_sec = total_rows / elapsed
+
+    # Noise-distribution fidelity: KS statistic of 1M device noise draws
+    # vs the CPU reference distribution at the same calibrated stddev
+    # (BASELINE.json metric "noise-dist KS-stat vs CPU ref").
+    from scipy import stats as scipy_stats
+    from pipelinedp_tpu.ops import noise as noise_ops
+    sum_std = float(stds[1])
+    draws = np.asarray(
+        noise_ops.laplace_noise(jax.random.PRNGKey(7), (1_000_000,),
+                                jnp.float32(sum_std)))
+    ks = float(
+        scipy_stats.kstest(draws,
+                           scipy_stats.laplace(scale=sum_std /
+                                               np.sqrt(2.0)).cdf).statistic)
     print(
         json.dumps({
             "metric": "DP SUM+COUNT records/sec/chip (eps=1, private "
@@ -127,6 +141,7 @@ def main():
                 "elapsed_sec": round(elapsed, 3),
                 "device": str(device),
                 "kept_partitions": int(np.asarray(keep).sum()),
+                "noise_ks_stat_vs_cpu_ref": round(ks, 5),
             },
         }))
 
