@@ -213,16 +213,24 @@ def _hash_mix(x: jnp.ndarray) -> jnp.ndarray:
     return x
 
 
-def _pair_hash(pid, pk, key: jax.Array) -> jnp.ndarray:
+def _pair_hash(pid, pk, key: jax.Array):
     """Salted uniform hash of (pid, pk) — the per-pair sampling rank.
 
     Ranking a privacy unit's pairs by this hash is a uniform permutation of
     its partitions (counter-based analogue of the reference's RNG sampling,
     contribution_bounders.py:87-92), with no second sort and no scatter.
+
+    Returns two independent u32 lanes (64 bits total). A single 32-bit lane
+    collides at the birthday bound (~2^16 pairs per privacy unit), and the
+    deterministic pk tie-break would then systematically favor low partition
+    ids; the second lane makes collided pairs order uniformly.
     """
-    salts = jax.random.bits(key, (2,), jnp.uint32)
+    salts = jax.random.bits(key, (4,), jnp.uint32)
     h = _hash_mix(pid.astype(jnp.uint32) * jnp.uint32(0x9E3779B9) + salts[0])
-    return _hash_mix(h ^ _hash_mix(pk.astype(jnp.uint32) + salts[1]))
+    lane0 = _hash_mix(h ^ _hash_mix(pk.astype(jnp.uint32) + salts[1]))
+    h2 = _hash_mix(pid.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B) + salts[2])
+    lane1 = _hash_mix(h2 ^ _hash_mix(pk.astype(jnp.uint32) + salts[3]))
+    return lane0, lane1
 
 
 def _sort_rows(keys, payloads):
@@ -299,11 +307,11 @@ def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
             vcols_in = list(pay0[1:-1])
             valid_in = valid0
 
-        # The one bounding sort: (pid, pair_hash, pk, row_rand) + payloads.
-        hpair = _pair_hash(pid_in, pk_in, key_l0)
+        # The one bounding sort: (pid, pair_hash64, pk, row_rand) + payloads.
+        hpair0, hpair1 = _pair_hash(pid_in, pk_in, key_l0)
         rand = jax.random.uniform(key_linf, (n,))
-        (spid, _, spk, _), pay = _sort_rows([pid_in, hpair, pk_in, rand],
-                                            vcols_in + [valid_in])
+        (spid, _, _, spk, _), pay = _sort_rows(
+            [pid_in, hpair0, hpair1, pk_in, rand], vcols_in + [valid_in])
         sval = from_cols(pay[:-1])
         svalid = pay[-1]
         new_pair = segment_ops.boundary_mask(spid, spk)
@@ -584,7 +592,12 @@ def quantile_outputs(qrows, min_v, max_v, stds, key: jax.Array,
         # silently saturate at 2^24 rows per (partition, leaf) cell.
         hist = jax.ops.segment_sum(in_chunk.astype(jnp.int32), idx,
                                    num_segments=C * L + 1)[:C * L]
-        hist = hist.astype(f).reshape(C, L)
+        hist = hist.reshape(C, L)
+        # Stay in i32 through the cross-shard psum and level roll-ups:
+        # casting to f32 first loses exactness above 2^24 per cell. Bound:
+        # i32 wraps above 2^31 rows per tree node per invocation; callers
+        # streaming more rows than that must split into multiple kernel
+        # invocations (the chunked ingest path already does).
         if psum_axis is not None:
             hist = jax.lax.psum(hist, psum_axis)
         # Clean per-level counts (level l has B^l nodes), then noise.
@@ -594,7 +607,7 @@ def quantile_outputs(qrows, min_v, max_v, stds, key: jax.Array,
         counts.reverse()  # counts[l-1] : (C, B^l)
         ckey = jax.random.fold_in(key, c)
         noisy = [
-            counts[l] + noise_ops.additive_noise(
+            counts[l].astype(f) + noise_ops.additive_noise(
                 jax.random.fold_in(ckey, l), counts[l].shape, std,
                 cfg.noise_kind) for l in range(h)
         ]

@@ -132,6 +132,36 @@ class TestShardedEngineParity:
         assert result["A"].percentile_25 == pytest.approx(25.0, abs=2.0)
         assert result["A"].percentile_75 == pytest.approx(75.0, abs=2.0)
 
+    def test_percentile_sharded_multichunk(self, monkeypatch):
+        # Forces quantile_chunk=2 so quantile_outputs takes the lax.map
+        # multi-chunk path (psum inside the mapped body) under shard_map —
+        # a collective-inside-scan regression here would otherwise only
+        # surface on real meshes.
+        import dataclasses
+        from pipelinedp_tpu import executor
+        orig = executor.make_kernel_config
+
+        def forced_chunk(*a, **kw):
+            cfg = orig(*a, **kw)
+            return dataclasses.replace(cfg, quantile_chunk=2)
+
+        monkeypatch.setattr(executor, "make_kernel_config", forced_chunk)
+        mesh = make_mesh(n_devices=8)
+        rows = [("u%d" % i, "pk%d" % (i % 5), float(i % 100))
+                for i in range(1000)]
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.PERCENTILE(50)],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_value=0.0,
+            max_value=100.0)
+        public = ["pk%d" % i for i in range(5)]
+        result = _aggregate(pdp.TPUBackend(mesh=mesh, noise_seed=6), rows,
+                            params, public)
+        assert set(result) == set(public)
+        for pk in public:
+            assert 30.0 <= result[pk].percentile_50 <= 70.0
+
     def test_vector_sum_sharded(self):
         mesh = make_mesh(n_devices=8)
         rows = [("u%d" % (i % 50), "pk%d" % (i % 3),
