@@ -82,13 +82,21 @@ def acquire_device(max_wait_sec=480.0):
             _log(f"probe succeeded on attempt {attempt} (platform={msg}); "
                  f"initializing in-process")
             try:
-                return jax.devices()[0], None
+                dev = jax.devices()[0]
             except RuntimeError as e:
-                # Chip grabbed between probe exit and our init: treat like a
-                # failed probe and keep retrying. (An in-process *hang* here
-                # is not preemptible, but the probe just demonstrated init
-                # completes, so the window is small.)
-                msg = f"in-process init failed: {str(e).splitlines()[0][:200]}"
+                # Chip grabbed between probe exit and our init. JAX caches
+                # the failed backend set, so retrying in this process cannot
+                # recover — go straight to the CPU fallback with a reason.
+                last_msg = (f"in-process init failed after successful probe: "
+                            f"{str(e).splitlines()[0][:200]}")
+                break
+            if dev.platform == "cpu" and msg != "cpu":
+                # Partial init: the TPU factory failed but CPU registered,
+                # and the cached backend set hides the failure from now on.
+                last_msg = (f"in-process init degraded to cpu "
+                            f"(probe saw {msg})")
+                break
+            return dev, None
         last_msg = msg
         remaining = deadline - time.time()
         if remaining <= delay:

@@ -32,6 +32,14 @@ from scipy import signal, special
 # is moved to the infinity atom, which is pessimistic).
 _TAIL_MASS = 1e-15
 
+# Losses above this are represented as the infinity atom (pessimistic: the
+# hockey-stick contribution of mass at loss L is p*(1 - e^(eps-L)) <= p, and
+# at L=80, e^(eps-L) < 2e-35 for any meaningful eps, so the bound is tight).
+# Keeps the discretization grid bounded (~1.6M cells at interval 1e-4) even
+# for privacy-meaningless parameters like the huge-eps determinism trick:
+# without the cap, eps0=1e4 would need a 1e8-cell grid and overflow exp().
+_MAX_FINITE_LOSS = 80.0
+
 
 def _norm_cdf(z):
     return 0.5 * special.erfc(-np.asarray(z, dtype=np.float64) / math.sqrt(2))
@@ -174,10 +182,21 @@ def from_gaussian_mechanism(
     def cdf(l):
         return _norm_cdf((np.asarray(l) - mu) / sd)
 
-    # Upper tail beyond `upper` goes to the infinity atom (pessimistic).
+    # Upper tail beyond `upper` goes to the infinity atom (pessimistic);
+    # the finite-loss cap bounds the grid for very small sigmas.
+    infinity_mass = _TAIL_MASS
+    if upper > _MAX_FINITE_LOSS:
+        upper = _MAX_FINITE_LOSS
+        infinity_mass = float(1.0 - cdf(upper))
+        if lower > upper:
+            # Essentially all mass is past the cap: one saturated atom.
+            return PrivacyLossDistribution(
+                np.zeros(1),
+                math.ceil(upper / value_discretization_interval),
+                value_discretization_interval, 1.0)
     return _discretize_from_cdf(cdf, lower, upper,
                                 value_discretization_interval,
-                                infinity_mass=_TAIL_MASS)
+                                infinity_mass=infinity_mass)
 
 
 def from_laplace_mechanism(
@@ -199,9 +218,19 @@ def from_laplace_mechanism(
                                   (2 * b))))
         return out
 
-    return _discretize_from_cdf(cdf, -max_loss, max_loss,
+    # Finite-loss cap for very small b (huge-eps regime): the atom mass at
+    # +1/b and interior mass above the cap become infinity mass
+    # (pessimistic), keeping the grid bounded.
+    infinity_mass = 0.0
+    upper = max_loss
+    lower = -max_loss
+    if max_loss > _MAX_FINITE_LOSS:
+        upper = _MAX_FINITE_LOSS
+        infinity_mass = float(1.0 - cdf(upper - 1e-12))
+        lower = max(lower, -_MAX_FINITE_LOSS)
+    return _discretize_from_cdf(cdf, lower, upper,
                                 value_discretization_interval,
-                                infinity_mass=0.0)
+                                infinity_mass=infinity_mass)
 
 
 def from_privacy_parameters(
@@ -213,11 +242,24 @@ def from_privacy_parameters(
     d = value_discretization_interval
     if eps < 0 or delta < 0 or delta >= 1:
         raise ValueError(f"Invalid privacy parameters ({eps}, {delta})")
-    p_plus = (1 - delta) * math.exp(eps) / (1 + math.exp(eps))
-    p_minus = (1 - delta) / (1 + math.exp(eps))
-    idx_plus = math.ceil(eps / d)
-    idx_minus = math.ceil(-eps / d)
+    # Log-safe sigmoid forms (exp(eps) overflows beyond ~709).
+    p_plus = (1 - delta) / (1 + math.exp(-eps))
+    p_minus = (1 - delta) * math.exp(-eps) / (1 + math.exp(-eps))
+    infinity_mass = delta
+    eps_eff = min(eps, _MAX_FINITE_LOSS)
+    if eps > _MAX_FINITE_LOSS:
+        # The +eps atom is beyond the finite-loss cap: count it as infinite
+        # loss (pessimistic) instead of materializing a huge grid. The only
+        # remaining finite mass is the (negligible) -eps atom, so the grid
+        # collapses to one cell.
+        infinity_mass += p_plus
+        p_plus = 0.0
+        idx_plus = idx_minus = math.ceil(-eps_eff / d)
+    else:
+        idx_plus = math.ceil(eps_eff / d)
+        idx_minus = math.ceil(-eps_eff / d)
     probs = np.zeros(idx_plus - idx_minus + 1, dtype=np.float64)
     probs[idx_plus - idx_minus] += p_plus
     probs[0] += p_minus
-    return PrivacyLossDistribution(probs, idx_minus, d, infinity_mass=delta)
+    return PrivacyLossDistribution(probs, idx_minus, d,
+                                   infinity_mass=infinity_mass)

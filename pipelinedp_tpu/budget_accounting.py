@@ -27,6 +27,16 @@ from typing import Optional
 import pipelinedp_tpu.aggregate_params as agg_params
 from pipelinedp_tpu import input_validators
 
+def _pld_naive_fallback_eps() -> float:
+    """Total epsilon above which the PLD accountant splits naively.
+
+    Derived from the PLD grid's finite-loss cap: composed-eps queries
+    saturate at accounting/pld.py _MAX_FINITE_LOSS, so the binary search
+    cannot distinguish budgets beyond it — and composition tightness is
+    irrelevant at such privacy-meaningless budgets anyway."""
+    from pipelinedp_tpu.accounting import pld as pldlib
+    return pldlib._MAX_FINITE_LOSS
+
 
 @dataclass
 class MechanismSpec:
@@ -366,6 +376,15 @@ class PLDBudgetAccountant(BudgetAccountant):
             raise Exception(
                 "Cannot call compute_budgets from within a budget scope.")
 
+        if self._total_epsilon >= _pld_naive_fallback_eps():
+            # Beyond the PLD finite-loss cap (accounting/pld.py
+            # _MAX_FINITE_LOSS) composition saturates; at such
+            # privacy-meaningless budgets composition tightness is
+            # irrelevant, so split the budget naively (sound: basic
+            # composition) instead. Keeps the huge-eps determinism testing
+            # trick working under this accountant.
+            self._compute_budgets_naive_fallback()
+            return
         if self._total_delta == 0:
             sum_weights = sum(m.weight for m in self._mechanisms)
             minimum_noise_std = sum_weights / self._total_epsilon * math.sqrt(2)
@@ -383,6 +402,43 @@ class PLDBudgetAccountant(BudgetAccountant):
                 epsilon_0 = math.sqrt(2) / mechanism_noise_std
                 delta_0 = epsilon_0 / self._total_epsilon * self._total_delta
                 mechanism.mechanism_spec.set_eps_delta(epsilon_0, delta_0)
+
+    def _compute_budgets_naive_fallback(self):
+        """Proportional eps/delta split with per-mechanism calibration.
+
+        Used when total_epsilon exceeds the PLD finite-loss cap: each
+        mechanism gets eps_i = eps * w_i / sum(w), delta split among
+        delta-consuming mechanisms, and its noise std from the exact
+        single-mechanism calibration — basic composition then bounds the
+        total at (total_epsilon, total_delta)."""
+        from pipelinedp_tpu import dp_computations
+
+        sum_weights = sum(m.weight for m in self._mechanisms)
+        delta_users = [
+            m for m in self._mechanisms
+            if m.mechanism_spec.mechanism_type in (
+                agg_params.MechanismType.GAUSSIAN,
+                agg_params.MechanismType.GENERIC)
+        ]
+        max_std = 0.0
+        for mechanism in self._mechanisms:
+            eps_i = self._total_epsilon * mechanism.weight / sum_weights
+            delta_i = (self._total_delta * mechanism.weight /
+                       sum(m.weight for m in delta_users)
+                       if mechanism in delta_users else 0.0)
+            mech_type = mechanism.mechanism_spec.mechanism_type
+            if mech_type == agg_params.MechanismType.GAUSSIAN:
+                std = dp_computations.gaussian_sigma(eps_i, delta_i,
+                                                     mechanism.sensitivity)
+            elif mech_type == agg_params.MechanismType.GENERIC:
+                std = math.sqrt(2) / eps_i * mechanism.sensitivity
+                mechanism.mechanism_spec.set_eps_delta(eps_i, delta_i)
+            else:
+                std = math.sqrt(2) / eps_i * mechanism.sensitivity
+            mechanism.mechanism_spec._noise_standard_deviation = std
+            max_std = max(max_std, std * mechanism.weight /
+                          mechanism.sensitivity)
+        self.minimum_noise_std = max_std
 
     def _find_minimum_noise_std(self) -> float:
         """Binary search for the smallest noise std satisfying the budget."""

@@ -425,9 +425,12 @@ class DPEngine:
         if isinstance(self._budget_accountant,
                       budget_accounting.NaiveBudgetAccountant):
             return  # all aggregations supported
-        if not is_public_partition:
-            raise NotImplementedError("PLD budget accounting does not support "
-                                      "private partition selection")
+        # Private partition selection IS supported under PLD here (the GENERIC
+        # mechanism composes through the loss distribution,
+        # budget_accounting.py PLDBudgetAccountant._compose_distributions) —
+        # the reference disallows it (/root/reference/pipeline_dp/
+        # dp_engine.py:511-521); this framework lifts that restriction.
+        del is_public_partition
         supported = [
             Metrics.COUNT, Metrics.PRIVACY_ID_COUNT, Metrics.SUM, Metrics.MEAN
         ]

@@ -369,6 +369,11 @@ class MultiProcLocalBackend(PipelineBackend):
         self._n_jobs = n_jobs or mp.cpu_count()
         self._local = LocalBackend()
 
+    def to_multi_transformable_collection(self, col):
+        # Generators from this backend's lazy stages are single-iteration;
+        # the contract requires re-iterability.
+        return list(col)
+
     def _pool_map(self, fn, data):
         with self._mp.Pool(self._n_jobs,
                            initializer=_pool_worker_init,

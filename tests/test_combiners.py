@@ -1,0 +1,460 @@
+"""Combiner create/merge/compute matrix (reference: tests/combiners_test.py).
+
+Every public combiner gets the create-accumulator / merge / compute-metrics
+triad tested, in both the no-noise (huge-eps) and noised regimes, plus the
+factory's metric -> combiner-set mapping and worker-boundary pickling.
+"""
+
+import pickle
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import combiners, dp_computations
+from pipelinedp_tpu.aggregate_params import MechanismType
+
+HUGE_EPS = 1e6
+
+
+def _params(**kwargs):
+    defaults = dict(metrics=[pdp.Metrics.COUNT],
+                    max_partitions_contributed=2,
+                    max_contributions_per_partition=3,
+                    min_value=0.0,
+                    max_value=5.0)
+    defaults.update(kwargs)
+    return pdp.AggregateParams(**defaults)
+
+
+def _spec(mechanism_type=MechanismType.LAPLACE, eps=HUGE_EPS, n_specs=1):
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=eps,
+                                           total_delta=1e-6)
+    specs = [accountant.request_budget(mechanism_type)
+             for _ in range(n_specs)]
+    accountant.compute_budgets()
+    return specs[0] if n_specs == 1 else specs
+
+
+class TestCountCombiner:
+
+    def _combiner(self, eps=HUGE_EPS, mech=MechanismType.LAPLACE):
+        return combiners.CountCombiner(_spec(mech, eps), _params())
+
+    def test_create_accumulator(self):
+        c = self._combiner()
+        assert c.create_accumulator([]) == 0
+        assert c.create_accumulator([1, 2, 3]) == 3
+
+    def test_merge_accumulators(self):
+        assert self._combiner().merge_accumulators(2, 5) == 7
+
+    def test_compute_metrics_no_noise(self):
+        got = self._combiner().compute_metrics(5)
+        assert got["count"] == pytest.approx(5, abs=1e-2)
+
+    def test_compute_metrics_with_noise(self):
+        c = self._combiner(eps=1.0)
+        draws = np.array([c.compute_metrics(1000)["count"]
+                          for _ in range(300)])
+        assert draws.std() > 1.0  # noise actually applied
+        assert draws.mean() == pytest.approx(1000, abs=draws.std())
+
+    @pytest.mark.parametrize("mech,dist", [
+        (MechanismType.LAPLACE, "laplace"),
+        (MechanismType.GAUSSIAN, "gaussian"),
+    ])
+    def test_mechanism_kind(self, mech, dist):
+        c = self._combiner(mech=mech)
+        assert dist in type(c.get_mechanism()).__name__.lower()
+
+    def test_sensitivities(self):
+        s = self._combiner().sensitivities()
+        # l0 = max_partitions, linf = max_contributions_per_partition.
+        assert s.l0 == 2 and s.linf == 3
+
+    def test_explain_computation(self):
+        text = self._combiner().explain_computation()()
+        assert "DP count" in text
+
+    def test_metrics_names(self):
+        assert self._combiner().metrics_names() == ["count"]
+
+    def test_pickle_roundtrip_drops_mechanism(self):
+        c = self._combiner()
+        c.get_mechanism()  # populate the lazy cache
+        c2 = pickle.loads(pickle.dumps(c))
+        assert c2.compute_metrics(5)["count"] == pytest.approx(5, abs=1e-2)
+
+
+class TestPrivacyIdCountCombiner:
+
+    def _combiner(self, eps=HUGE_EPS):
+        return combiners.PrivacyIdCountCombiner(_spec(eps=eps), _params())
+
+    def test_create_accumulator_is_presence_indicator(self):
+        c = self._combiner()
+        assert c.create_accumulator([1, 2, 3]) == 1
+        assert c.create_accumulator([]) == 0
+
+    def test_merge_and_compute(self):
+        c = self._combiner()
+        assert c.merge_accumulators(1, 1) == 2
+        assert c.compute_metrics(9)["privacy_id_count"] == pytest.approx(
+            9, abs=1e-2)
+
+    def test_no_per_partition_sampling_needed(self):
+        assert not self._combiner().expects_per_partition_sampling()
+
+    def test_sensitivities(self):
+        s = self._combiner().sensitivities()
+        assert s.l0 == 2 and s.linf == 1
+
+
+class TestSumCombiner:
+
+    def _per_contribution(self, eps=HUGE_EPS):
+        return combiners.SumCombiner(_spec(eps=eps),
+                                     _params(metrics=[pdp.Metrics.SUM]))
+
+    def _per_partition(self, eps=HUGE_EPS):
+        params = _params(metrics=[pdp.Metrics.SUM],
+                         min_value=None,
+                         max_value=None,
+                         min_sum_per_partition=0.0,
+                         max_sum_per_partition=10.0)
+        return combiners.SumCombiner(_spec(eps=eps), params)
+
+    def test_create_accumulator_clips_each_contribution(self):
+        c = self._per_contribution()
+        # [-1 -> 0, 10 -> 5, 2 -> 2]
+        assert c.create_accumulator([-1.0, 10.0, 2.0]) == pytest.approx(7.0)
+        assert c.create_accumulator([]) == 0.0
+
+    def test_create_accumulator_clips_partition_sum(self):
+        c = self._per_partition()
+        assert c.create_accumulator([20.0, 5.0]) == pytest.approx(10.0)
+        assert c.create_accumulator([-50.0]) == pytest.approx(0.0)
+        assert c.create_accumulator([3.0, 4.0]) == pytest.approx(7.0)
+
+    @pytest.mark.parametrize("per_partition", [False, True])
+    def test_merge_accumulators(self, per_partition):
+        c = self._per_partition() if per_partition else (
+            self._per_contribution())
+        assert c.merge_accumulators(3.0, 4.5) == pytest.approx(7.5)
+
+    def test_compute_metrics_no_noise(self):
+        got = self._per_contribution().compute_metrics(12.5)
+        assert got["sum"] == pytest.approx(12.5, abs=1e-2)
+
+    def test_compute_metrics_with_noise(self):
+        c = self._per_contribution(eps=1.0)
+        draws = np.array([c.compute_metrics(100.0)["sum"]
+                          for _ in range(300)])
+        assert draws.std() > 1.0
+        assert draws.mean() == pytest.approx(100.0, abs=3 * draws.std())
+
+    def test_sampling_requirement_depends_on_regime(self):
+        assert self._per_contribution().expects_per_partition_sampling()
+        assert not self._per_partition().expects_per_partition_sampling()
+
+    def test_per_partition_sensitivity_ignores_linf(self):
+        # Per-partition bounds: linf = max(|min_sum|, |max_sum|), l0 = 2.
+        s = self._per_partition().sensitivities()
+        assert s.l0 == 2 and s.linf == pytest.approx(10.0)
+
+
+class TestMeanCombiner:
+
+    def _combiner(self, eps=HUGE_EPS, metrics=("mean",)):
+        count_spec, sum_spec = _spec(eps=eps, n_specs=2)
+        params = _params(metrics=[pdp.Metrics.MEAN], min_value=0.0,
+                         max_value=10.0)
+        return combiners.MeanCombiner(count_spec, sum_spec, params,
+                                      list(metrics))
+
+    def test_create_accumulator_normalizes_to_middle(self):
+        c = self._combiner()
+        count, nsum = c.create_accumulator([1.0, 5.0])
+        assert count == 2
+        assert nsum == pytest.approx((1.0 - 5.0) + (5.0 - 5.0))
+
+    def test_create_accumulator_clips(self):
+        _, nsum = self._combiner().create_accumulator([100.0])
+        assert nsum == pytest.approx(5.0)  # clip to 10, normalize -5
+
+    def test_merge(self):
+        assert self._combiner().merge_accumulators((2, 1.0),
+                                                   (3, -0.5)) == (5, 0.5)
+
+    def test_compute_metrics_no_noise(self):
+        got = self._combiner(metrics=("mean", "count", "sum"))
+        res = got.compute_metrics((4, -8.0))  # values average 5 - 2 = 3
+        assert res["mean"] == pytest.approx(3.0, abs=1e-2)
+        assert res["count"] == pytest.approx(4, abs=1e-2)
+        assert res["sum"] == pytest.approx(12.0, abs=0.1)
+
+    def test_requires_mean_in_metrics(self):
+        with pytest.raises(ValueError, match="mean"):
+            self._combiner(metrics=("count",))
+
+    def test_rejects_duplicates(self):
+        with pytest.raises(ValueError):
+            self._combiner(metrics=("mean", "mean"))
+
+    def test_rejects_unknown_metric(self):
+        with pytest.raises(ValueError):
+            self._combiner(metrics=("mean", "variance"))
+
+
+class TestVarianceCombiner:
+
+    def _combiner(self, eps=HUGE_EPS, metrics=("variance",)):
+        params = _params(metrics=[pdp.Metrics.VARIANCE], min_value=0.0,
+                         max_value=12.0, max_contributions_per_partition=5)
+        return combiners.VarianceCombiner(
+            combiners.CombinerParams(_spec(eps=eps), params), list(metrics))
+
+    def test_create_accumulator(self):
+        count, nsum, nsum2 = self._combiner().create_accumulator([2.0, 8.0])
+        assert count == 2
+        assert nsum == pytest.approx((2 - 6) + (8 - 6))
+        assert nsum2 == pytest.approx(16 + 4)
+
+    def test_merge(self):
+        got = self._combiner().merge_accumulators((1, 2.0, 4.0),
+                                                  (2, -1.0, 1.0))
+        assert got == (3, 1.0, 5.0)
+
+    def test_compute_metrics_no_noise(self):
+        c = self._combiner(metrics=("variance", "mean", "count", "sum"))
+        values = np.array([2.0, 4.0, 6.0, 8.0])
+        acc = c.create_accumulator(values)
+        res = c.compute_metrics(acc)
+        assert res["count"] == pytest.approx(4, abs=1e-2)
+        assert res["mean"] == pytest.approx(values.mean(), abs=1e-2)
+        assert res["variance"] == pytest.approx(values.var(), abs=0.3)
+
+    def test_requires_variance_in_metrics(self):
+        with pytest.raises(ValueError, match="variance"):
+            self._combiner(metrics=("mean",))
+
+
+class TestQuantileCombiner:
+
+    def _combiner(self, percentiles=(50,), eps=HUGE_EPS):
+        params = _params(metrics=[pdp.Metrics.PERCENTILE(p)
+                                  for p in percentiles],
+                         min_value=0.0, max_value=100.0)
+        return combiners.QuantileCombiner(
+            combiners.CombinerParams(_spec(eps=eps), params),
+            list(percentiles))
+
+    def test_accumulator_is_serialized_bytes(self):
+        acc = self._combiner().create_accumulator([1.0, 2.0])
+        assert isinstance(acc, bytes)
+
+    def test_merge_is_tree_merge(self):
+        c = self._combiner()
+        left = c.create_accumulator([10.0] * 50)
+        right = c.create_accumulator([90.0] * 50)
+        merged = c.merge_accumulators(left, right)
+        res = c.compute_metrics(merged)
+        assert 10.0 <= res["percentile_50"] <= 90.0
+
+    def test_compute_metrics_no_noise(self):
+        c = self._combiner(percentiles=(25, 75))
+        acc = c.create_accumulator(list(np.linspace(0, 100, 1000)))
+        res = c.compute_metrics(acc)
+        assert res["percentile_25"] == pytest.approx(25.0, abs=2.0)
+        assert res["percentile_75"] == pytest.approx(75.0, abs=2.0)
+
+    def test_metrics_names_formatting(self):
+        c = self._combiner(percentiles=(25, 99.9))
+        assert c.metrics_names() == ["percentile_25", "percentile_99_9"]
+
+    def test_pickles_across_worker_boundary(self):
+        c = self._combiner()
+        acc = c.create_accumulator([50.0] * 100)
+        c2 = pickle.loads(pickle.dumps(c))
+        res = c2.compute_metrics(acc)
+        assert res["percentile_50"] == pytest.approx(50.0, abs=2.0)
+
+
+class TestVectorSumCombiner:
+
+    def _combiner(self, eps=HUGE_EPS):
+        params = _params(metrics=[pdp.Metrics.VECTOR_SUM],
+                         min_value=None, max_value=None,
+                         max_contributions_per_partition=10,
+                         vector_norm_kind=pdp.NormKind.Linf,
+                         vector_max_norm=100.0, vector_size=2)
+        return combiners.VectorSumCombiner(
+            combiners.CombinerParams(_spec(eps=eps), params))
+
+    def test_create_accumulator(self):
+        got = self._combiner().create_accumulator(
+            [np.array([1.0, 2.0]), np.array([3.0, 4.0])])
+        np.testing.assert_allclose(got, [4.0, 6.0])
+
+    def test_create_accumulator_empty(self):
+        np.testing.assert_allclose(self._combiner().create_accumulator([]),
+                                   [0.0, 0.0])
+
+    def test_shape_mismatch_raises(self):
+        with pytest.raises(TypeError, match="Shape mismatch"):
+            self._combiner().create_accumulator([np.array([1.0, 2.0, 3.0])])
+
+    def test_merge(self):
+        got = self._combiner().merge_accumulators(np.array([1.0, 1.0]),
+                                                  np.array([2.0, 3.0]))
+        np.testing.assert_allclose(got, [3.0, 4.0])
+
+    def test_compute_metrics_no_noise(self):
+        res = self._combiner().compute_metrics(np.array([5.0, -2.0]))
+        np.testing.assert_allclose(res["vector_sum"], [5.0, -2.0], atol=0.1)
+
+
+class TestCompoundCombiner:
+
+    def _compound(self, eps=HUGE_EPS):
+        params = _params(metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM])
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=eps,
+                                               total_delta=1e-6)
+        compound = combiners.create_compound_combiner(params, accountant)
+        accountant.compute_budgets()
+        return compound
+
+    def test_accumulator_carries_row_count(self):
+        compound = self._compound()
+        acc = compound.create_accumulator([1.0, 2.0])
+        assert acc[0] == 1
+        count_acc, sum_acc = acc[1]
+        assert count_acc == 2 and sum_acc == pytest.approx(3.0)
+
+    def test_merge_sums_row_count_and_children(self):
+        compound = self._compound()
+        a = compound.create_accumulator([1.0])
+        b = compound.create_accumulator([2.0, 3.0])
+        row_count, (count_acc, sum_acc) = compound.merge_accumulators(a, b)
+        assert row_count == 2
+        assert count_acc == 3 and sum_acc == pytest.approx(6.0)
+
+    def test_compute_metrics_returns_named_tuple(self):
+        compound = self._compound()
+        acc = compound.create_accumulator([1.0, 4.0])
+        res = compound.compute_metrics(acc)
+        assert res._fields == ("count", "sum")
+        assert res.count == pytest.approx(2, abs=1e-2)
+        assert res.sum == pytest.approx(5.0, abs=1e-2)
+
+    def test_named_tuple_pickles(self):
+        compound = self._compound()
+        res = compound.compute_metrics(compound.create_accumulator([1.0]))
+        res2 = pickle.loads(pickle.dumps(res))
+        assert res2 == res
+
+    def test_duplicate_metric_names_rejected(self):
+        params = _params()
+        specs = _spec(n_specs=2)
+        dup = [combiners.CountCombiner(specs[0], params),
+               combiners.CountCombiner(specs[1], params)]
+        with pytest.raises(ValueError):
+            combiners.CompoundCombiner(dup, return_named_tuple=True)
+
+
+class TestCreateCompoundCombiner:
+
+    CASES = [
+        ([pdp.Metrics.COUNT], [combiners.CountCombiner], 1),
+        ([pdp.Metrics.SUM], [combiners.SumCombiner], 1),
+        ([pdp.Metrics.PRIVACY_ID_COUNT],
+         [combiners.PrivacyIdCountCombiner], 1),
+        ([pdp.Metrics.COUNT, pdp.Metrics.SUM],
+         [combiners.CountCombiner, combiners.SumCombiner], 2),
+        ([pdp.Metrics.MEAN], [combiners.MeanCombiner], 2),
+        # MEAN folds COUNT and SUM into one mechanism pair.
+        ([pdp.Metrics.MEAN, pdp.Metrics.COUNT, pdp.Metrics.SUM],
+         [combiners.MeanCombiner], 2),
+        ([pdp.Metrics.VARIANCE], [combiners.VarianceCombiner], 1),
+        # VARIANCE subsumes all of mean/count/sum.
+        ([pdp.Metrics.VARIANCE, pdp.Metrics.MEAN, pdp.Metrics.COUNT],
+         [combiners.VarianceCombiner], 1),
+        ([pdp.Metrics.COUNT, pdp.Metrics.PRIVACY_ID_COUNT],
+         [combiners.CountCombiner, combiners.PrivacyIdCountCombiner], 2),
+        # All percentiles share one QuantileCombiner and one budget.
+        ([pdp.Metrics.PERCENTILE(10), pdp.Metrics.PERCENTILE(90)],
+         [combiners.QuantileCombiner], 1),
+    ]
+
+    @pytest.mark.parametrize("metrics,expected_types,expected_requests",
+                             CASES)
+    def test_metric_to_combiner_mapping(self, metrics, expected_types,
+                                        expected_requests):
+        params = _params(metrics=metrics)
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                               total_delta=1e-6)
+        compound = combiners.create_compound_combiner(params, accountant)
+        assert [type(c) for c in compound.combiners] == expected_types
+        assert len(accountant._mechanisms) == expected_requests
+        accountant.compute_budgets()
+
+    def test_vector_sum_mapping(self):
+        params = _params(metrics=[pdp.Metrics.VECTOR_SUM],
+                         min_value=None, max_value=None,
+                         vector_norm_kind=pdp.NormKind.L2,
+                         vector_max_norm=10.0, vector_size=3)
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                               total_delta=1e-6)
+        compound = combiners.create_compound_combiner(params, accountant)
+        assert [type(c) for c in compound.combiners
+                ] == [combiners.VectorSumCombiner]
+        accountant.compute_budgets()
+
+
+class TestCustomCombiners:
+
+    class SumOfSquares(combiners.CustomCombiner):
+
+        def create_accumulator(self, values):
+            return float(sum(v**2 for v in values))
+
+        def merge_accumulators(self, a, b):
+            return a + b
+
+        def compute_metrics(self, acc):
+            return {"sum_squares": acc}
+
+        def explain_computation(self):
+            return lambda: "sum of squares"
+
+        def request_budget(self, budget_accountant):
+            self._budget = budget_accountant.request_budget(
+                MechanismType.LAPLACE)
+
+        def metrics_names(self):
+            return ["sum_squares"]
+
+    def test_custom_compound_plain_tuple_output(self):
+        params = _params(custom_combiners=[])
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                               total_delta=1e-6)
+        custom = self.SumOfSquares()
+        compound = combiners.create_compound_combiner_with_custom_combiners(
+            params, accountant, [custom])
+        accountant.compute_budgets()
+        acc = compound.create_accumulator([2.0, 3.0])
+        assert acc[1][0] == pytest.approx(13.0)
+        res = compound.compute_metrics(acc)
+        assert res == ({"sum_squares": 13.0},)
+
+    def test_custom_combiner_receives_params_and_budget(self):
+        params = _params(custom_combiners=[])
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                               total_delta=1e-6)
+        custom = self.SumOfSquares()
+        combiners.create_compound_combiner_with_custom_combiners(
+            params, accountant, [custom])
+        accountant.compute_budgets()
+        assert custom._budget.eps == pytest.approx(1.0)
+        assert custom._aggregate_params is not None

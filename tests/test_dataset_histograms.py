@@ -205,6 +205,185 @@ class TestPreaggregatedHistograms:
                 sorted((b.lower, b.count) for b in want_h.bins), field
 
 
+def _preaggregate(rows):
+    from pipelinedp_tpu.analysis import pre_aggregation
+    ext = DataExtractors(privacy_id_extractor=lambda x: x[0],
+                         partition_extractor=lambda x: x[1],
+                         value_extractor=lambda x: 0)
+    return list(pre_aggregation.preaggregate(rows, BACKEND, ext))
+
+
+def _bins(raw_fn, preagg_fn, rows, pre_aggregated, distinct=False):
+    """Runs one histogram computation on raw or preaggregated (pid, pk).
+
+    The raw functions consume (pid, pk) tuples — distinct pairs for the
+    l0 / privacy-id-per-partition histograms, with duplicates otherwise
+    (see compute_dataset_histograms wiring).
+    """
+    if pre_aggregated:
+        col = [(pk, agg) for pk, agg in _preaggregate(rows)]
+        return _get(preagg_fn(col, BACKEND))
+    col = sorted(set(rows)) if distinct else rows
+    return _get(raw_fn(col, BACKEND))
+
+
+class TestPerHistogramEdgeCases:
+    """Edge-case matrix per histogram type, raw and pre-aggregated inputs
+    (reference: tests/dataset_histograms/computing_histograms_test.py)."""
+
+    L0_CASES = [
+        ("empty", [], []),
+        ("small", [(1, 1), (1, 2), (2, 1)],
+         [(1, 1, 1, 1), (2, 1, 2, 2)]),
+        ("each_id_one_contribution", [(i, i) for i in range(100)],
+         [(1, 100, 100, 1)]),
+        ("one_id_one_partition", [(0, 0)], [(1, 1, 1, 1)]),
+        ("one_id_many_partitions_log_bin", [(0, i) for i in range(1234)],
+         [(1230, 1, 1234, 1234)]),
+        ("two_ids_overlapping", [(0, i) for i in range(15)] +
+         [(1, i) for i in range(10, 25)], [(15, 2, 30, 15)]),
+    ]
+
+    @pytest.mark.parametrize("pre_aggregated", [False, True],
+                             ids=["raw", "preagg"])
+    @pytest.mark.parametrize("name,rows,expected",
+                             L0_CASES, ids=[c[0] for c in L0_CASES])
+    def test_l0(self, name, rows, expected, pre_aggregated):
+        h = _bins(ch._compute_l0_contributions_histogram,
+                  ch._compute_l0_contributions_histogram_on_preaggregated_data,
+                  rows, pre_aggregated, distinct=True)
+        assert h.name == hist.HistogramType.L0_CONTRIBUTIONS
+        got = [(b.lower, b.count, b.sum, b.max) for b in h.bins]
+        assert got == expected, name
+
+    L1_CASES = [
+        ("empty", [], []),
+        ("small", [(1, 1), (1, 2), (2, 1)],
+         [(1, 1, 1, 1), (2, 1, 2, 2)]),
+        ("one_id_repeat_one_partition", [(0, 0)] * 100,
+         [(100, 1, 100, 100)]),
+        ("one_id_many_partitions", [(0, i // 2) for i in range(1235)],
+         [(1230, 1, 1235, 1235)]),
+        ("three_ids", [(0, i) for i in range(15)] +
+         [(1, i) for i in range(10, 25)] + [(2, i) for i in range(11)],
+         [(11, 1, 11, 11), (15, 2, 30, 15)]),
+    ]
+
+    @pytest.mark.parametrize("pre_aggregated", [False, True],
+                             ids=["raw", "preagg"])
+    @pytest.mark.parametrize("name,rows,expected",
+                             L1_CASES, ids=[c[0] for c in L1_CASES])
+    def test_l1(self, name, rows, expected, pre_aggregated):
+        h = _bins(ch._compute_l1_contributions_histogram,
+                  ch._compute_l1_contributions_histogram_on_preaggregated_data,
+                  rows, pre_aggregated)
+        assert h.name == hist.HistogramType.L1_CONTRIBUTIONS
+        got = [(b.lower, b.count, b.sum, b.max) for b in h.bins]
+        assert got == expected, name
+
+    LINF_CASES = [
+        ("empty", [], []),
+        ("small", [(1, 1), (1, 2), (2, 1)],
+         [(1, 3, 3, 1)]),
+        ("one_pair_repeated", [(0, 0)] * 1234,
+         [(1230, 1, 1234, 1234)]),
+        ("mixed_pairs", [(0, 0)] * 3 + [(0, 1)] * 2 + [(1, 0)],
+         [(1, 1, 1, 1), (2, 1, 2, 2), (3, 1, 3, 3)]),
+    ]
+
+    @pytest.mark.parametrize("pre_aggregated", [False, True],
+                             ids=["raw", "preagg"])
+    @pytest.mark.parametrize("name,rows,expected",
+                             LINF_CASES, ids=[c[0] for c in LINF_CASES])
+    def test_linf(self, name, rows, expected, pre_aggregated):
+        h = _bins(
+            ch._compute_linf_contributions_histogram,
+            ch._compute_linf_contributions_histogram_on_preaggregated_data,
+            rows, pre_aggregated)
+        assert h.name == hist.HistogramType.LINF_CONTRIBUTIONS
+        got = [(b.lower, b.count, b.sum, b.max) for b in h.bins]
+        assert got == expected, name
+
+    COUNT_PER_PARTITION_CASES = [
+        ("empty", [], []),
+        ("two_partitions", [(1, 1), (1, 2), (2, 1)],
+         [(1, 1, 1, 1), (2, 1, 2, 2)]),
+        ("one_partition_many_rows", [(i % 7, 0) for i in range(999)],
+         [(999, 1, 999, 999)]),
+    ]
+
+    @pytest.mark.parametrize("pre_aggregated", [False, True],
+                             ids=["raw", "preagg"])
+    @pytest.mark.parametrize("name,rows,expected",
+                             COUNT_PER_PARTITION_CASES,
+                             ids=[c[0] for c in COUNT_PER_PARTITION_CASES])
+    def test_count_per_partition(self, name, rows, expected, pre_aggregated):
+        h = _bins(ch._compute_partition_count_histogram,
+                  ch._compute_partition_count_histogram_on_preaggregated_data,
+                  rows, pre_aggregated)
+        assert h.name == hist.HistogramType.COUNT_PER_PARTITION
+        got = [(b.lower, b.count, b.sum, b.max) for b in h.bins]
+        assert got == expected, name
+
+    PID_PER_PARTITION_CASES = [
+        ("empty", [], []),
+        ("two_partitions", [(1, 1), (1, 2), (2, 1)],
+         [(1, 1, 1, 1), (2, 1, 2, 2)]),
+        ("distinct_ids_counted_once", [(0, 0)] * 50 + [(1, 0)] * 50,
+         [(2, 1, 2, 2)]),
+    ]
+
+    @pytest.mark.parametrize("pre_aggregated", [False, True],
+                             ids=["raw", "preagg"])
+    @pytest.mark.parametrize("name,rows,expected",
+                             PID_PER_PARTITION_CASES,
+                             ids=[c[0] for c in PID_PER_PARTITION_CASES])
+    def test_privacy_id_per_partition(self, name, rows, expected,
+                                      pre_aggregated):
+        h = _bins(
+            ch._compute_partition_privacy_id_count_histogram,
+            ch.
+            _compute_partition_privacy_id_count_histogram_on_preaggregated_data,
+            rows, pre_aggregated, distinct=True)
+        assert h.name == hist.HistogramType.COUNT_PRIVACY_ID_PER_PARTITION
+        got = [(b.lower, b.count, b.sum, b.max) for b in h.bins]
+        assert got == expected, name
+
+
+class TestLinfSumHistogram:
+    """Float-binned sum-contributions histogram (10k buckets)."""
+
+    def _rows(self, sums):
+        # One ((pid, pk), value) row per requested per-pair sum.
+        return [((i, i), s) for i, s in enumerate(sums)]
+
+    def test_single_value(self):
+        h = _get(
+            ch._compute_linf_sum_contributions_histogram(
+                self._rows([5.0]), BACKEND))
+        assert h.name == hist.HistogramType.LINF_SUM_CONTRIBUTIONS
+        assert len(h.bins) == 1
+        assert h.bins[0].count == 1
+        assert h.bins[0].sum == pytest.approx(5.0)
+
+    def test_uniform_values_fill_buckets(self):
+        sums = list(np.linspace(0.0, 100.0, 1000))
+        h = _get(
+            ch._compute_linf_sum_contributions_histogram(
+                self._rows(sums), BACKEND))
+        assert h.total_count() == 1000
+        assert h.total_sum() == pytest.approx(sum(sums), rel=1e-6)
+        assert h.max_value() == pytest.approx(100.0)
+
+    def test_negative_values(self):
+        sums = [-10.0, -5.0, 0.0, 5.0]
+        h = _get(
+            ch._compute_linf_sum_contributions_histogram(
+                self._rows(sums), BACKEND))
+        assert h.total_count() == 4
+        assert h.bins[0].lower == pytest.approx(-10.0)
+
+
 class TestErrorEstimator:
 
     def test_estimate_rmse_count(self):

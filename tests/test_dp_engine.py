@@ -470,12 +470,14 @@ class TestValidation:
         with pytest.raises(TypeError):
             engine.aggregate([1], "not params", pdp.DataExtractors())
 
-    def test_pld_accountant_private_partitions_unsupported(self):
+    def test_pld_accountant_unsupported_metric_raises(self):
         accountant = pdp.PLDBudgetAccountant(1.0, 1e-6)
         engine = pdp.DPEngine(accountant, pdp.LocalBackend())
-        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.VARIANCE],
                                      max_partitions_contributed=1,
-                                     max_contributions_per_partition=1)
+                                     max_contributions_per_partition=1,
+                                     min_value=0.0,
+                                     max_value=1.0)
         extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r,
                                         partition_extractor=lambda r: r,
                                         value_extractor=lambda r: 0)
@@ -506,3 +508,43 @@ class TestPLDAccountingEndToEnd:
         accountant.compute_budgets()
         result = dict(result)
         assert result["A"].sum == pytest.approx(9.0, abs=0.5)
+
+    @pytest.mark.parametrize("backend_name", BACKENDS)
+    def test_private_partition_selection_under_pld(self, backend_name):
+        # The reference forbids private selection under PLD
+        # (/root/reference/pipeline_dp/dp_engine.py:511-521); here the
+        # GENERIC selection mechanism composes through the PLD, so crowded
+        # partitions are kept and sparse ones dropped.
+        backend = make_backend(backend_name)
+        accountant = pdp.PLDBudgetAccountant(total_epsilon=1e4,
+                                             total_delta=1e-4,
+                                             pld_discretization=1e-3)
+        engine = pdp.DPEngine(accountant, backend)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        rows = [(f"u{i}", "crowded", 1.0) for i in range(500)]
+        rows += [("solo", "sparse", 1.0)]
+        extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                        partition_extractor=lambda r: r[1],
+                                        value_extractor=lambda r: r[2])
+        result = engine.aggregate(rows, params, extractors)
+        accountant.compute_budgets()
+        result = dict(result)
+        assert "crowded" in result
+        assert "sparse" not in result
+        assert result["crowded"].count == pytest.approx(500, rel=0.05)
+
+    def test_select_partitions_under_pld(self):
+        accountant = pdp.PLDBudgetAccountant(total_epsilon=1e4,
+                                             total_delta=1e-4,
+                                             pld_discretization=1e-3)
+        engine = pdp.DPEngine(accountant, pdp.LocalBackend(seed=0))
+        rows = [(f"u{i}", "big") for i in range(500)]
+        extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                        partition_extractor=lambda r: r[1],
+                                        value_extractor=lambda r: 0)
+        params = pdp.SelectPartitionsParams(max_partitions_contributed=1)
+        result = engine.select_partitions(rows, params, extractors)
+        accountant.compute_budgets()
+        assert list(result) == ["big"]

@@ -1,0 +1,315 @@
+"""Per-op backend semantics matrix (reference: tests/pipeline_backend_test.py).
+
+One behavioral contract, asserted across every backend that can execute in
+this environment (LocalBackend, MultiProcLocalBackend, TPUBackend's generic
+op path). Beam/Spark adapters are exercised by tests/test_private_apis.py
+via fake runners.
+"""
+
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import combiners, pipeline_backend, pipeline_functions
+
+
+def _local():
+    return pdp.LocalBackend(seed=0)
+
+
+def _multiproc():
+    return pdp.MultiProcLocalBackend(n_jobs=2)
+
+
+def _tpu_generic():
+    # TPUBackend inherits the generic op vocabulary; the fused path only
+    # takes over inside DPEngine.aggregate.
+    return pdp.TPUBackend(noise_seed=0)
+
+
+BACKENDS = [_local, _multiproc, _tpu_generic]
+BACKEND_IDS = ["local", "multiproc", "tpu-generic"]
+
+
+@pytest.fixture(params=BACKENDS, ids=BACKEND_IDS)
+def backend(request):
+    return request.param()
+
+
+class TestElementwiseOps:
+
+    def test_map_empty(self, backend):
+        assert list(backend.map([], lambda x: x / 0, "map")) == []
+
+    def test_map(self, backend):
+        assert list(backend.map([1, 2, 3], str, "map")) == ["1", "2", "3"]
+        assert list(backend.map(range(5), lambda x: x**2,
+                                "map")) == [0, 1, 4, 9, 16]
+
+    def test_map_with_side_inputs(self, backend):
+        if isinstance(backend, pdp.MultiProcLocalBackend):
+            pytest.skip("side inputs not supported on multiproc")
+        got = backend.map_with_side_inputs([1, 2],
+                                           lambda x, l1, l2: [x] + l1 + l2,
+                                           [[3, 4, 5], [6]], "side")
+        assert list(got) == [[1, 3, 4, 5, 6], [2, 3, 4, 5, 6]]
+
+    def test_flat_map(self, backend):
+        assert list(backend.flat_map([[1, 2], [3]], lambda x: x,
+                                     "fm")) == [1, 2, 3]
+        pairs = [("a", [1, 2]), ("b", [3])]
+        assert list(
+            backend.flat_map(pairs, lambda kv: [(kv[0], v) for v in kv[1]],
+                             "fm")) == [("a", 1), ("a", 2), ("b", 3)]
+
+    def test_flat_map_empty_inner(self, backend):
+        assert list(backend.flat_map([[], [], [7]], lambda x: x, "fm")) == [7]
+
+    def test_map_tuple(self, backend):
+        data = [(1, 2), (2, 3), (3, 4)]
+        assert list(backend.map_tuple(data, lambda k, v: k + v,
+                                      "mt")) == [3, 5, 7]
+        assert list(backend.map_tuple(data, lambda k, v: (str(k), str(v)),
+                                      "mt")) == [("1", "2"), ("2", "3"),
+                                                 ("3", "4")]
+
+    def test_map_values(self, backend):
+        assert list(backend.map_values([], lambda x: x / 0, "mv")) == []
+        data = [(1, 2), (2, 3), (3, 4)]
+        assert list(backend.map_values(data, lambda x: x**2,
+                                       "mv")) == [(1, 4), (2, 9), (3, 16)]
+
+    def test_filter(self, backend):
+        assert list(backend.filter([], lambda x: True, "f")) == []
+        data = [1, 2, 2, 3, 3, 4, 2]
+        assert list(backend.filter(data, lambda x: x % 2, "f")) == [1, 3, 3]
+        assert list(backend.filter(data, lambda x: x < 3,
+                                   "f")) == [1, 2, 2, 2]
+
+    def test_keys_values(self, backend):
+        data = [(1, 2), (2, 3), (3, 4), (4, 8)]
+        assert list(backend.keys([], "k")) == []
+        assert list(backend.keys(data, "k")) == [1, 2, 3, 4]
+        assert list(backend.values([], "v")) == []
+        assert list(backend.values(data, "v")) == [2, 3, 4, 8]
+
+
+class TestKeyedOps:
+
+    def test_group_by_key(self, backend):
+        data = [("cheese", "brie"), ("bread", "sourdough"),
+                ("cheese", "swiss")]
+        got = {k: sorted(v) for k, v in backend.group_by_key(data, "g")}
+        assert got == {
+            "cheese": ["brie", "swiss"],
+            "bread": ["sourdough"],
+        }
+
+    def test_group_by_key_unhashable_values_ok(self, backend):
+        data = [(1, [1, 2]), (1, [3])]
+        got = dict(backend.group_by_key(data, "g"))
+        assert sorted(got[1]) == [[1, 2], [3]]
+
+    def test_filter_by_key_empty_keys(self, backend):
+        col = [(7, 1), (2, 1), (3, 9)]
+        assert list(backend.filter_by_key(col, [], "fbk")) == []
+
+    def test_filter_by_key(self, backend):
+        col = [(7, 1), (2, 1), (3, 9), (4, 1), (9, 10)]
+        got = sorted(backend.filter_by_key(col, [7, 9], "fbk"))
+        assert got == [(7, 1), (9, 10)]
+
+    def test_filter_by_key_none_raises_or_keeps_nothing(self, backend):
+        # keys_to_keep must be a collection; None is a misuse.
+        col = [(1, 1)]
+        with pytest.raises(TypeError):
+            list(backend.filter_by_key(col, None, "fbk"))
+
+    def test_count_per_element(self, backend):
+        data = [1, 2, 3, 4, 5, 6, 1, 4, 0, 1]
+        assert dict(backend.count_per_element(data, "c")) == {
+            1: 3, 2: 1, 3: 1, 4: 2, 5: 1, 6: 1, 0: 1}
+
+    def test_sum_per_key(self, backend):
+        data = [(1, 2), (2, 1), (1, 4), (3, 8), (2, -3), (10, 5)]
+        got = sorted(backend.sum_per_key(data, "s"))
+        assert got == [(1, 6), (2, -2), (3, 8), (10, 5)]
+
+    def test_reduce_per_key(self, backend):
+        data = [(1, 2), (2, 1), (1, 4), (3, 8), (2, 3)]
+        got = sorted(backend.reduce_per_key(data, lambda x, y: x + y, "r"))
+        assert got == [(1, 6), (2, 4), (3, 8)]
+
+    def test_combine_accumulators_per_key(self, backend):
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=10)
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=1e6,
+                                               total_delta=1e-6)
+        compound = combiners.create_compound_combiner(params, accountant)
+        accountant.compute_budgets()
+        data = [(1, [1, 1]), (1, [1]), (2, [1])]
+        col = backend.map_values(data, compound.create_accumulator, "acc")
+        col = backend.combine_accumulators_per_key(col, compound, "comb")
+        # row_count counts merged (pid, pk) accumulators — the
+        # privacy-unit count partition selection consumes.
+        got = {k: acc[0] for k, acc in col}
+        assert got == {1: 2, 2: 1}
+
+
+class TestCollectionOps:
+
+    def test_flatten(self, backend):
+        got = list(backend.flatten(([1, 2], [3], [4, 5]), "fl"))
+        assert sorted(got) == [1, 2, 3, 4, 5]
+
+    def test_flatten_with_empty(self, backend):
+        assert sorted(backend.flatten(([], [1], []), "fl")) == [1]
+
+    def test_distinct(self, backend):
+        data = [3, 2, 1, 3, 5, 4, 1, 1, 2]
+        assert set(backend.distinct(data, "d")) == {1, 2, 3, 4, 5}
+
+    def test_to_list(self, backend):
+        got = list(backend.to_list([1, 2, 3], "tl"))
+        assert len(got) == 1
+        assert sorted(got[0]) == [1, 2, 3]
+
+    def test_to_multi_transformable_collection(self, backend):
+        col = backend.to_multi_transformable_collection(iter([1, 2, 3]))
+        assert list(backend.map(col, lambda x: x, "m1")) == [1, 2, 3]
+        assert list(backend.map(col, lambda x: x, "m2")) == [1, 2, 3]
+
+
+class TestSampling:
+
+    def test_sample_fixed_per_key_no_discard_below_cap(self, backend):
+        data = [("pid1", ("pk1", 1)), ("pid1", ("pk2", 1)),
+                ("pid1", ("pk3", 1)), ("pid2", ("pk4", 1))]
+        got = {k: sorted(v) for k, v in
+               backend.sample_fixed_per_key(data, 3, "s")}
+        assert got == {
+            "pid1": [("pk1", 1), ("pk2", 1), ("pk3", 1)],
+            "pid2": [("pk4", 1)],
+        }
+
+    def test_sample_fixed_per_key_caps(self, backend):
+        data = [(("pid1", "pk1"), 1)] * 5 + [(("pid1", "pk2"), 1)] * 2
+        got = dict(backend.sample_fixed_per_key(data, 3, "s"))
+        assert len(got[("pid1", "pk1")]) == 3
+        assert len(got[("pid1", "pk2")]) == 2
+        # Sampled values are a subset of the input values.
+        assert set(got[("pid1", "pk1")]) == {1}
+
+    def test_sample_fixed_per_key_is_uniform_ish(self):
+        # Statistical: sampling 1 of [0..3] many times covers all values.
+        backend = pdp.LocalBackend(seed=None)
+        seen = set()
+        for _ in range(200):
+            data = [("k", v) for v in range(4)]
+            got = dict(backend.sample_fixed_per_key(data, 1, "s"))
+            seen.add(got["k"][0])
+        assert seen == {0, 1, 2, 3}
+
+
+class TestLaziness:
+    """Local ops must not consume their input at graph-build time."""
+
+    @staticmethod
+    def _poison():
+        yield 1 / 0
+
+    @pytest.mark.parametrize("op", [
+        lambda b, c: b.map(c, str, "m"),
+        lambda b, c: b.map_values(c, str, "mv"),
+        lambda b, c: b.filter(c, bool, "f"),
+        lambda b, c: b.values(c, "v"),
+        lambda b, c: b.keys(c, "k"),
+        lambda b, c: b.count_per_element(c, "c"),
+        lambda b, c: b.sum_per_key(c, "s"),
+        lambda b, c: b.flat_map(c, str, "fm"),
+        lambda b, c: b.sample_fixed_per_key(c, 2, "sf"),
+        lambda b, c: b.filter_by_key(c, [1], "fbk"),
+        lambda b, c: b.distinct(c, "d"),
+        lambda b, c: b.group_by_key(c, "g"),
+        lambda b, c: b.reduce_per_key(c, lambda x, y: x, "r"),
+    ])
+    def test_op_is_lazy(self, op):
+        backend = pdp.LocalBackend()
+        op(backend, self._poison())  # must not raise at build time
+        with pytest.raises(ZeroDivisionError):
+            list(op(backend, self._poison()))
+
+
+class TestPipelineFunctions:
+
+    def test_key_by(self):
+        backend = pdp.LocalBackend()
+        got = list(
+            pipeline_functions.key_by(backend, [1, 2, 3], lambda x: x % 2,
+                                      "kb"))
+        assert sorted(got) == [(0, 2), (1, 1), (1, 3)]
+
+    def test_size(self):
+        backend = pdp.LocalBackend()
+        assert list(pipeline_functions.size(backend, [5, 6, 7], "sz")) == [3]
+
+    def test_min_max_elements(self):
+        backend = pdp.LocalBackend()
+        got = list(
+            pipeline_functions.min_max_elements(backend, [3, 1, 4, 1, 5],
+                                                "mm"))
+        assert got == [(1, 5)]
+
+    def test_collect_to_container(self):
+        import dataclasses
+
+        @dataclasses.dataclass
+        class Box:
+            total: int
+            items: list
+
+        backend = pdp.LocalBackend()
+        got = list(
+            pipeline_functions.collect_to_container(
+                backend, {
+                    "total": backend.to_list([3], "t"),
+                    "items": backend.to_list([1, 2], "i"),
+                }, Box, "collect"))
+        assert len(got) == 1
+        assert got[0].total == [3]
+
+
+class TestAnnotator:
+
+    def test_annotate_hook_receives_kwargs(self):
+        calls = []
+
+        class Recorder(pipeline_backend.Annotator):
+
+            def annotate(self, col, backend, stage_name, **kwargs):
+                calls.append((stage_name, kwargs))
+                return col
+
+        pipeline_backend.register_annotator(Recorder())
+        try:
+            backend = pdp.LocalBackend()
+            out = backend.annotate([1, 2], "stage-x", foo=42)
+            assert list(out) == [1, 2]
+            assert calls and calls[0][0] == "stage-x"
+            assert calls[0][1]["foo"] == 42
+        finally:
+            pipeline_backend._annotators.clear()
+
+
+class TestUniqueLabels:
+
+    def test_unique_labels_suffix_and_dedup(self):
+        gen = pipeline_backend.UniqueLabelsGenerator("sfx")
+        a = gen.unique("stage")
+        b = gen.unique("stage")
+        assert a != b
+        assert "sfx" in a and "sfx" in b
+
+    def test_unique_labels_empty_name(self):
+        gen = pipeline_backend.UniqueLabelsGenerator("")
+        assert gen.unique("") != gen.unique("")
