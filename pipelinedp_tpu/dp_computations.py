@@ -377,6 +377,37 @@ def compute_dp_var_noise_stds(eps: float, delta: float, l0: int, linf: int,
     return count_std, nsum_std, nsum2_std
 
 
+def noise_sensitivity(l0_sensitivity: float, linf_sensitivity: float,
+                      noise_kind: NoiseKind) -> float:
+    """The norm sensitivity matching `noise_std`'s mechanism: l1 for
+    Laplace, l2 for Gaussian (used for secure-noise grid calibration)."""
+    if noise_kind == NoiseKind.LAPLACE:
+        return compute_l1_sensitivity(l0_sensitivity, linf_sensitivity)
+    if noise_kind == NoiseKind.GAUSSIAN:
+        return compute_l2_sensitivity(l0_sensitivity, linf_sensitivity)
+    raise ValueError("Only Laplace and Gaussian noise is supported.")
+
+
+def compute_dp_var_noise_sensitivities(
+        l0: int, linf: int, min_value: float, max_value: float,
+        noise_kind: NoiseKind) -> Tuple[float, float, float]:
+    """Per-slot norm sensitivities matching compute_dp_var_noise_stds."""
+    mid = compute_middle(min_value, max_value)
+    sq_lo, sq_hi = compute_squares_interval(min_value, max_value)
+    mid2 = compute_middle(sq_lo, sq_hi)
+    return (noise_sensitivity(l0, linf, noise_kind),
+            noise_sensitivity(l0, linf * abs(mid - min_value), noise_kind),
+            noise_sensitivity(l0, linf * abs(mid2 - sq_lo), noise_kind))
+
+
+def vector_noise_sensitivity(
+        noise_params: AdditiveVectorNoiseParams) -> float:
+    """Per-coordinate norm sensitivity matching vector_noise_std."""
+    return noise_sensitivity(noise_params.l0_sensitivity,
+                             noise_params.linf_sensitivity,
+                             noise_params.noise_kind)
+
+
 def compute_dp_count_noise_std(dp_params: ScalarNoiseParams) -> float:
     return _compute_noise_std(dp_params.max_contributions_per_partition,
                               dp_params)

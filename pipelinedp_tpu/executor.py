@@ -46,6 +46,7 @@ from pipelinedp_tpu import dp_computations
 from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
                                              Metrics, NoiseKind, NormKind)
 from pipelinedp_tpu.ops import noise as noise_ops
+from pipelinedp_tpu.ops import secure_noise
 from pipelinedp_tpu.ops import segment_ops
 from pipelinedp_tpu.ops import selection_ops
 
@@ -93,6 +94,11 @@ class KernelConfig:
     tree_height: int = 0
     branching: int = 0
     quantile_chunk: int = 0  # partitions per histogram chunk (memory bound)
+    # Secure release mode: snapped grid + discrete table-sampled noise
+    # (ops/secure_noise.py) instead of continuous f32 draws — the device
+    # counterpart of the reference's PyDP snapped mechanisms
+    # (dp_computations.py:131-152).
+    secure: bool = False
 
 
 SUPPORTED_COLUMNAR_METRICS = (Metrics.COUNT, Metrics.PRIVACY_ID_COUNT,
@@ -182,6 +188,40 @@ def compute_noise_stds(compound: dp_combiners.CompoundCombiner,
         else:
             raise NotImplementedError(type(child))
     return np.asarray(stds, dtype=np.float64)
+
+
+def compute_noise_sensitivities(compound: dp_combiners.CompoundCombiner,
+                                params: AggregateParams) -> np.ndarray:
+    """Per-slot norm sensitivities, in the same order as compute_noise_stds
+    (l1 for Laplace slots, l2 for Gaussian) — consumed by the secure-noise
+    grid calibration, which must compensate the +1 grid-unit sensitivity
+    snapping introduces."""
+    sens: List[float] = []
+    for child in compound.combiners:
+        if isinstance(
+                child,
+            (dp_combiners.CountCombiner, dp_combiners.PrivacyIdCountCombiner,
+             dp_combiners.SumCombiner)):
+            sens.append(child.get_mechanism().sensitivity)
+        elif isinstance(child, dp_combiners.MeanCombiner):
+            mech = child.get_mechanism()
+            sens.append(mech.count_mechanism.sensitivity)
+            sens.append(mech.sum_mechanism.sensitivity)
+        elif isinstance(child, dp_combiners.VarianceCombiner):
+            sens.extend(
+                dp_computations.compute_dp_var_noise_sensitivities(
+                    params.max_partitions_contributed,
+                    params.max_contributions_per_partition, params.min_value,
+                    params.max_value, params.noise_kind))
+        elif isinstance(child, dp_combiners.VectorSumCombiner):
+            sens.append(
+                dp_computations.vector_noise_sensitivity(
+                    child._params.additive_vector_noise_params))
+        elif isinstance(child, dp_combiners.QuantileCombiner):
+            sens.append(0.0)  # quantile slot: secure mode rejects it
+        else:
+            raise NotImplementedError(type(child))
+    return np.asarray(sens, dtype=np.float64)
 
 
 def _variance_stds(child: dp_combiners.VarianceCombiner,
@@ -408,11 +448,14 @@ def _clip_rows_to_norm_ball(vecs, max_norm: float, norm_kind: NormKind):
 
 
 def finalize(cols, min_v, mid, stds: jnp.ndarray, final_key: jax.Array,
-             cfg: KernelConfig):
+             cfg: KernelConfig, secure_tables=None):
     """Phase 2: DP partition selection + noise + metric formulas.
 
     On the multi-chip path `cols` are globally psum'd columns; this phase is
     computed identically on every shard (same key -> same results).
+
+    secure_tables: (thr_hi (S, L) u32, thr_lo (S, L) u32, gran (S,)) built
+    by secure_noise.build_tables — required when cfg.secure.
     """
     f = _ftype()
     key_sel, key_noise = jax.random.split(final_key, 2)
@@ -426,6 +469,10 @@ def finalize(cols, min_v, mid, stds: jnp.ndarray, final_key: jax.Array,
     else:
         keep = jnp.ones(P, dtype=bool)
 
+    if cfg.secure and secure_tables is None:
+        raise ValueError("cfg.secure requires secure_tables "
+                         "(secure_noise.build_tables)")
+
     outputs = {}
     std_offset = 0
     for i, entry in enumerate(cfg.plan):
@@ -433,9 +480,16 @@ def finalize(cols, min_v, mid, stds: jnp.ndarray, final_key: jax.Array,
         kind = cfg.noise_kind
 
         def noised(col, std_idx, subkey_idx):
-            return col + noise_ops.additive_noise(
-                jax.random.fold_in(ekey, subkey_idx), col.shape,
-                stds[std_idx].astype(f), kind)
+            subkey = jax.random.fold_in(ekey, subkey_idx)
+            if cfg.secure:
+                thr_hi, thr_lo, gran = secure_tables
+                return secure_noise.snapped_noisy(col.astype(f), subkey,
+                                                  thr_hi[std_idx],
+                                                  thr_lo[std_idx],
+                                                  gran[std_idx])
+            return col + noise_ops.additive_noise(subkey, col.shape,
+                                                  stds[std_idx].astype(f),
+                                                  kind)
 
         if entry.kind == 'count':
             outputs['count'] = noised(cols['count'], std_offset, 0)
@@ -628,13 +682,13 @@ def quantile_outputs(qrows, min_v, max_v, stds, key: jax.Array,
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def aggregate_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
-                     stds, rng_key, cfg: KernelConfig):
+                     stds, rng_key, cfg: KernelConfig, secure_tables=None):
     """Single-device fused program: partial_columns + finalize."""
     rows_key, final_key = jax.random.split(rng_key, 2)
     cols, qrows = partial_columns(pid, pk, values, valid, min_v, max_v, min_s,
                                   max_s, mid, rows_key, cfg)
     outputs, keep, row_count = finalize(cols, min_v, mid, stds, final_key,
-                                        cfg)
+                                        cfg, secure_tables)
     if cfg.quantiles:
         qkey = jax.random.fold_in(rng_key, 7919)
         outputs.update(
@@ -647,8 +701,8 @@ def make_kernel_config(
         compound: dp_combiners.CompoundCombiner,
         n_partitions: int,
         private_selection: bool,
-        selection_params: Optional[selection_ops.SelectionParams]
-) -> KernelConfig:
+        selection_params: Optional[selection_ops.SelectionParams],
+        secure: bool = False) -> KernelConfig:
     """Builds the static kernel config from aggregation parameters."""
     vector = Metrics.VECTOR_SUM in (params.metrics or [])
     clip_per_value = params.bounds_per_contribution_are_set and not vector
@@ -677,6 +731,10 @@ def make_kernel_config(
         # extra chunk costs another pass over the row stream.
         n_leaves = branching**tree_height
         quantile_chunk = max(1, min(n_partitions, (1 << 25) // n_leaves))
+    if secure and quantiles:
+        raise NotImplementedError(
+            "Secure discrete noise does not yet cover the percentile tree "
+            "path; drop PERCENTILE metrics or disable secure_noise.")
     return KernelConfig(
         n_partitions=n_partitions,
         linf=params.max_contributions_per_partition or 0,
@@ -699,7 +757,8 @@ def make_kernel_config(
         quantiles=quantiles,
         tree_height=tree_height,
         branching=branching,
-        quantile_chunk=quantile_chunk)
+        quantile_chunk=quantile_chunk,
+        secure=secure)
 
 
 def kernel_scalars(params: AggregateParams):
@@ -808,9 +867,17 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
                     f"TPUBackend(max_partitions={backend.max_partitions}) is "
                     f"smaller than the {n_partitions} partitions in the data.")
             n_partitions = backend.max_partitions
+        secure = bool(getattr(backend, "secure_noise", False))
         cfg = make_kernel_config(params, compound, n_partitions, private,
-                                 selection_params)
+                                 selection_params, secure=secure)
         stds = compute_noise_stds(compound, params)
+        secure_tables = None
+        if secure:
+            thr_hi, thr_lo, gran = secure_noise.build_tables(
+                stds, params.noise_kind,
+                sensitivities=compute_noise_sensitivities(compound, params))
+            secure_tables = (jnp.asarray(thr_hi), jnp.asarray(thr_lo),
+                             jnp.asarray(gran, dtype=_ftype()))
         key = noise_ops.make_noise_key(getattr(backend, "noise_seed", None))
         min_v, max_v, min_s, max_s, mid = kernel_scalars(params)
         pid, pk, values, valid = pad_rows(encoded)
@@ -818,12 +885,12 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
             from pipelinedp_tpu.parallel import sharded
             outputs, keep, _ = sharded.sharded_aggregate_arrays(
                 backend.mesh, pid, pk, values, valid, min_v, max_v, min_s,
-                max_s, mid, stds, key, cfg)
+                max_s, mid, stds, key, cfg, secure_tables)
         else:
             outputs, keep, _ = aggregate_kernel(
                 jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(values),
                 jnp.asarray(valid), min_v, max_v, min_s, max_s, mid,
-                jnp.asarray(stds), key, cfg)
+                jnp.asarray(stds), key, cfg, secure_tables)
         yield from decode_results(outputs, keep, encoded.partition_vocab,
                                   compound)
 

@@ -66,9 +66,10 @@ def shard_rows_by_pid(pid: np.ndarray, pk: np.ndarray, values: np.ndarray,
 
 @partial(jax.jit, static_argnames=("cfg", "mesh"))
 def _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
-                    stds, rng_key, cfg: executor.KernelConfig, mesh: Mesh):
+                    stds, rng_key, cfg: executor.KernelConfig, mesh: Mesh,
+                    secure_tables=None):
 
-    def per_shard(pid_s, pk_s, values_s, valid_s, stds_r, key_r):
+    def per_shard(pid_s, pk_s, values_s, valid_s, stds_r, key_r, tables_r):
         shard_idx = jax.lax.axis_index(SHARD_AXIS)
         rows_key, final_key = jax.random.split(key_r, 2)
         # Distinct sampling randomness per shard; identical finalize key.
@@ -78,7 +79,7 @@ def _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
                                                mid, shard_rows_key, cfg)
         cols = jax.tree.map(lambda x: jax.lax.psum(x, SHARD_AXIS), cols)
         outputs, keep, row_count = executor.finalize(cols, min_v, mid, stds_r,
-                                                     final_key, cfg)
+                                                     final_key, cfg, tables_r)
         if cfg.quantiles:
             # Chunk histograms are psum'd inside quantile_outputs (tree
             # merge over the mesh); noise + descent replicated via key_r.
@@ -91,15 +92,15 @@ def _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
     fn = jax.shard_map(per_shard,
                        mesh=mesh,
                        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                                 P(SHARD_AXIS), P(), P()),
+                                 P(SHARD_AXIS), P(), P(), P()),
                        out_specs=P(),
                        check_vma=False)
-    return fn(pid, pk, values, valid, stds, rng_key)
+    return fn(pid, pk, values, valid, stds, rng_key, secure_tables)
 
 
 def sharded_aggregate_arrays(mesh: Mesh, pid, pk, values, valid, min_v, max_v,
                              min_s, max_s, mid, stds, rng_key,
-                             cfg: executor.KernelConfig):
+                             cfg: executor.KernelConfig, secure_tables=None):
     """Shards rows by pid over `mesh` and runs the two-phase fused program.
 
     Accepts host numpy arrays (any length); returns the same
@@ -117,4 +118,5 @@ def sharded_aggregate_arrays(mesh: Mesh, pid, pk, values, valid, min_v, max_v,
     values = jax.device_put(jnp.asarray(values), sharding)
     valid = jax.device_put(jnp.asarray(valid), sharding)
     return _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s,
-                           mid, jnp.asarray(stds), rng_key, cfg, mesh)
+                           mid, jnp.asarray(stds), rng_key, cfg, mesh,
+                           secure_tables)

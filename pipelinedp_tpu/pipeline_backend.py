@@ -317,16 +317,24 @@ class TPUBackend(LocalBackend):
             in the data — reuse it across datasets to avoid recompiles.
         noise_seed: base seed for the on-device counter-based RNG. None ->
             fresh nondeterministic seed per aggregation.
+        secure_noise: release values snapped to a discrete grid with
+            table-sampled discrete Laplace/Gaussian noise
+            (ops/secure_noise.py) instead of continuous f32 draws — the
+            device counterpart of the reference's PyDP snapped secure
+            mechanisms (dp_computations.py:131-152). Costs one O(log K)
+            table search per released value.
     """
 
     def __init__(self,
                  mesh=None,
                  max_partitions: Optional[int] = None,
-                 noise_seed: Optional[int] = None):
+                 noise_seed: Optional[int] = None,
+                 secure_noise: bool = False):
         super().__init__(seed=noise_seed)
         self.mesh = mesh
         self.max_partitions = max_partitions
         self.noise_seed = noise_seed
+        self.secure_noise = secure_noise
 
     @property
     def is_tpu(self) -> bool:

@@ -535,6 +535,32 @@ class TestPLDAccountingEndToEnd:
         assert "sparse" not in result
         assert result["crowded"].count == pytest.approx(500, rel=0.05)
 
+    def test_private_selection_under_pld_true_composition_path(self):
+        # total_epsilon below the naive-fallback threshold: this exercises
+        # the real PLD binary search with the GENERIC selection mechanism
+        # composed through _compose_distributions (not the fallback split).
+        accountant = pdp.PLDBudgetAccountant(total_epsilon=5.0,
+                                             total_delta=1e-5,
+                                             pld_discretization=1e-3)
+        engine = pdp.DPEngine(accountant, pdp.LocalBackend(seed=0))
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        rows = [(f"u{i}", "crowded", 1.0) for i in range(2000)]
+        rows += [("solo", "sparse", 1.0)]
+        extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                        partition_extractor=lambda r: r[1],
+                                        value_extractor=lambda r: r[2])
+        result = engine.aggregate(rows, params, extractors)
+        accountant.compute_budgets()
+        # The GENERIC spec received eps/delta from the PLD search, and the
+        # count mechanism received a noise std.
+        assert accountant.minimum_noise_std > 0
+        result = dict(result)
+        assert "crowded" in result
+        assert "sparse" not in result
+        assert result["crowded"].count == pytest.approx(2000, rel=0.05)
+
     def test_select_partitions_under_pld(self):
         accountant = pdp.PLDBudgetAccountant(total_epsilon=1e4,
                                              total_delta=1e-4,
