@@ -116,6 +116,83 @@ def acquire_device(max_wait_sec=480.0):
     return dev, f"tpu-init-failed: {last_msg[:160]}"
 
 
+def _bench_eps_sweep(jax, jnp, on_tpu):
+    """BASELINE config 5: 64-parameter-config utility-analysis ε-sweep,
+    vmapped over the config axis in one jit-compiled program
+    (analysis/kernels.sweep_kernel)."""
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu.analysis import error_model as em
+    from pipelinedp_tpu.analysis import kernels as analysis_kernels
+
+    n_rows = 2**21 if on_tpu else 2**17
+    n_partitions = 2**14 if on_tpu else 2**10
+    l0_grid = [1, 2, 4, 8, 16, 32, 64, 128]
+    linf_grid = [1, 2, 4, 8, 16, 32, 64, 128]
+    configs = [
+        pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                            noise_kind=pdp.NoiseKind.GAUSSIAN,
+                            max_partitions_contributed=l0,
+                            max_contributions_per_partition=linf)
+        for l0 in l0_grid for linf in linf_grid
+    ]
+    noise_stds = np.array([[
+        em.config_noise_std(p, pdp.Metrics.COUNT, 1.0, 1e-6)
+    ] for p in configs])
+    cfg = analysis_kernels.build_config_arrays(configs, [pdp.Metrics.COUNT],
+                                               noise_stds, (1.0, 1e-6))
+    rng = np.random.default_rng(11)
+    counts = rng.integers(1, 16, n_rows).astype(np.float64)
+    sums = rng.random(n_rows) * 5.0
+    contributed = rng.integers(1, 256, n_rows).astype(np.float64)
+    pk_idx = rng.integers(0, n_partitions, n_rows).astype(np.int32)
+
+    def run():
+        out = analysis_kernels.sweep_kernel(
+            counts,
+            sums,
+            contributed,
+            pk_idx,
+            cfg,
+            n_partitions_total=n_partitions,
+            metric_codes=(analysis_kernels.METRIC_CODES[pdp.Metrics.COUNT],),
+            public=False,
+            return_per_partition=False)
+        return float(np.asarray(out["bucket_rows"]).sum())
+
+    run()  # compile
+    start = time.perf_counter()
+    checksum = run()
+    elapsed = time.perf_counter() - start
+    del checksum
+    return {
+        "eps_sweep_configs": len(configs),
+        "eps_sweep_rows": n_rows,
+        "eps_sweep_partitions": n_partitions,
+        "eps_sweep_sec": round(elapsed, 4),
+        "eps_sweep_config_rows_per_sec": round(
+            len(configs) * n_rows / elapsed),
+    }
+
+
+def _bench_ingest():
+    """Host ingest throughput: raw key columns -> vocab-encoded int arrays
+    (columnar.encode_columns, the 1B-row bottleneck flagged in round 2)."""
+    from pipelinedp_tpu import columnar
+    n = 4_000_000
+    rng = np.random.default_rng(3)
+    pids = rng.integers(0, 1_000_000, n)
+    pks = np.char.add("movie_", rng.integers(0, 100_000, n).astype(str))
+    vals = rng.random(n)
+    start = time.perf_counter()
+    encoded = columnar.encode_columns(pids, pks, vals)
+    elapsed = time.perf_counter() - start
+    return {
+        "ingest_rows": n,
+        "ingest_rows_per_sec": round(n / elapsed),
+        "ingest_partitions": encoded.n_partitions,
+    }
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--rows", type=int, default=200_000_000,
@@ -213,6 +290,12 @@ def main():
     total_rows = n_chunks * chunk
     records_per_sec = total_rows / elapsed
 
+    # --- BASELINE config 5: 64-config ε-sweep as ONE compiled program. ---
+    sweep_detail = _bench_eps_sweep(jax, jnp, on_tpu)
+
+    # --- Host ingest: vectorized vocab factorization (columnar.encode). ---
+    ingest_detail = _bench_ingest()
+
     # Noise-distribution fidelity: KS statistic of 1M device noise draws
     # vs the CPU reference distribution at the same calibrated stddev
     # (BASELINE.json metric "noise-dist KS-stat vs CPU ref").
@@ -243,6 +326,8 @@ def main():
                 "device": str(device),
                 "kept_partitions": int(np.asarray(keep).sum()),
                 "noise_ks_stat_vs_cpu_ref": round(ks, 5),
+                **sweep_detail,
+                **ingest_detail,
                 **({"device_fallback": fallback} if fallback else {}),
             },
         }))

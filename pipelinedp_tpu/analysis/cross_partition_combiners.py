@@ -1,272 +1,117 @@
-"""Utility-analysis cross-partition combiners.
+"""Cross-partition aggregation of utility-analysis metrics.
 
-Capability parity with the reference ``analysis/cross_partition_combiners.py``:
-per-partition metrics → UtilityReport with data-drop breakdown, RMSE, and
-weighted averaging via recursive dataclass add/multiply.
+Capability parity with the reference ``analysis/cross_partition_combiners.py``
+(per-partition metrics -> UtilityReport with data-drop breakdown, RMSE and
+weighted averaging), re-designed as flat vector algebra:
+
+* A partition's contribution to the final report is a numeric matrix
+  ([n_configs, n_metrics, error_model.REPORT_WIDTH] plus a
+  [n_configs, INFO_WIDTH] partition-info block). Merging partitions is
+  element-wise addition — no recursive dataclass walking — so the same
+  reduction runs as a distributed-backend accumulator here and as a device
+  ``segment_sum`` in ``analysis/kernels.py``.
+* Result dataclasses (UtilityReport and friends) are materialized once at
+  finalization from the summed vectors (``error_model.finalize_*``).
 """
 
-import copy
-import dataclasses
-import math
-from typing import Callable, List, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from pipelinedp_tpu import aggregate_params as agg
-from pipelinedp_tpu import combiners as dp_combiners
-from pipelinedp_tpu.analysis import metrics
+from pipelinedp_tpu.analysis import error_model as em
+from pipelinedp_tpu.analysis import metrics as metrics_dc
 
 
-def _sum_metrics_to_data_dropped(
-        sum_metrics: metrics.SumMetrics, partition_keep_probability: float,
-        dp_metric: agg.Metric) -> metrics.DataDropInfo:
-    """Attributes dropped data to bounding stages (reference ``:24-47``)."""
-    linf_dropped = (sum_metrics.clipping_to_min_error -
-                    sum_metrics.clipping_to_max_error)
-    l0_dropped = -sum_metrics.expected_l0_bounding_error
-    expected_after_bounding = sum_metrics.sum - l0_dropped - linf_dropped
-    partition_selection_dropped = expected_after_bounding * (
-        1 - partition_keep_probability)
-    return metrics.DataDropInfo(
-        l0=l0_dropped,
-        linf=linf_dropped,
-        partition_selection=partition_selection_dropped)
-
-
-def _create_contribution_bounding_errors(
-        sum_metrics: metrics.SumMetrics) -> metrics.ContributionBoundingErrors:
-    l0_mean_var = metrics.MeanVariance(
-        mean=sum_metrics.expected_l0_bounding_error,
-        var=sum_metrics.std_l0_bounding_error**2)
-    return metrics.ContributionBoundingErrors(
-        l0=l0_mean_var,
-        linf_min=sum_metrics.clipping_to_min_error,
-        linf_max=sum_metrics.clipping_to_max_error)
-
-
-def _sum_metrics_to_value_error(sum_metrics: metrics.SumMetrics,
-                                keep_prob: float,
-                                weight: float) -> metrics.ValueErrors:
-    """Per-partition ValueErrors, weighted for the cross-partition average."""
-    value = sum_metrics.sum
-    bounding_errors = _create_contribution_bounding_errors(sum_metrics)
-    mean = (bounding_errors.l0.mean + bounding_errors.linf_min +
-            bounding_errors.linf_max)
-    variance = (sum_metrics.std_l0_bounding_error**2 +
-                sum_metrics.std_noise**2)
-    rmse = math.sqrt(mean**2 + variance)
-    l1 = 0  # not computed (reference TODO at :73)
-    rmse_with_dropped_partitions = (keep_prob * rmse +
-                                    (1 - keep_prob) * abs(value))
-    l1_with_dropped_partitions = 0
-    result = metrics.ValueErrors(
-        bounding_errors=bounding_errors,
-        mean=mean,
-        variance=variance,
-        rmse=rmse,
-        l1=l1,
-        rmse_with_dropped_partitions=rmse_with_dropped_partitions,
-        l1_with_dropped_partitions=l1_with_dropped_partitions)
-    if weight != 1:
-        _multiply_float_dataclasses_field(result,
-                                          weight,
-                                          fields_to_ignore=["noise_std"])
-    return result
-
-
-def _sum_metrics_to_metric_utility(
-        sum_metrics: metrics.SumMetrics, dp_metric: agg.Metric,
-        partition_keep_probability: float,
-        partition_weight: float) -> metrics.MetricUtility:
-    """Cross-partition MetricUtility from one partition's utility."""
-    data_dropped = _sum_metrics_to_data_dropped(sum_metrics,
-                                                partition_keep_probability,
-                                                dp_metric)
-    absolute_error = _sum_metrics_to_value_error(sum_metrics,
-                                                 partition_keep_probability,
-                                                 partition_weight)
-    relative_error = absolute_error.to_relative(sum_metrics.sum)
-    return metrics.MetricUtility(metric=dp_metric,
-                                 noise_std=sum_metrics.std_noise,
-                                 noise_kind=sum_metrics.noise_kind,
-                                 ratio_data_dropped=data_dropped,
-                                 absolute_error=absolute_error,
-                                 relative_error=relative_error)
-
-
-def _partition_metrics_public_partitions(
-        is_empty_partition: bool) -> metrics.PartitionsInfo:
-    result = metrics.PartitionsInfo(public_partitions=True,
-                                    num_dataset_partitions=0,
-                                    num_non_public_partitions=0,
-                                    num_empty_partitions=0)
-    if is_empty_partition:
-        result.num_empty_partitions = 1
-    else:
-        result.num_dataset_partitions = 1
-    return result
-
-
-def _partition_metrics_private_partitions(
-        prob_keep: float) -> metrics.PartitionsInfo:
-    kept_partitions = metrics.MeanVariance(mean=prob_keep,
-                                           var=prob_keep * (1 - prob_keep))
-    return metrics.PartitionsInfo(public_partitions=False,
-                                  num_dataset_partitions=1,
-                                  kept_partitions=kept_partitions)
-
-
-def _add_dataclasses_by_fields(dataclass1, dataclass2,
-                               fields_to_ignore: List[str]) -> None:
-    """Recursively adds numeric fields of dataclass2 into dataclass1."""
-    assert type(dataclass1) == type(dataclass2), \
-        f"{type(dataclass1)} != {type(dataclass2)}"
-    for field in dataclasses.fields(dataclass1):
-        if field.name in fields_to_ignore:
-            continue
-        value1 = getattr(dataclass1, field.name)
-        if value1 is None:
-            continue
-        value2 = getattr(dataclass2, field.name)
-        if dataclasses.is_dataclass(value1):
-            _add_dataclasses_by_fields(value1, value2, fields_to_ignore)
-            continue
-        setattr(dataclass1, field.name, value1 + value2)
-
-
-def _multiply_float_dataclasses_field(dataclass,
-                                      factor: float,
-                                      fields_to_ignore: List[str] = ()
-                                      ) -> None:
-    """Recursively multiplies float fields of 'dataclass' in place."""
-    for field in dataclasses.fields(dataclass):
-        if field.name in fields_to_ignore:
-            continue
-        value = getattr(dataclass, field.name)
-        if value is None:
-            continue
-        if field.type is float or isinstance(value, float):
-            setattr(dataclass, field.name, value * factor)
-        elif dataclasses.is_dataclass(value):
-            _multiply_float_dataclasses_field(value, factor)
-
-
-def _per_partition_to_utility_report(
-        per_partition_utility: metrics.PerPartitionMetrics,
-        dp_metrics: List[agg.Metric], public_partitions: bool,
-        partition_weight: float) -> metrics.UtilityReport:
-    """Converts per-partition metrics to a 1-partition UtilityReport."""
-    if public_partitions:
-        prob_to_keep = 1
-        is_empty_partition = per_partition_utility.raw_statistics.count == 0
-        partition_metrics = _partition_metrics_public_partitions(
-            is_empty_partition)
-    else:
-        prob_to_keep = (
-            per_partition_utility.partition_selection_probability_to_keep)
-        partition_metrics = _partition_metrics_private_partitions(prob_to_keep)
-    metric_errors = None
-    if dp_metrics:
-        assert len(per_partition_utility.metric_errors) == len(dp_metrics)
-        metric_errors = [
-            _sum_metrics_to_metric_utility(metric_error, dp_metric,
-                                           prob_to_keep, partition_weight)
-            for metric_error, dp_metric in zip(
-                per_partition_utility.metric_errors, dp_metrics)
-        ]
-    return metrics.UtilityReport(configuration_index=-1,
-                                 partitions_info=partition_metrics,
-                                 metric_errors=metric_errors)
-
-
-def _merge_partition_metrics(metrics1: metrics.PartitionsInfo,
-                             metrics2: metrics.PartitionsInfo) -> None:
-    _add_dataclasses_by_fields(metrics1, metrics2,
-                               ["public_partitions", "strategy"])
-
-
-def _merge_metric_utility(utility1: metrics.MetricUtility,
-                          utility2: metrics.MetricUtility) -> None:
-    _add_dataclasses_by_fields(utility1, utility2,
-                               ["metric", "noise_std", "noise_kind"])
-
-
-def _merge_utility_reports(report1: metrics.UtilityReport,
-                           report2: metrics.UtilityReport) -> None:
-    _merge_partition_metrics(report1.partitions_info, report2.partitions_info)
-    if report1.metric_errors is None:
-        return
-    assert len(report1.metric_errors) == len(report2.metric_errors)
-    for utility1, utility2 in zip(report1.metric_errors,
-                                  report2.metric_errors):
-        _merge_metric_utility(utility1, utility2)
-
-
-def _average_utility_report(report: metrics.UtilityReport, sums_actual: Tuple,
-                            total_weight: float) -> None:
-    """Averages the report's error fields across partitions."""
-    if not report.metric_errors:
-        return
-    for sum_actual, metric_error in zip(sums_actual, report.metric_errors):
-        scaling_factor = 0 if total_weight == 0 else 1.0 / total_weight
-        _multiply_float_dataclasses_field(
-            metric_error,
-            scaling_factor,
-            fields_to_ignore=["noise_std", "ratio_data_dropped"])
-        dropped_scaling_factor = 1 if sum_actual == 0 else 1.0 / sum_actual
-        _multiply_float_dataclasses_field(metric_error.ratio_data_dropped,
-                                          dropped_scaling_factor)
+def equal_weight_fn(per_partition: metrics_dc.PerPartitionMetrics) -> float:
+    """Weights partitions by their keep probability (1 for public)."""
+    return per_partition.partition_selection_probability_to_keep
 
 
 def partition_size_weight_fn(
-        per_partition_metrics: metrics.PerPartitionMetrics) -> float:
-    """Weights partitions by their size."""
-    return per_partition_metrics.metric_errors[0].sum
+        per_partition: metrics_dc.PerPartitionMetrics) -> float:
+    """Weights partitions by their (first metric's) size."""
+    return per_partition.metric_errors[0].sum
 
 
-def equal_weight_fn(
-        per_partition_metrics: metrics.PerPartitionMetrics) -> float:
-    """Weights partitions by their probability to be kept (1 for public)."""
-    return per_partition_metrics.partition_selection_probability_to_keep
+# Accumulator: (report rows [K, n_metrics, REPORT_WIDTH],
+#               info rows [K, INFO_WIDTH]).
+AccumulatorType = Tuple[np.ndarray, np.ndarray]
 
 
-class CrossPartitionCombiner(dp_combiners.Combiner):
-    """Aggregates per-partition error metrics into a UtilityReport.
-
-    Accumulator: (sum of non-DP metrics for averaging, UtilityReport,
-    accumulated weight).
-    """
-    AccumulatorType = Tuple[Tuple, metrics.UtilityReport, float]
+class CrossPartitionAggregator:
+    """Reduces per-partition metrics into per-configuration UtilityReports."""
 
     def __init__(self,
-                 dp_metrics: List[agg.Metric],
+                 metric_list: Sequence[agg.Metric],
                  public_partitions: bool,
-                 weight_fn: Callable[[metrics.PerPartitionMetrics],
+                 weight_fn: Callable[[metrics_dc.PerPartitionMetrics],
                                      float] = equal_weight_fn):
-        self._dp_metrics = dp_metrics
-        self._public_partitions = public_partitions
+        self._metric_list = list(metric_list)
+        self._public = public_partitions
         self._weight_fn = weight_fn
 
     def create_accumulator(
-            self,
-            per_partition: metrics.PerPartitionMetrics) -> AccumulatorType:
-        actual_metrics = tuple(me.sum for me in per_partition.metric_errors)
-        weight = self._weight_fn(per_partition)
-        return actual_metrics, _per_partition_to_utility_report(
-            per_partition, self._dp_metrics, self._public_partitions,
-            weight), weight
+            self, packed: Sequence[metrics_dc.PerPartitionMetrics]
+    ) -> AccumulatorType:
+        """One partition's contribution; ``packed`` has one entry per
+        configuration."""
+        k = len(packed)
+        n_metrics = len(self._metric_list)
+        rows = np.zeros((k, n_metrics, em.REPORT_WIDTH))
+        info = np.zeros((k, em.INFO_WIDTH))
+        for ki, per_partition in enumerate(packed):
+            keep_prob = (1.0 if self._public else
+                         per_partition.partition_selection_probability_to_keep)
+            weight = self._weight_fn(per_partition)
+            for mi in range(n_metrics):
+                sm = per_partition.metric_errors[mi]
+                stats = np.array([
+                    sm.sum, sm.clipping_to_min_error, sm.clipping_to_max_error,
+                    sm.expected_l0_bounding_error,
+                    sm.std_l0_bounding_error**2
+                ])
+                rows[ki, mi] = em.metric_report_terms(stats, keep_prob, weight,
+                                                      sm.std_noise)
+            n_users = per_partition.raw_statistics.privacy_id_count
+            info[ki] = em.info_terms(np.asarray(float(n_users)),
+                                     np.asarray(keep_prob),
+                                     np.asarray(weight), self._public)
+        return rows, info
 
     def merge_accumulators(self, acc1: AccumulatorType,
                            acc2: AccumulatorType) -> AccumulatorType:
-        sum_actual1, report1, weight1 = acc1
-        sum_actual2, report2, weight2 = acc2
-        sum_actual = tuple(x + y for x, y in zip(sum_actual1, sum_actual2))
-        _merge_utility_reports(report1, report2)
-        return sum_actual, report1, weight1 + weight2
+        return acc1[0] + acc2[0], acc1[1] + acc2[1]
 
-    def compute_metrics(self, acc: AccumulatorType) -> metrics.UtilityReport:
-        sum_actual, report, total_weight = acc
-        report_copy = copy.deepcopy(report)
-        _average_utility_report(report_copy, sum_actual, total_weight)
-        return report_copy
+    def compute_reports(
+        self,
+        acc: AccumulatorType,
+        noise_stds: np.ndarray,
+        noise_kinds: Sequence[agg.NoiseKind],
+        strategies: Optional[Sequence[agg.PartitionSelectionStrategy]] = None,
+    ) -> List[metrics_dc.UtilityReport]:
+        """Finalizes one report per configuration from the summed vectors.
+
+        noise_stds: [K, n_metrics]; noise_kinds/strategies: per config.
+        """
+        rows, info = acc
+        reports = []
+        for ki in range(rows.shape[0]):
+            report = em.finalize_utility_report(rows[ki], info[ki],
+                                                self._metric_list,
+                                                noise_stds[ki],
+                                                noise_kinds[ki],
+                                                self._public,
+                                                configuration_index=ki)
+            if strategies is not None:
+                report.partitions_info.strategy = strategies[ki]
+            reports.append(report)
+        return reports
+
+    # Compatibility with the backend combiner protocol (values are already
+    # accumulators when combine_accumulators_per_key runs).
+    def compute_metrics(self, acc: AccumulatorType) -> AccumulatorType:
+        return acc
 
     def metrics_names(self):
         return []

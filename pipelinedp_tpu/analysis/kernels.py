@@ -1,0 +1,341 @@
+"""Fused utility-analysis sweep kernel (jax).
+
+The whole multi-configuration utility analysis — l0 keep fractions, clipping
+error statistics, partition-selection keep probabilities and cross-partition
+report reduction — runs as ONE jit-compiled XLA program over columnar row
+arrays, with the parameter-configuration axis K materialized as an array
+dimension (BASELINE config 5: a 64-budget ε-sweep is a single compiled
+program, not 64 pipeline passes).
+
+Capability parity with the reference's vectorized accumulators
+(``analysis/per_partition_combiners.py:339-431``) and report reduction
+(``analysis/cross_partition_combiners.py``); the formulas are shared with the
+host path via ``analysis/error_model.py`` (xp=jnp).
+
+Memory shape: configs are processed in chunks of ``config_chunk`` via
+``lax.map`` and the partition-selection PMF windows in chunks of
+``partition_chunk`` partitions, so peak usage is bounded regardless of K x P.
+"""
+
+import functools
+from typing import NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipelinedp_tpu import aggregate_params as agg
+from pipelinedp_tpu.analysis import error_model as em
+from pipelinedp_tpu.ops import selection_ops
+
+
+def _generate_bucket_bounds() -> Tuple[int, ...]:
+    """Partition-size histogram buckets: [0, 1] + [1, 2, 5] * 10^i."""
+    result = [0, 1]
+    for i in range(1, 10):
+        result += [10**i, 2 * 10**i, 5 * 10**i]
+    return tuple(result)
+
+
+BUCKET_BOUNDS = _generate_bucket_bounds()
+N_BUCKETS = len(BUCKET_BOUNDS)
+
+# Metric codes (static kernel arguments; jittable stand-ins for the enum).
+METRIC_CODES = {
+    agg.Metrics.SUM: 0,
+    agg.Metrics.COUNT: 1,
+    agg.Metrics.PRIVACY_ID_COUNT: 2,
+}
+
+
+class SweepConfigArrays(NamedTuple):
+    """Per-configuration parameter arrays (all shape [K] or [K, n_metrics])."""
+    l0: np.ndarray  # max_partitions_contributed
+    lo: np.ndarray  # [K, n_metrics] clip lower bounds
+    hi: np.ndarray  # [K, n_metrics] clip upper bounds
+    noise_std: np.ndarray  # [K, n_metrics]
+    # Partition-selection scalars (see ops/selection_ops.SelectionParams):
+    sel_kind: np.ndarray
+    sel_pre_shift: np.ndarray
+    sel_eps1: np.ndarray
+    sel_delta1: np.ndarray
+    sel_n_cross: np.ndarray
+    sel_pi_cross: np.ndarray
+    sel_threshold: np.ndarray
+    sel_scale: np.ndarray
+
+
+def build_config_arrays(
+        config_params: Sequence[agg.AggregateParams],
+        metric_list: Sequence[agg.Metric],
+        noise_stds: np.ndarray,
+        selection_budget: Optional[Tuple[float, float]]) -> SweepConfigArrays:
+    """Packs per-config AggregateParams into kernel input arrays.
+
+    noise_stds: [K, n_metrics] precomputed DP noise stddevs.
+    selection_budget: (eps, delta) of the partition-selection mechanism, or
+      None for public partitions.
+    """
+    k = len(config_params)
+    n_metrics = max(len(metric_list), 1)
+    lo = np.zeros((k, n_metrics))
+    hi = np.zeros((k, n_metrics))
+    for ki, params in enumerate(config_params):
+        for mi, metric in enumerate(metric_list):
+            lo[ki, mi], hi[ki, mi] = em.metric_bounds(params, metric)
+    sel = np.zeros((8, k))
+    # Benign defaults (Laplace thresholding with scale 1) so padded/public
+    # entries never produce NaNs inside unused where-branches.
+    sel[0, :] = 1
+    sel[7, :] = 1.0
+    if selection_budget is not None:
+        eps, delta = selection_budget
+        for ki, params in enumerate(config_params):
+            sp = selection_ops.selection_params_from_host(
+                params.partition_selection_strategy, eps, delta,
+                params.max_partitions_contributed, params.pre_threshold)
+            sel[:, ki] = (sp.kind, sp.pre_shift, sp.eps1, sp.delta1,
+                          sp.n_cross, sp.pi_cross, sp.threshold, sp.scale)
+    return SweepConfigArrays(
+        l0=np.array([p.max_partitions_contributed for p in config_params],
+                    dtype=np.float64),
+        lo=lo,
+        hi=hi,
+        noise_std=np.asarray(noise_stds, dtype=np.float64),
+        sel_kind=sel[0],
+        sel_pre_shift=sel[1],
+        sel_eps1=sel[2],
+        sel_delta1=sel[3],
+        sel_n_cross=sel[4],
+        sel_pi_cross=sel[5],
+        sel_threshold=sel[6],
+        sel_scale=sel[7])
+
+
+def _keep_prob_batch(xs: jnp.ndarray, cfg: SweepConfigArrays) -> jnp.ndarray:
+    """Selector keep probability at (possibly fractional) id-counts xs.
+
+    xs: [KC, ...]; per-config selector scalars broadcast from cfg (traced
+    arrays — unlike ops/selection_ops.keep_probabilities, which specializes
+    on static python scalars). Branches for all three strategy kinds are
+    evaluated and where-selected, with inert parameters sanitized so unused
+    branches stay finite.
+    """
+    shape = (-1,) + (1,) * (xs.ndim - 1)
+    is_tg = cfg.sel_kind == 0
+    kind = cfg.sel_kind.reshape(shape)
+    n = xs - cfg.sel_pre_shift.reshape(shape)
+    eps1 = jnp.where(is_tg, cfg.sel_eps1, 1.0).reshape(shape)
+    delta1 = jnp.where(is_tg, cfg.sel_delta1, 0.5).reshape(shape)
+    n_cross = cfg.sel_n_cross.reshape(shape)
+    pi_cross = cfg.sel_pi_cross.reshape(shape)
+    threshold = cfg.sel_threshold.reshape(shape)
+    scale = jnp.maximum(cfg.sel_scale.reshape(shape), 1e-30)
+    # Truncated geometric (partition_selection.py closed form, log-space).
+    n_eff = jnp.maximum(n, 1.0)
+    n1 = jnp.minimum(n_eff, n_cross)
+    log_pi1 = (jnp.log(delta1) + (n1 - 1.0) * eps1 +
+               jnp.log1p(-jnp.exp(-n1 * eps1)) - jnp.log1p(-jnp.exp(-eps1)))
+    pi1 = jnp.exp(jnp.minimum(log_pi1, 0.0))
+    k = jnp.maximum(n_eff - n_cross, 0.0)
+    decay = jnp.exp(-k * eps1)
+    geo = jnp.where(eps1 < 700.0,
+                    jnp.exp(-eps1) * (1.0 - decay) /
+                    (1.0 - jnp.exp(-jnp.minimum(eps1, 700.0))), 0.0)
+    q = decay * (1.0 - pi_cross) - delta1 * geo
+    p_tg = jnp.clip(jnp.where(n_eff <= n_cross, pi1, 1.0 - jnp.maximum(q, 0)),
+                    0.0, 1.0)
+    # Laplace thresholding.
+    z = (n - threshold) / scale
+    p_lap = jnp.where(z >= 0, 1.0 - 0.5 * jnp.exp(-jnp.abs(z)),
+                      0.5 * jnp.exp(-jnp.abs(z)))
+    # Gaussian thresholding.
+    zg = (threshold - n) / scale
+    p_gauss = 0.5 * jax.scipy.special.erfc(zg / jnp.sqrt(2.0))
+    probs = jnp.where(kind == 0, p_tg, jnp.where(kind == 1, p_lap, p_gauss))
+    return jnp.where(n <= 0, 0.0, probs)
+
+
+def _norm_cdf_skew(z: jnp.ndarray, skew: jnp.ndarray) -> jnp.ndarray:
+    """Skew-corrected normal CDF (poisson_binomial.compute_pmf_approximation)."""
+    cdf = 0.5 * jax.scipy.special.erfc(-z / jnp.sqrt(2.0))
+    pdf = jnp.exp(-0.5 * z * z) / jnp.sqrt(2.0 * jnp.pi)
+    return jnp.clip(cdf + skew * (1.0 - z * z) * pdf / 6.0, 0.0, 1.0)
+
+
+def _windowed_keep_prob(mu, var, third, n_users, cfg: SweepConfigArrays, *,
+                        window: int, partition_chunk: int) -> jnp.ndarray:
+    """P(partition kept) from Poisson-binomial moments, per (config, pk).
+
+    Integrates the selector keep probability against the refined-normal PMF
+    over a ``window``-point support grid per partition (step >= 1 id), chunked
+    over the partition axis. mu/var/third: [KC, P]; n_users: [P].
+    """
+    kc, p = mu.shape
+    pad = (-p) % partition_chunk
+    n_chunks = (p + pad) // partition_chunk
+
+    def pad_t(x):  # [KC, P] -> [n_chunks, KC, partition_chunk]
+        x = jnp.pad(x, ((0, 0), (0, pad)))
+        return x.reshape(kc, n_chunks, partition_chunk).transpose(1, 0, 2)
+
+    mu_c, var_c, third_c = pad_t(mu), pad_t(var), pad_t(third)
+    n_c = jnp.pad(n_users, (0, pad)).reshape(n_chunks,
+                                             partition_chunk)[:, None, :]
+
+    def chunk(args):
+        mu, var, third, n_users = args  # [KC, PC] / [1, PC]
+        sigma = jnp.sqrt(jnp.maximum(var, 0.0))
+        safe_sigma = jnp.maximum(sigma, 1e-30)
+        skew = jnp.where(sigma > 0, third / safe_sigma**3, 0.0)
+        step = jnp.maximum(1.0, 16.0 * sigma / window)
+        offsets = jnp.arange(window) - (window - 1) / 2.0  # [W]
+        xs = mu[..., None] + offsets * step[..., None]  # [KC, PC, W]
+        z_hi = (xs + 0.5 * step[..., None] - mu[..., None]) / safe_sigma[...,
+                                                                         None]
+        z_lo = (xs - 0.5 * step[..., None] - mu[..., None]) / safe_sigma[...,
+                                                                         None]
+        sk = skew[..., None]
+        pmf = jnp.maximum(
+            _norm_cdf_skew(z_hi, sk) - _norm_cdf_skew(z_lo, sk), 0.0)
+        # Restrict support to [0, n_users] like the host PMF.
+        support = (xs > -0.5) & (xs <= n_users[..., None] + 0.5)
+        pmf = jnp.where(support, pmf, 0.0)
+        keep = _keep_prob_batch(xs, cfg)
+        p_win = jnp.sum(pmf * keep, axis=-1)
+        # Degenerate sigma: all-or-nothing ids -> PMF concentrated at mu.
+        p_point = _keep_prob_batch(jnp.round(mu), cfg)
+        return jnp.clip(jnp.where(sigma > 0, p_win, p_point), 0.0, 1.0)
+
+    out = jax.lax.map(chunk, (mu_c, var_c, third_c, n_c))  # [n_chunks,KC,PC]
+    return out.transpose(1, 0, 2).reshape(kc, -1)[:, :p]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("n_partitions_total", "metric_codes", "public",
+                     "config_chunk", "window", "partition_chunk",
+                     "return_per_partition"))
+def sweep_kernel(counts,
+                 sums,
+                 contributed,
+                 pk_idx,
+                 cfg: SweepConfigArrays,
+                 *,
+                 n_partitions_total: int,
+                 metric_codes: Tuple[int, ...],
+                 public: bool,
+                 config_chunk: int = 8,
+                 window: int = 64,
+                 partition_chunk: int = 4096,
+                 return_per_partition: bool = True):
+    """The fused analysis sweep.
+
+    Args:
+      counts/sums/contributed: per-(privacy_id, partition) row arrays [N]
+        (contribution count, value sum, partitions contributed by the id).
+      pk_idx: dense partition index per row [N], in [0, n_partitions_total).
+      cfg: SweepConfigArrays with leading config axis K.
+      metric_codes: static tuple of METRIC_CODES values, canonical order.
+      public: public-partition analysis (keep probability 1, empty-partition
+        bookkeeping) vs private selection modeling.
+
+    Returns dict with:
+      bucket_rows: [K, N_BUCKETS, n_metrics, REPORT_WIDTH]
+      bucket_info: [K, N_BUCKETS, INFO_WIDTH]
+      and, when return_per_partition: stats [K, P, n_metrics, STAT_WIDTH],
+      keep_prob [K, P], n_users [P], n_rows [P].
+    """
+    f = counts.dtype
+    p_total = n_partitions_total
+    n_metrics = max(len(metric_codes), 1)
+    ones = jnp.ones_like(counts)
+    seg = functools.partial(jax.ops.segment_sum,
+                            num_segments=p_total,
+                            indices_are_sorted=False)
+    n_users = seg(ones, pk_idx)
+    n_rows = seg(counts, pk_idx)
+
+    metric_vals = []
+    for code in metric_codes:
+        if code == 0:
+            metric_vals.append(sums)
+        elif code == 1:
+            metric_vals.append(counts)
+        else:
+            metric_vals.append(jnp.where(counts > 0, ones, 0.0))
+    # Partition size (for the report histogram): first metric's raw sum,
+    # privacy-id count for select-partitions analysis.
+    size = seg(metric_vals[0], pk_idx) if metric_codes else n_users
+    bounds = jnp.asarray(BUCKET_BOUNDS, dtype=f)
+    bucket = jnp.clip(
+        jnp.searchsorted(bounds, size, side="right") - 1, 0, N_BUCKETS - 1)
+    bseg = functools.partial(jax.ops.segment_sum, num_segments=N_BUCKETS)
+
+    k_total = cfg.l0.shape[0]
+    kc = min(config_chunk, k_total)
+    pad_k = (-k_total) % kc
+
+    def pad_cfg(x):
+        widths = ((0, pad_k),) + ((0, 0),) * (x.ndim - 1)
+        # Padded configs reuse config 0 so every branch stays numerically
+        # benign; their outputs are sliced off below.
+        return jnp.pad(x, widths, mode="edge").reshape(
+            (-1, kc) + x.shape[1:])
+
+    cfg_chunks = SweepConfigArrays(*[pad_cfg(jnp.asarray(x)) for x in cfg])
+
+    def chunk_fn(c: SweepConfigArrays):
+        q = em.keep_fraction(contributed[None, :], c.l0[:, None], xp=jnp)
+        stats = []
+        for mi in range(len(metric_codes)):
+            terms = em.metric_stat_terms(metric_vals[mi][None, :],
+                                         c.lo[:, mi:mi + 1],
+                                         c.hi[:, mi:mi + 1],
+                                         q,
+                                         xp=jnp)  # [KC, N, 5]
+            stats.append(jax.vmap(lambda t: seg(t, pk_idx))(terms))
+        stats = (jnp.stack(stats, axis=2) if stats else jnp.zeros(
+            (kc, p_total, 0, em.STAT_WIDTH), dtype=f))  # [KC, P, M, 5]
+        if public:
+            keep_prob = jnp.ones((kc, p_total), dtype=f)
+            weight = keep_prob
+        else:
+            sel_terms = em.selection_moment_terms(q, xp=jnp)  # [KC, N, 3]
+            sel = jax.vmap(lambda t: seg(t, pk_idx))(sel_terms)  # [KC, P, 3]
+            keep_prob = _windowed_keep_prob(sel[..., em.SEL_MU],
+                                            sel[..., em.SEL_VAR],
+                                            sel[..., em.SEL_SKEW3],
+                                            n_users,
+                                            c,
+                                            window=window,
+                                            partition_chunk=partition_chunk)
+            weight = keep_prob
+        rows = em.metric_report_terms(stats, keep_prob[..., None],
+                                      weight[..., None],
+                                      c.noise_std[:, None, :],
+                                      xp=jnp)  # [KC, P, M, 24]
+        info = em.info_terms(n_users[None, :], keep_prob, weight, public,
+                             xp=jnp)  # [KC, P, 5]
+        bucket_rows = jax.vmap(lambda r: bseg(r, bucket))(rows)
+        bucket_info = jax.vmap(lambda r: bseg(r, bucket))(info)
+        if return_per_partition:
+            return bucket_rows, bucket_info, stats, keep_prob
+        return bucket_rows, bucket_info
+
+    outs = jax.lax.map(chunk_fn, cfg_chunks)
+
+    def unchunk(x):  # [n_chunks, KC, ...] -> [K, ...]
+        return x.reshape((-1,) + x.shape[2:])[:k_total]
+
+    result = {
+        "bucket_rows": unchunk(outs[0]),
+        "bucket_info": unchunk(outs[1]),
+        "n_users": n_users,
+        "n_rows": n_rows,
+        "bucket": bucket,
+    }
+    if return_per_partition:
+        result["stats"] = unchunk(outs[2])
+        result["keep_prob"] = unchunk(outs[3])
+    return result

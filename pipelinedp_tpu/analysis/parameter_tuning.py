@@ -1,9 +1,12 @@
 """Parameter tuning from dataset histograms + utility analysis.
 
 Capability parity with the reference ``analysis/parameter_tuning.py``:
-candidate generation from contribution histograms (constant-relative-step
-grid, bin-max subsampling, 2D grids), a utility-analysis sweep over all
-candidates, and argmin-RMSE selection.
+candidate bounds generated from contribution histograms, a utility-analysis
+sweep over every candidate, and argmin-RMSE selection. Re-designed around
+numpy grid construction (geomspace / CDF-quantile subsampling / meshgrid
+cross products) instead of per-candidate accumulation loops, and the sweep
+itself runs through the dense single-program analysis path on local/TPU
+backends (``analysis/kernels.sweep_kernel``).
 """
 
 import dataclasses
@@ -11,7 +14,6 @@ import logging
 import math
 from dataclasses import dataclass
 from enum import Enum
-from numbers import Number
 from typing import Callable, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -47,9 +49,10 @@ class ParametersToTune:
 
 @dataclass
 class TuneOptions:
-    """Options for the tuning process (reference ``parameter_tuning.py:52-89``).
+    """Options for the tuning process.
 
-    Attributes not being tuned are taken from aggregate_params.
+    Attributes not being tuned are taken from aggregate_params
+    (reference ``parameter_tuning.py:52-89``).
     """
     epsilon: float
     delta: float
@@ -67,7 +70,7 @@ class TuneOptions:
 
 @dataclass
 class TuneResult:
-    """Tuning results (reference ``:92-112``)."""
+    """Tuning results (reference ``parameter_tuning.py:92-112``)."""
     options: TuneOptions
     contribution_histograms: histograms.DatasetHistograms
     utility_analysis_parameters: 'data_structures.MultiParameterConfiguration'
@@ -75,132 +78,125 @@ class TuneResult:
     utility_reports: List[metrics.UtilityReport]
 
 
+# ---------------------------------------------------------------------------
+# Candidate grids.
+# ---------------------------------------------------------------------------
+
+
+def geometric_candidates(max_value: int, n: int) -> List[int]:
+    """<= n integer candidates covering [1, max_value] at near-constant ratio.
+
+    Built as a deduplicated ceil(geomspace) — always contains 1 and
+    max_value. Replaces the reference's accumulate-and-round loop
+    (``parameter_tuning.py:236-264``) with one vectorized construction.
+    """
+    max_value = max(int(max_value), 1)
+    n = max(1, min(n, max_value))
+    if n == 1 or max_value == 1:
+        return [1]
+    grid = np.unique(
+        np.ceil(np.geomspace(1.0, float(max_value),
+                             num=n)).astype(np.int64).clip(1, max_value))
+    return grid.tolist()
+
+
+def quantile_candidates(histogram: histograms.Histogram,
+                        n: int) -> List[float]:
+    """<= n float candidates at evenly spaced mass quantiles of a histogram.
+
+    Uses each selected bin's max value, so candidates are attainable bounds;
+    the distribution's maximum is always included. Mass-quantile spacing
+    (instead of the reference's even bin-index subsampling,
+    ``parameter_tuning.py:267-275``) concentrates candidates where the data
+    actually lives.
+    """
+    counts = np.fromiter((b.count for b in histogram.bins),
+                         dtype=np.float64,
+                         count=len(histogram.bins))
+    maxes = np.fromiter((b.max for b in histogram.bins),
+                        dtype=np.float64,
+                        count=len(histogram.bins))
+    n = max(1, min(n, len(maxes)))
+    cum = np.cumsum(counts)
+    targets = np.linspace(0.0, 1.0, num=n) * cum[-1]
+    ids = np.minimum(np.searchsorted(cum, targets, side="left"),
+                     len(maxes) - 1)
+    values = np.unique(maxes[ids])
+    if values[-1] != maxes[-1]:
+        values = np.append(values, maxes[-1])
+    return values.tolist()
+
+
+def cross_product_candidates(
+        gen1: Callable[[int], Sequence], gen2: Callable[[int], Sequence],
+        budget: int) -> Tuple[List, List]:
+    """2-D candidate grid under a total-candidate budget.
+
+    Each axis starts with sqrt(budget) candidates; if one distribution
+    saturates early (fewer distinct values than asked), the spare budget is
+    re-spent on the other axis. The cross product is flattened via meshgrid.
+    """
+    per_axis = max(1, math.isqrt(budget))
+    c1, c2 = gen1(per_axis), gen2(per_axis)
+    if len(c1) < per_axis:
+        c2 = gen2(max(1, budget // len(c1)))
+    elif len(c2) < per_axis:
+        c1 = gen1(max(1, budget // len(c2)))
+    g1, g2 = np.meshgrid(np.asarray(c1), np.asarray(c2), indexing="ij")
+    return g1.ravel().tolist(), g2.ravel().tolist()
+
+
 def _find_candidate_parameters(
         hist: histograms.DatasetHistograms,
         parameters_to_tune: ParametersToTune, metric: Optional[agg.Metric],
-        max_candidates: int
-) -> 'data_structures.MultiParameterConfiguration':
-    """Candidates for l0 / linf / max_sum_per_partition (reference ``:115-179``)."""
-    calculate_l0_param = parameters_to_tune.max_partitions_contributed
-    generate_linf_count = metric == agg.Metrics.COUNT
-    generate_max_sum_per_partition = metric == agg.Metrics.SUM
-    calculate_linf_count = (
-        parameters_to_tune.max_contributions_per_partition and
-        generate_linf_count)
-    calculate_sum_per_partition_param = (
-        parameters_to_tune.max_sum_per_partition and
-        generate_max_sum_per_partition)
-    l0_bounds = linf_bounds = None
-    max_sum_per_partition_bounds = min_sum_per_partition_bounds = None
+        max_candidates: int) -> 'data_structures.MultiParameterConfiguration':
+    """Candidate bounds for l0 / linf / max_sum_per_partition."""
+    tune_l0 = parameters_to_tune.max_partitions_contributed
+    tune_linf = (parameters_to_tune.max_contributions_per_partition and
+                 metric == agg.Metrics.COUNT)
+    tune_sum = (parameters_to_tune.max_sum_per_partition and
+                metric == agg.Metrics.SUM)
+    if tune_sum and hist.linf_sum_contributions_histogram.bins and (
+            hist.linf_sum_contributions_histogram.bins[0].lower < 0):
+        logging.warning(
+            "max_sum_per_partition candidates might be negative; "
+            "min_sum_per_partition tuning is not supported yet, so "
+            "max_sum_per_partition tuning works best when "
+            "linf_sum_contributions_histogram has no negative sums")
 
-    if calculate_sum_per_partition_param:
-        if hist.linf_sum_contributions_histogram.bins[0].lower < 0:
-            logging.warning(
-                "max_sum_per_partition candidates might be negative; "
-                "min_sum_per_partition tuning is not supported yet, so "
-                "max_sum_per_partition tuning works best when "
-                "linf_sum_contributions_histogram has no negative sums")
+    gen_l0 = lambda n: geometric_candidates(
+        hist.l0_contributions_histogram.max_value(), n)
+    gen_linf = lambda n: geometric_candidates(
+        hist.linf_contributions_histogram.max_value(), n)
+    gen_sum = lambda n: quantile_candidates(
+        hist.linf_sum_contributions_histogram, n)
 
-    if calculate_l0_param and calculate_linf_count:
-        l0_bounds, linf_bounds = _find_candidates_parameters_in_2d_grid(
-            hist.l0_contributions_histogram,
-            hist.linf_contributions_histogram,
-            _find_candidates_constant_relative_step,
-            _find_candidates_constant_relative_step, max_candidates)
-    elif calculate_l0_param and calculate_sum_per_partition_param:
-        l0_bounds, max_sum_per_partition_bounds = (
-            _find_candidates_parameters_in_2d_grid(
-                hist.l0_contributions_histogram,
-                hist.linf_sum_contributions_histogram,
-                _find_candidates_constant_relative_step,
-                _find_candidates_bins_max_values_subsample, max_candidates))
-        min_sum_per_partition_bounds = [0] * len(max_sum_per_partition_bounds)
-    elif calculate_l0_param:
-        l0_bounds = _find_candidates_constant_relative_step(
-            hist.l0_contributions_histogram, max_candidates)
-    elif calculate_linf_count:
-        linf_bounds = _find_candidates_constant_relative_step(
-            hist.linf_contributions_histogram, max_candidates)
-    elif calculate_sum_per_partition_param:
-        max_sum_per_partition_bounds = (
-            _find_candidates_bins_max_values_subsample(
-                hist.linf_sum_contributions_histogram, max_candidates))
-        min_sum_per_partition_bounds = [0] * len(max_sum_per_partition_bounds)
+    l0 = linf = sum_max = sum_min = None
+    if tune_l0 and tune_linf:
+        l0, linf = cross_product_candidates(gen_l0, gen_linf, max_candidates)
+    elif tune_l0 and tune_sum:
+        l0, sum_max = cross_product_candidates(gen_l0, gen_sum,
+                                               max_candidates)
+    elif tune_l0:
+        l0 = gen_l0(max_candidates)
+    elif tune_linf:
+        linf = gen_linf(max_candidates)
+    elif tune_sum:
+        sum_max = gen_sum(max_candidates)
     else:
-        assert False, "Nothing to tune."
-
+        raise ValueError("Nothing to tune.")
+    if sum_max is not None:
+        sum_min = [0.0] * len(sum_max)
     return data_structures.MultiParameterConfiguration(
-        max_partitions_contributed=l0_bounds,
-        max_contributions_per_partition=linf_bounds,
-        min_sum_per_partition=min_sum_per_partition_bounds,
-        max_sum_per_partition=max_sum_per_partition_bounds)
+        max_partitions_contributed=l0,
+        max_contributions_per_partition=linf,
+        min_sum_per_partition=sum_min,
+        max_sum_per_partition=sum_max)
 
 
-def _find_candidates_parameters_in_2d_grid(
-        hist1: histograms.Histogram, hist2: histograms.Histogram,
-        find_candidates_func1: Callable[[histograms.Histogram, int],
-                                        Sequence[Number]],
-        find_candidates_func2: Callable[[histograms.Histogram, int],
-                                        Sequence[Number]],
-        max_candidates: int) -> Tuple[Sequence[Number], Sequence[Number]]:
-    """Cross-product grid of candidates for two parameters, rebalanced when
-    one parameter has fewer candidates than sqrt(max_candidates)
-    (reference ``:182-233``)."""
-    max_per_parameter = int(math.sqrt(max_candidates))
-    param1_candidates = find_candidates_func1(hist1, max_per_parameter)
-    param2_candidates = find_candidates_func2(hist2, max_per_parameter)
-
-    if (len(param2_candidates) < max_per_parameter and
-            len(param1_candidates) == max_per_parameter):
-        param1_candidates = find_candidates_func1(
-            hist1, int(max_candidates / len(param2_candidates)))
-    elif (len(param1_candidates) < max_per_parameter and
-          len(param2_candidates) == max_per_parameter):
-        param2_candidates = find_candidates_func2(
-            hist2, int(max_candidates / len(param1_candidates)))
-
-    param1_bounds, param2_bounds = [], []
-    for param1 in param1_candidates:
-        for param2 in param2_candidates:
-            param1_bounds.append(param1)
-            param2_bounds.append(param2)
-    return param1_bounds, param2_bounds
-
-
-def _find_candidates_constant_relative_step(histogram: histograms.Histogram,
-                                            max_candidates: int) -> List[int]:
-    """Geometric sequence of candidates from 1 to histogram.max_value
-    (reference ``:236-264``)."""
-    max_value = histogram.max_value()
-    assert max_value >= 1, "max_value has to be >= 1."
-    max_candidates = min(max_candidates, max_value)
-    assert max_candidates > 0, "max_candidates have to be positive"
-    if max_candidates == 1:
-        return [1]
-    step = pow(max_value, 1 / (max_candidates - 1))
-    candidates = [1]
-    accumulated = 1
-    for _ in range(1, max_candidates):
-        previous_candidate = candidates[-1]
-        if previous_candidate >= max_value:
-            break
-        accumulated *= step
-        next_candidate = max(previous_candidate + 1, math.ceil(accumulated))
-        candidates.append(next_candidate)
-    candidates[-1] = max_value
-    return candidates
-
-
-def _find_candidates_bins_max_values_subsample(
-        histogram: histograms.Histogram,
-        max_candidates: int) -> List[float]:
-    """Evenly-spaced subsample of the histogram bins' max values."""
-    max_candidates = min(max_candidates, len(histogram.bins))
-    ids = np.round(np.linspace(0,
-                               len(histogram.bins) - 1,
-                               num=max_candidates)).astype(int)
-    bin_maximums = np.fromiter((b.max for b in histogram.bins), dtype=float)
-    return bin_maximums[ids].tolist()
+# ---------------------------------------------------------------------------
+# Tuning driver.
+# ---------------------------------------------------------------------------
 
 
 def tune(col,
@@ -210,7 +206,7 @@ def tune(col,
          data_extractors: Union[extractors.DataExtractors,
                                 extractors.PreAggregateExtractors],
          public_partitions=None):
-    """Tunes parameters: candidates → utility analysis sweep → argmin RMSE.
+    """Tunes parameters: candidate grid -> utility sweep -> argmin RMSE.
 
     For tuning select_partitions set options.aggregate_params.metrics = [].
 
@@ -219,65 +215,41 @@ def tune(col,
         utility results).
     """
     _check_tune_args(options, public_partitions is not None)
-
-    metric = None
-    if options.aggregate_params.metrics:
-        metric = options.aggregate_params.metrics[0]
-
+    metric = (options.aggregate_params.metrics[0]
+              if options.aggregate_params.metrics else None)
     candidates = _find_candidate_parameters(
         contribution_histograms, options.parameters_to_tune, metric,
         options.number_of_parameter_candidates)
-
-    utility_analysis_options = data_structures.UtilityAnalysisOptions(
+    analysis_options = data_structures.UtilityAnalysisOptions(
         epsilon=options.epsilon,
         delta=options.delta,
         aggregate_params=options.aggregate_params,
         multi_param_configuration=candidates,
         partitions_sampling_prob=options.partitions_sampling_prob,
         pre_aggregated_data=options.pre_aggregated_data)
-
-    utility_result, per_partition_utility_result = (
-        utility_analysis.perform_utility_analysis(col, backend,
-                                                  utility_analysis_options,
-                                                  data_extractors,
-                                                  public_partitions))
-    use_public_partitions = public_partitions is not None
-
-    utility_result = backend.to_list(utility_result, "To list")
-    utility_result = backend.map(
-        utility_result,
-        lambda result: _convert_utility_analysis_to_tune_result(
-            result, options, candidates, use_public_partitions,
-            contribution_histograms), "To Tune result")
-    return utility_result, per_partition_utility_result
+    reports, per_partition = utility_analysis.perform_utility_analysis(
+        col, backend, analysis_options, data_extractors, public_partitions)
+    reports_list = backend.to_list(reports, "Collect utility reports")
+    result = backend.map(
+        reports_list, lambda rs: _to_tune_result(
+            list(rs), options, candidates, contribution_histograms),
+        "To TuneResult")
+    return result, per_partition
 
 
-def _convert_utility_analysis_to_tune_result(
-        utility_reports: Tuple[metrics.UtilityReport], tune_options:
-        TuneOptions,
-        run_configurations: 'data_structures.MultiParameterConfiguration',
-        use_public_partitions: bool,
-        contribution_histograms: histograms.DatasetHistograms) -> TuneResult:
-    assert len(utility_reports) == run_configurations.size
-    assert (tune_options.function_to_minimize ==
-            MinimizingFunction.ABSOLUTE_ERROR)
-
-    sorted_utility_reports = sorted(utility_reports,
-                                    key=lambda e: e.configuration_index)
-
-    index_best = -1  # not found (select-partitions analysis)
-    if tune_options.aggregate_params.metrics:
-        rmse = [
-            ur.metric_errors[0].absolute_error.rmse
-            for ur in sorted_utility_reports
-        ]
-        index_best = int(np.argmin(rmse))
-
-    return TuneResult(tune_options,
-                      contribution_histograms,
-                      run_configurations,
-                      index_best,
-                      utility_reports=sorted_utility_reports)
+def _to_tune_result(
+        reports: List[metrics.UtilityReport], options: TuneOptions,
+        candidates: 'data_structures.MultiParameterConfiguration',
+        hist: histograms.DatasetHistograms) -> TuneResult:
+    assert len(reports) == candidates.size
+    reports.sort(key=lambda r: r.configuration_index)
+    index_best = -1  # select-partitions analysis has no RMSE to rank
+    if options.aggregate_params.metrics:
+        index_best = int(
+            np.argmin([
+                r.metric_errors[0].absolute_error.rmse for r in reports
+            ]))
+    return TuneResult(options, hist, candidates, index_best, reports)
 
 
 def _check_tune_args(options: TuneOptions, is_public_partitions: bool):
@@ -295,11 +267,9 @@ def _check_tune_args(options: TuneOptions, is_public_partitions: bool):
     ]:
         raise ValueError("Tuning is supported only for Count, Privacy id "
                          f"count and Sum, but {tune_metrics[0]} given.")
-
     if options.parameters_to_tune.min_sum_per_partition:
         raise ValueError(
             "Tuning of min_sum_per_partition is not supported yet.")
-
     if options.function_to_minimize != MinimizingFunction.ABSOLUTE_ERROR:
         raise NotImplementedError(
             f"Only {MinimizingFunction.ABSOLUTE_ERROR} is implemented.")

@@ -1,374 +1,130 @@
-"""Utility-analysis per-partition combiners.
+"""Per-partition utility analysis for distributed backends.
 
-Capability parity with the reference ``analysis/per_partition_combiners.py``:
-closed-form per-partition error modeling (keep probability, clipping error,
-l0-bounding error moments) with the sparse↔dense accumulator switch so
-hundreds of simultaneous parameter configurations stay cheap.
+Capability parity with the reference ``analysis/per_partition_combiners.py``
+(closed-form keep probability, clipping and l0-bounding error moments,
+hundreds of parameter configurations analyzed at once), re-designed around
+the flat-array error model in ``analysis/error_model.py``:
 
-TPU-first notes: all create_accumulator kernels take whole numpy arrays of a
-partition's per-privacy-id aggregates (count, sum, n_partitions) — one batch
-per partition — and the keep-probability of the exact branch is a PMF dot
-product against a *vectorized* probability_of_keep (our selectors expose
-probability_of_keep_vec), instead of the reference's per-integer C++ calls.
+* The reference assembles ~4 combiner objects per configuration and threads
+  tuple accumulators through create/merge; here ONE ``PerPartitionAnalyzer``
+  evaluates every configuration in a single broadcasted numpy pass over the
+  partition's rows ([K, n_metrics, STAT_WIDTH] at once).
+* There is no accumulator-merge protocol: the engine groups rows by partition
+  first, so each partition is analyzed exactly once. (The TPU path doesn't
+  use this class at all — ``analysis/kernels.sweep_kernel`` computes the same
+  statistics as segment sums on the device.)
+
+Budget laziness: noise stddevs and selection strategies derive from
+MechanismSpecs whose eps/delta are finalized by
+``BudgetAccountant.compute_budgets()``; they are resolved on first use, which
+happens when the lazy pipeline is first iterated.
 """
 
-import abc
-import copy
-import math
-from dataclasses import dataclass
-from typing import Any, List, Optional, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from pipelinedp_tpu import aggregate_params as agg
-from pipelinedp_tpu import combiners as dp_combiners
-from pipelinedp_tpu import dp_computations
-from pipelinedp_tpu import partition_selection
-from pipelinedp_tpu.analysis import metrics
-from pipelinedp_tpu.analysis import poisson_binomial
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu.analysis import error_model as em
+from pipelinedp_tpu.analysis import metrics as metrics_dc
 
-MAX_PROBABILITIES_IN_ACCUMULATOR = 100
-
-# Aggregates per (privacy_id, partition_key):
-# (count, sum, num_partitions_contributed_by_privacy_id).
-PreaggregatedData = Tuple[int, float, int]
+# A preaggregated row: (count, sum, n_partitions_contributed,
+# n_contributions) for one (privacy_id, partition_key) pair.
+PreaggregatedRow = Tuple[int, float, int, int]
 
 
-class UtilityAnalysisCombiner(dp_combiners.Combiner):
+class PerPartitionAnalyzer:
+    """Analyzes one partition's rows under every parameter configuration.
 
-    @abc.abstractmethod
-    def create_accumulator(self, data: Tuple[int, float, int]):
-        """Creates an accumulator from per-(pid, pk) aggregate arrays.
-
-        data: (counts, sums, n_partitions) numpy arrays — one element per
-        privacy id contributing to this partition.
-        """
-
-    def merge_accumulators(self, acc1: Tuple, acc2: Tuple):
-        """Merges two tuples additively."""
-        return tuple(a + b for a, b in zip(acc1, acc2))
-
-    def explain_computation(self):
-        """Not used for utility analysis combiners."""
-
-    def metrics_names(self) -> List[str]:
-        return []
-
-
-@dataclass
-class SumOfRandomVariablesMoments:
-    """Moments of a sum of independent random variables."""
-    count: int
-    expectation: float
-    variance: float
-    third_central_moment: float
-
-    def __add__(
-            self, other: 'SumOfRandomVariablesMoments'
-    ) -> 'SumOfRandomVariablesMoments':
-        return SumOfRandomVariablesMoments(
-            self.count + other.count, self.expectation + other.expectation,
-            self.variance + other.variance,
-            self.third_central_moment + other.third_central_moment)
-
-
-def _l0_keep_probabilities(n_partitions: np.ndarray,
-                           max_partitions: int) -> np.ndarray:
-    """P(a contribution survives l0 bounding) = min(1, l0/n_partitions)."""
-    n = np.asarray(n_partitions, dtype=np.float64)
-    return np.where(n > 0, np.minimum(1.0, max_partitions / np.maximum(n, 1)),
-                    0.0)
-
-
-def _probabilities_to_moments(
-        probabilities: List[float]) -> SumOfRandomVariablesMoments:
-    """Moments of a sum of independent Bernoulli variables (vectorized)."""
-    ps = np.asarray(probabilities, dtype=np.float64)
-    exp = float(ps.sum())
-    var = float((ps * (1 - ps)).sum())
-    third = float((ps * (1 - ps) * (1 - 2 * ps)).sum())
-    return SumOfRandomVariablesMoments(len(ps), exp, var, third)
-
-
-@dataclass
-class PartitionSelectionCalculator:
-    """Probability this partition is kept under private partition selection.
-
-    Keeps exact per-user keep probabilities while there are at most
-    MAX_PROBABILITIES_IN_ACCUMULATOR of them (exact Poisson-binomial PMF);
-    beyond that, switches to moment-based refined-normal approximation
-    (reference ``per_partition_combiners.py:96-150``).
+    The output contract (consumed by ``utility_analysis.pack_metrics``) is a
+    flat tuple: ``(RawStatistics, *per config: [keep probability if private]
+    + [SumMetrics per metric in error_model.ordered_metrics order])``.
     """
-    probabilities: Optional[List[float]] = None
-    moments: Optional[SumOfRandomVariablesMoments] = None
-
-    def __post_init__(self):
-        assert (self.probabilities is None) != (
-            self.moments is None), \
-            "Only one of probabilities and moments must be set."
-
-    def compute_probability_to_keep(
-            self, partition_selection_strategy: agg.PartitionSelectionStrategy,
-            eps: float, delta: float, max_partitions_contributed: int,
-            pre_threshold: Optional[int]) -> float:
-        pmf = self._compute_pmf()
-        ps_strategy = partition_selection.create_partition_selection_strategy(
-            partition_selection_strategy, eps, delta,
-            max_partitions_contributed, pre_threshold)
-        counts = np.arange(pmf.start, pmf.start + len(pmf.probabilities))
-        keep_probs = ps_strategy.probability_of_keep_vec(counts)
-        return float(np.dot(pmf.probabilities, keep_probs))
-
-    def _compute_pmf(self) -> poisson_binomial.PMF:
-        """PMF of the post-bounding privacy-id count in this partition."""
-        if self.probabilities:
-            return poisson_binomial.compute_pmf(self.probabilities)
-        moments = self.moments
-        std = math.sqrt(moments.variance)
-        skewness = 0 if std == 0 else moments.third_central_moment / std**3
-        return poisson_binomial.compute_pmf_approximation(
-            moments.expectation, std, skewness, moments.count)
-
-
-# (probabilities, moments); exactly one is set — see
-# PartitionSelectionCalculator.
-PartitionSelectionAccumulator = Tuple[Optional[Tuple[float]],
-                                      Optional[SumOfRandomVariablesMoments]]
-
-
-def _merge_list(a: List, b: List) -> List:
-    """Combines 2 lists, modifying the larger one in place."""
-    if len(a) >= len(b):
-        a.extend(b)
-        return a
-    b.extend(a)
-    return b
-
-
-def _merge_partition_selection_accumulators(
-        acc1: PartitionSelectionAccumulator,
-        acc2: PartitionSelectionAccumulator) -> PartitionSelectionAccumulator:
-    probs1, moments1 = acc1
-    probs2, moments2 = acc2
-    if ((probs1 is not None) and (probs2 is not None) and
-            len(probs1) + len(probs2) <= MAX_PROBABILITIES_IN_ACCUMULATOR):
-        return (_merge_list(probs1, probs2), None)
-    if moments1 is None:
-        moments1 = _probabilities_to_moments(probs1)
-    if moments2 is None:
-        moments2 = _probabilities_to_moments(probs2)
-    return (None, moments1 + moments2)
-
-
-class PartitionSelectionCombiner(UtilityAnalysisCombiner):
-    """Computes the probability a partition survives private selection."""
-
-    def __init__(self, params: dp_combiners.CombinerParams):
-        self._params = params
-
-    def create_accumulator(self, sparse_acc: Tuple[np.ndarray, np.ndarray,
-                                                   np.ndarray]):
-        count, sum_, n_partitions = sparse_acc
-        max_partitions = (
-            self._params.aggregate_params.max_partitions_contributed)
-        prob_keep_partition = _l0_keep_probabilities(n_partitions,
-                                                     max_partitions)
-        acc = (list(prob_keep_partition), None)
-        # May hold many probabilities; merging with empty converts to moments
-        # when over the threshold.
-        return _merge_partition_selection_accumulators(acc, ([], None))
-
-    def merge_accumulators(
-            self, acc1: PartitionSelectionAccumulator,
-            acc2: PartitionSelectionAccumulator
-    ) -> PartitionSelectionAccumulator:
-        return _merge_partition_selection_accumulators(acc1, acc2)
-
-    def compute_metrics(self, acc: PartitionSelectionAccumulator) -> float:
-        probs, moments = acc
-        params = self._params
-        calculator = PartitionSelectionCalculator(probs, moments)
-        aggregate_params = params.aggregate_params
-        return calculator.compute_probability_to_keep(
-            aggregate_params.partition_selection_strategy, params.eps,
-            params.delta, aggregate_params.max_partitions_contributed,
-            aggregate_params.pre_threshold)
-
-
-class SumCombiner(UtilityAnalysisCombiner):
-    """Closed-form error modeling for SUM.
-
-    Accumulator: (partition_sum, clipping_to_min_error, clipping_to_max_error,
-    expected_l0_bounding_error, var_cross_partition_error); all computed as
-    one vectorized pass over the partition's per-privacy-id aggregates
-    (reference ``per_partition_combiners.py:228-280``).
-    """
-    AccumulatorType = Tuple[float, float, float, float, float]
 
     def __init__(self,
-                 params: dp_combiners.CombinerParams,
-                 metric: agg.Metric = agg.Metrics.SUM):
-        self._params = copy.copy(params)
-        self._metric = metric
+                 config_params: Sequence[agg.AggregateParams],
+                 metric_list: Sequence[agg.Metric],
+                 metric_specs: Sequence[budget_accounting.MechanismSpec],
+                 selection_spec: Optional[
+                     budget_accounting.MechanismSpec] = None):
+        self._config_params = list(config_params)
+        self._metric_list = list(metric_list)
+        self._metric_specs = list(metric_specs)
+        self._selection_spec = selection_spec
+        self._noise_stds = None
+        self._selectors = None
 
-    def create_accumulator(
-            self, data: Tuple[np.ndarray, np.ndarray,
-                              np.ndarray]) -> AccumulatorType:
-        count, partition_sum, n_partitions = data
-        del count  # not used for SumCombiner
-        min_bound = self._params.aggregate_params.min_sum_per_partition
-        max_bound = self._params.aggregate_params.max_sum_per_partition
-        max_partitions = (
-            self._params.aggregate_params.max_partitions_contributed)
-        l0_prob_keep_contribution = _l0_keep_probabilities(
-            n_partitions, max_partitions)
-        per_partition_contribution = np.clip(partition_sum, min_bound,
-                                             max_bound)
-        per_partition_error = per_partition_contribution - partition_sum
-        clipping_to_min_error = np.where(partition_sum < min_bound,
-                                         per_partition_error, 0)
-        clipping_to_max_error = np.where(partition_sum > max_bound,
-                                         per_partition_error, 0)
-        expected_l0_bounding_error = -per_partition_contribution * (
-            1 - l0_prob_keep_contribution)
-        var_cross_partition_error = (per_partition_contribution**2 *
-                                     l0_prob_keep_contribution *
-                                     (1 - l0_prob_keep_contribution))
-        return (partition_sum.sum().item(), clipping_to_min_error.sum().item(),
-                clipping_to_max_error.sum().item(),
-                expected_l0_bounding_error.sum().item(),
-                var_cross_partition_error.sum().item())
+    @property
+    def private(self) -> bool:
+        return self._selection_spec is not None
 
-    def compute_metrics(self, acc: AccumulatorType) -> metrics.SumMetrics:
-        (partition_sum, clipping_to_min_error, clipping_to_max_error,
-         expected_l0_bounding_error, var_cross_partition_error) = acc
-        std_noise = dp_computations.compute_dp_count_noise_std(
-            self._params.scalar_noise_params)
-        return metrics.SumMetrics(
-            aggregation=self._metric,
-            sum=partition_sum,
-            clipping_to_min_error=clipping_to_min_error,
-            clipping_to_max_error=clipping_to_max_error,
-            expected_l0_bounding_error=expected_l0_bounding_error,
-            std_l0_bounding_error=math.sqrt(var_cross_partition_error),
-            std_noise=std_noise,
-            noise_kind=self._params.aggregate_params.noise_kind)
+    @property
+    def config_params(self) -> List[agg.AggregateParams]:
+        return self._config_params
 
+    @property
+    def metric_list(self) -> List[agg.Metric]:
+        return self._metric_list
 
-class CountCombiner(SumCombiner):
-    """COUNT error modeling: counts are a SUM with bounds [0, linf]."""
-    AccumulatorType = Tuple[float, float, float, float, float]
+    def selection_budget(self) -> Optional[Tuple[float, float]]:
+        """(eps, delta) of the selection mechanism; None for public."""
+        if not self.private:
+            return None
+        return self._selection_spec.eps, self._selection_spec.delta
 
-    def __init__(self, params: dp_combiners.CombinerParams):
-        super().__init__(params, agg.Metrics.COUNT)
+    def results_per_config(self) -> int:
+        return len(self._metric_list) + (1 if self.private else 0)
 
-    def create_accumulator(
-        self, sparse_acc: Tuple[np.ndarray, np.ndarray,
-                                np.ndarray]) -> 'CountCombiner.AccumulatorType':
-        count, _sum, n_partitions = sparse_acc
-        data = None, count, n_partitions
-        self._params.aggregate_params.min_sum_per_partition = 0.0
-        self._params.aggregate_params.max_sum_per_partition = (
-            self._params.aggregate_params.max_contributions_per_partition)
-        return super().create_accumulator(data)
+    def resolve_mechanisms(self):
+        """Noise stds [K, n_metrics] and per-config selectors (lazy)."""
+        if self._noise_stds is None:
+            self._noise_stds = np.array(
+                [[
+                    em.config_noise_std(p, metric, spec.eps, spec.delta)
+                    for metric, spec in zip(self._metric_list,
+                                            self._metric_specs)
+                ]
+                 for p in self._config_params]).reshape(
+                     len(self._config_params), len(self._metric_list))
+        if self._selectors is None and self.private:
+            self._selectors = [
+                em.config_selector(p, self._selection_spec.eps,
+                                   self._selection_spec.delta)
+                for p in self._config_params
+            ]
+        return self._noise_stds, self._selectors
 
+    def __getstate__(self):
+        # Mechanism caches may hold unpicklable native state; workers rebuild
+        # them from the finalized specs.
+        state = self.__dict__.copy()
+        state["_noise_stds"] = None
+        state["_selectors"] = None
+        return state
 
-class PrivacyIdCountCombiner(SumCombiner):
-    """PRIVACY_ID_COUNT error modeling: indicator sums with bounds [0, 1]."""
-    AccumulatorType = Tuple[float, float, float, float, float]
-
-    def __init__(self, params: dp_combiners.CombinerParams):
-        super().__init__(params, agg.Metrics.PRIVACY_ID_COUNT)
-        self._params.aggregate_params.max_contributions_per_partition = 1
-
-    def create_accumulator(
-        self, sparse_acc: Tuple[np.ndarray, np.ndarray, np.ndarray]
-    ) -> 'PrivacyIdCountCombiner.AccumulatorType':
-        counts, _sum, n_partitions = sparse_acc
-        counts = np.where(counts > 0, 1, 0)
-        data = None, counts, n_partitions
-        self._params.aggregate_params.min_sum_per_partition = 0.0
-        self._params.aggregate_params.max_sum_per_partition = 1.0
-        return super().create_accumulator(data)
-
-
-class RawStatisticsCombiner(UtilityAnalysisCombiner):
-    """Per-partition raw (non-DP) statistics: (privacy_id_count, count)."""
-    AccumulatorType = Tuple[int, int]
-
-    def create_accumulator(
-            self, sparse_acc: Tuple[np.ndarray, np.ndarray,
-                                    np.ndarray]) -> AccumulatorType:
-        count, _sum, n_partitions = sparse_acc
-        return len(count), np.sum(count).item()
-
-    def compute_metrics(self, acc: AccumulatorType):
-        privacy_id_count, count = acc
-        return metrics.RawStatistics(privacy_id_count, count)
-
-
-class CompoundCombiner(dp_combiners.CompoundCombiner):
-    """Compound combiner with sparse↔dense accumulator switching.
-
-    Sparse mode keeps raw per-privacy-id (counts, sums, n_partitions) lists;
-    once a partition accumulates more rows than 2×n_combiners the lists are
-    converted to numpy arrays and every internal combiner consumes the batch
-    in one vectorized call (reference ``per_partition_combiners.py:339-431``).
-    With N parameter configurations there are ~N internal combiners reading
-    the SAME batch — a unit-stride broadcast, the scan axis the TPU analysis
-    kernel vmaps over.
-    """
-    SparseAccumulatorType = Tuple[List[int], List[float], List[int]]
-    DenseAccumulatorType = List[Any]
-    AccumulatorType = Tuple[Optional[SparseAccumulatorType],
-                            Optional[DenseAccumulatorType]]
-
-    def create_accumulator(self, data: PreaggregatedData) -> AccumulatorType:
-        if not data:
-            # Empty partitions (only with public partitions).
-            return (([0], [0], [0]), None)
-        return (([data[0]], [data[1]], [data[2]]), None)
-
-    def _to_dense(self,
-                  sparse_acc: SparseAccumulatorType) -> DenseAccumulatorType:
-        sparse_acc = [np.array(a) for a in sparse_acc]
-        return (
-            len(sparse_acc[0]),
-            tuple(
-                combiner.create_accumulator(sparse_acc)
-                for combiner in self._combiners),
-        )
-
-    def _merge_sparse(self, acc1, acc2):
-        if acc1 is None:
-            return acc2
-        if acc2 is None:
-            return acc1
-        return tuple(_merge_list(s, t) for s, t in zip(acc1, acc2))
-
-    def _merge_dense(self, acc1, acc2):
-        if acc1 is None:
-            return acc2
-        if acc2 is None:
-            return acc1
-        return super().merge_accumulators(acc1, acc2)
-
-    def merge_accumulators(self, acc1: AccumulatorType,
-                           acc2: AccumulatorType) -> AccumulatorType:
-        sparse1, dense1 = acc1
-        sparse2, dense2 = acc2
-        sparse_res = self._merge_sparse(sparse1, sparse2)
-        merge_res = self._merge_dense(dense1, dense2)
-        sparse_bigger_than_dense = sparse_res is not None and len(
-            sparse_res[0]) > 2 * len(self._combiners)
-        if sparse_bigger_than_dense:
-            merge_res = self._merge_dense(merge_res,
-                                          self._to_dense(sparse_res))
-            sparse_res = None
-        return sparse_res, merge_res
-
-    def compute_metrics(self, acc: AccumulatorType):
-        sparse, dense = acc
-        if sparse:
-            dense = self._merge_dense(dense, self._to_dense(sparse))
-        return super().compute_metrics(dense)
+    def analyze_rows(self, rows: List[Optional[PreaggregatedRow]]) -> Tuple:
+        """Analyzes one partition. ``None`` rows (empty-public markers) are
+        ignored."""
+        rows = [r for r in rows if r is not None]
+        noise_stds, selectors = self.resolve_mechanisms()
+        counts = np.array([r[0] for r in rows], dtype=np.float64)
+        sums = np.array([r[1] for r in rows], dtype=np.float64)
+        contributed = np.array([r[2] for r in rows], dtype=np.float64)
+        stats = em.partition_stats(counts, sums, contributed,
+                                   self._config_params, self._metric_list)
+        result = [
+            metrics_dc.RawStatistics(privacy_id_count=len(rows),
+                                     count=int(counts.sum()))
+        ]
+        for ki, params in enumerate(self._config_params):
+            if self.private:
+                q = em.keep_fraction(contributed,
+                                     float(params.max_partitions_contributed))
+                result.append(em.host_keep_probability(q, selectors[ki]))
+            for mi, metric in enumerate(self._metric_list):
+                result.append(
+                    em.stats_to_sum_metrics(stats[ki, mi], metric,
+                                            float(noise_stds[ki, mi]),
+                                            params.noise_kind))
+        return tuple(result)

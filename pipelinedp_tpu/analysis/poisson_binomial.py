@@ -39,7 +39,8 @@ def compute_exp_std_skewness(
     exp = float(ps.sum())
     var = float((ps * (1 - ps)).sum())
     std = float(np.sqrt(var))
-    skewness = float((ps * (1 - ps) * (1 - 2 * ps)).sum() / std**3)
+    skewness = 0.0 if std == 0 else float(
+        (ps * (1 - ps) * (1 - 2 * ps)).sum() / std**3)
     return exp, std, skewness
 
 

@@ -1,34 +1,38 @@
 """Public API for performing utility analysis.
 
-Capability parity with the reference ``analysis/utility_analysis.py:42-251``:
-per-partition analysis → cross-partition UtilityReports, plus a histogram of
-reports by partition-size bucket (logarithmic [1,2,5]·10^i buckets).
+Capability parity with the reference ``analysis/utility_analysis.py``
+(per-partition analysis -> cross-partition UtilityReports plus a histogram of
+reports by partition-size bucket), re-designed with two executions of the
+same error model (``analysis/error_model.py``):
+
+* **Dense path** (LocalBackend / TPUBackend): rows are gathered into columnar
+  arrays and the whole sweep — every parameter configuration x every
+  partition, including the report-histogram reduction — runs as one
+  jit-compiled XLA program (``analysis/kernels.sweep_kernel``). This is
+  BASELINE config 5's 64-budget ε-sweep.
+* **Distributed path** (multiprocess / Beam / Spark backends): per-partition
+  analysis runs as a grouped ``map_values`` and the cross-partition reduction
+  as additive fixed-width vectors keyed by size bucket
+  (``analysis/cross_partition_combiners.py``).
 """
 
 import bisect
-import copy
-from typing import Any, Iterable, List, Tuple, Union
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
 
 from pipelinedp_tpu import budget_accounting
 from pipelinedp_tpu import data_extractors as extractors
 from pipelinedp_tpu import pipeline_backend
 from pipelinedp_tpu.analysis import cross_partition_combiners
 from pipelinedp_tpu.analysis import data_structures
+from pipelinedp_tpu.analysis import error_model as em
+from pipelinedp_tpu.analysis import kernels
 from pipelinedp_tpu.analysis import metrics
 from pipelinedp_tpu.analysis import utility_analysis_engine
 
-
-def _generate_bucket_bounds():
-    result = [0, 1]
-    for i in range(1, 10):
-        result.append(10**i)
-        result.append(2 * 10**i)
-        result.append(5 * 10**i)
-    return tuple(result)
-
-
-# Bucket bounds for the UtilityReport histogram: [0, 1] + [1, 2, 5]*10^i.
-BUCKET_BOUNDS = _generate_bucket_bounds()
+# Partition-size histogram bucket lower bounds: [0, 1] + [1, 2, 5] * 10^i.
+BUCKET_BOUNDS = kernels.BUCKET_BOUNDS
 
 
 def perform_utility_analysis(
@@ -49,153 +53,248 @@ def perform_utility_analysis(
         total_epsilon=options.epsilon, total_delta=options.delta)
     engine = utility_analysis_engine.UtilityAnalysisEngine(
         budget_accountant=budget_accountant, backend=backend)
-    per_partition_result = engine.analyze(col,
-                                          options=options,
-                                          data_extractors=data_extractors,
-                                          public_partitions=public_partitions)
-    # (partition_key, per-partition analysis results)
+    if isinstance(backend, pipeline_backend.LocalBackend):
+        return _perform_dense(col, engine, budget_accountant, options,
+                              data_extractors, public_partitions)
+    return _perform_distributed(col, backend, engine, budget_accountant,
+                                options, data_extractors, public_partitions)
+
+
+# ---------------------------------------------------------------------------
+# Dense (single-program) path.
+# ---------------------------------------------------------------------------
+
+
+def _perform_dense(col, engine, budget_accountant, options, data_extractors,
+                   public_partitions):
+    utility_analysis_engine._check_utility_analysis_params(
+        options, data_extractors)
+    analyzer = engine.request_budgets(options, public_partitions)
+    rows_col = engine.preaggregated_rows(col, options, data_extractors,
+                                         public_partitions)
     budget_accountant.compute_budgets()
+    rows = list(rows_col)
+    public = public_partitions is not None
 
-    n_configurations = options.n_configurations
-    per_partition_result = backend.map_values(
-        per_partition_result,
-        lambda value: _pack_per_partition_metrics(value, n_configurations),
-        "Pack per-partition metrics.")
-    # (partition_key, (PerPartitionMetrics, ...))
-    per_partition_result = backend.to_multi_transformable_collection(
-        per_partition_result)
+    # Dense partition index space: the public keys (order-preserving) for
+    # public analysis — so missing publics become empty partitions — or the
+    # dataset keys otherwise.
+    if public:
+        keys = list(dict.fromkeys(public_partitions))
+    else:
+        keys = list(dict.fromkeys(pk for pk, _ in rows))
+    index = {pk: i for i, pk in enumerate(keys)}
+    n = len(rows)
+    counts = np.fromiter((r[0] for _, r in rows), dtype=np.float64, count=n)
+    sums = np.fromiter((r[1] for _, r in rows), dtype=np.float64, count=n)
+    contributed = np.fromiter((r[2] for _, r in rows),
+                              dtype=np.float64,
+                              count=n)
+    pk_idx = np.fromiter((index[pk] for pk, _ in rows),
+                         dtype=np.int32,
+                         count=n)
 
-    col = backend.values(per_partition_result, "Drop partition key")
-    col = backend.flat_map(col, _unnest_metrics, "Unnest metrics")
-    # ((configuration_index, bucket), PerPartitionMetrics)
-
-    per_partition_result = backend.flat_map(
-        per_partition_result, lambda kv: (((kv[0], i), result)
-                                          for i, result in enumerate(kv[1])),
-        "Unpack PerPartitionMetrics from list")
-    # ((partition_key, configuration_index), PerPartitionMetrics)
-
-    combiner = cross_partition_combiners.CrossPartitionCombiner(
-        options.aggregate_params.metrics, public_partitions is not None)
-
-    accumulators = backend.map_values(col, combiner.create_accumulator,
-                                      "Create accumulators")
-    accumulators = backend.combine_accumulators_per_key(
-        accumulators, combiner, "Combine cross-partition metrics")
-    cross_partition_metrics = backend.map_values(
-        accumulators, combiner.compute_metrics,
-        "Compute cross-partition metrics")
-    # ((configuration_index, bucket), UtilityReport)
-
-    if public_partitions is None:
-        strategies = data_structures.get_partition_selection_strategy(options)
-
-        def add_partition_selection_strategy(key, report):
-            # key = (configuration_index, bucket); report.configuration_index
-            # is not populated until _group_utility_reports, so the config
-            # index must come from the key (fixes a reference bug where all
-            # reports get the last configuration's strategy).
-            report = copy.deepcopy(report)
-            report.partitions_info.strategy = strategies[key[0]]
-            return key, report
-
-        cross_partition_metrics = backend.map_tuple(
-            cross_partition_metrics, add_partition_selection_strategy,
-            "Add Partition Selection Strategy")
-
-    cross_partition_metrics = backend.map_tuple(
-        cross_partition_metrics, lambda key, value: (key[0], (key[1], value)),
-        "Rekey")
-    cross_partition_metrics = backend.group_by_key(cross_partition_metrics,
-                                                   "Group by configuration")
-    result = backend.map_tuple(cross_partition_metrics,
-                               _group_utility_reports,
-                               "Group utility reports")
-    # (UtilityReport)
-    return result, per_partition_result
+    metric_list = analyzer.metric_list
+    noise_stds, _ = analyzer.resolve_mechanisms()
+    cfg = kernels.build_config_arrays(analyzer.config_params, metric_list,
+                                      noise_stds,
+                                      analyzer.selection_budget())
+    if not keys:
+        k = len(analyzer.config_params)
+        out = {
+            "bucket_rows":
+                np.zeros((k, kernels.N_BUCKETS, len(metric_list),
+                          em.REPORT_WIDTH)),
+            "bucket_info": np.zeros((k, kernels.N_BUCKETS, em.INFO_WIDTH)),
+        }
+        per_partition = []
+    else:
+        out = kernels.sweep_kernel(
+            counts,
+            sums,
+            contributed,
+            pk_idx,
+            cfg,
+            n_partitions_total=len(keys),
+            metric_codes=tuple(kernels.METRIC_CODES[m] for m in metric_list),
+            public=public)
+        per_partition = _dense_per_partition(out, keys, analyzer, public)
+    reports = _build_reports(
+        np.asarray(out["bucket_rows"], dtype=np.float64),
+        np.asarray(out["bucket_info"], dtype=np.float64), analyzer, options,
+        public)
+    return reports, per_partition
 
 
-def _pack_per_partition_metrics(
-        utility_result: List[Any],
-        n_configurations: int) -> Tuple[metrics.PerPartitionMetrics]:
-    """Groups flat per-partition combiner outputs by configuration.
-
-    utility_result = [RawStatistics, config0 results..., config1 results...];
-    each configuration has the same number of results (selection probability
-    float and/or SumMetrics per metric).
-    """
-    n_metrics = len(utility_result) // n_configurations
-
-    raw_statistics = utility_result[0]
-    result = tuple(
-        metrics.PerPartitionMetrics(1, raw_statistics, [])
-        for _ in range(n_configurations))
-
-    for i, metric in enumerate(utility_result[1:]):
-        i_configuration = i // n_metrics
-        ith_result = result[i_configuration]
-        if isinstance(metric, float):  # partition selection probability
-            ith_result.partition_selection_probability_to_keep = metric
-        else:
-            ith_result.metric_errors.append(metric)
+def _dense_per_partition(out, keys, analyzer, public):
+    """((pk, config_index), PerPartitionMetrics) rows from kernel outputs."""
+    stats = np.asarray(out["stats"], dtype=np.float64)
+    keep_prob = np.asarray(out["keep_prob"], dtype=np.float64)
+    n_users = np.asarray(out["n_users"])
+    n_rows = np.asarray(out["n_rows"])
+    noise_stds, _ = analyzer.resolve_mechanisms()
+    result = []
+    for pi, pk in enumerate(keys):
+        raw = metrics.RawStatistics(privacy_id_count=int(round(n_users[pi])),
+                                    count=int(round(n_rows[pi])))
+        for ki, params in enumerate(analyzer.config_params):
+            errors = [
+                em.stats_to_sum_metrics(stats[ki, pi, mi], metric,
+                                        float(noise_stds[ki, mi]),
+                                        params.noise_kind)
+                for mi, metric in enumerate(analyzer.metric_list)
+            ]
+            prob = 1.0 if public else float(keep_prob[ki, pi])
+            result.append(
+                ((pk, ki), metrics.PerPartitionMetrics(prob, raw, errors)))
     return result
 
 
-def _get_lower_bound(n: int) -> int:
-    if n < 0:
+def _build_reports(bucket_rows, bucket_info, analyzer, options,
+                   public) -> List[metrics.UtilityReport]:
+    """Per-config UtilityReports (global + per-size-bucket histogram)."""
+    noise_stds, _ = analyzer.resolve_mechanisms()
+    metric_list = analyzer.metric_list
+    strategies = (None if public else
+                  data_structures.get_partition_selection_strategy(options))
+    reports = []
+    for ki, params in enumerate(analyzer.config_params):
+        report = em.finalize_utility_report(bucket_rows[ki].sum(axis=0),
+                                            bucket_info[ki].sum(axis=0),
+                                            metric_list, noise_stds[ki],
+                                            params.noise_kind, public, ki)
+        if strategies is not None:
+            report.partitions_info.strategy = strategies[ki]
+        if metric_list:
+            bins = []
+            for b in range(kernels.N_BUCKETS):
+                info_b = bucket_info[ki, b]
+                if info_b[em.N_DATASET] + info_b[em.N_EMPTY] < 0.5:
+                    continue
+                sub = em.finalize_utility_report(bucket_rows[ki, b], info_b,
+                                                 metric_list, noise_stds[ki],
+                                                 params.noise_kind, public,
+                                                 ki)
+                if strategies is not None:
+                    sub.partitions_info.strategy = strategies[ki]
+                bins.append(
+                    metrics.UtilityReportBin(
+                        partition_size_from=BUCKET_BOUNDS[b],
+                        partition_size_to=(BUCKET_BOUNDS[b + 1]
+                                           if b + 1 < len(BUCKET_BOUNDS) else
+                                           -1),
+                        report=sub))
+            report.utility_report_histogram = bins
+        reports.append(report)
+    return reports
+
+
+# ---------------------------------------------------------------------------
+# Distributed path.
+# ---------------------------------------------------------------------------
+
+
+def pack_metrics(flat: Sequence[Any], n_configurations: int, n_metrics: int,
+                 private: bool) -> Tuple[metrics.PerPartitionMetrics, ...]:
+    """Groups a flat analyzer output tuple by configuration.
+
+    flat = (RawStatistics, *per config: [keep prob if private] + [SumMetrics
+    per metric]).
+    """
+    raw = flat[0]
+    per_config = n_metrics + (1 if private else 0)
+    packed = []
+    for ki in range(n_configurations):
+        base = 1 + ki * per_config
+        prob = float(flat[base]) if private else 1.0
+        errors = list(flat[base + (1 if private else 0):base + per_config])
+        packed.append(metrics.PerPartitionMetrics(prob, raw, errors))
+    return tuple(packed)
+
+
+def _bucket_index(packed: Sequence[metrics.PerPartitionMetrics]) -> int:
+    """Size bucket of a partition (first metric's raw value; privacy-id count
+    for select-partitions analysis)."""
+    if packed[0].metric_errors:
+        size = packed[0].metric_errors[0].sum
+    else:
+        size = packed[0].raw_statistics.privacy_id_count
+    if size < 0:
         return 0
-    return BUCKET_BOUNDS[bisect.bisect_right(BUCKET_BOUNDS, n) - 1]
+    return max(bisect.bisect_right(BUCKET_BOUNDS, size) - 1, 0)
 
 
-def _get_upper_bound(n: int) -> int:
-    if n < 0:
-        return 0
-    index = bisect.bisect_right(BUCKET_BOUNDS, n)
-    if index >= len(BUCKET_BOUNDS):
-        return -1
-    return BUCKET_BOUNDS[index]
+def _perform_distributed(col, backend, engine, budget_accountant, options,
+                         data_extractors, public_partitions):
+    public = public_partitions is not None
+    analyzer = engine.request_budgets(options, public_partitions)
+    per_partition_result = engine.analyze(col,
+                                          options,
+                                          data_extractors,
+                                          public_partitions,
+                                          analyzer=analyzer)
+    budget_accountant.compute_budgets()
+
+    n_configurations = options.n_configurations
+    n_metrics = len(analyzer.metric_list)
+    private = analyzer.private
+    packed = backend.map_values(
+        per_partition_result,
+        lambda flat: pack_metrics(flat, n_configurations, n_metrics, private),
+        "Pack per-partition metrics")
+    packed = backend.to_multi_transformable_collection(packed)
+
+    per_partition_out = backend.flat_map(
+        packed, lambda kv: (((kv[0], ki), m) for ki, m in enumerate(kv[1])),
+        "Unpack PerPartitionMetrics")
+
+    aggregator = cross_partition_combiners.CrossPartitionAggregator(
+        analyzer.metric_list, public)
+    keyed = backend.map_tuple(
+        packed, lambda pk, ms:
+        (_bucket_index(ms), aggregator.create_accumulator(ms)),
+        "Per-bucket report vectors")
+    combined = backend.combine_accumulators_per_key(
+        keyed, aggregator, "Combine cross-partition metrics")
+    listed = backend.to_list(combined, "To list")
+    reports = backend.flat_map(
+        listed, lambda bucket_accs: _finalize_distributed(
+            bucket_accs, aggregator, analyzer, options, public),
+        "Finalize utility reports")
+    return reports, per_partition_out
 
 
-def _unnest_metrics(
-    per_partition: List[metrics.PerPartitionMetrics]
-) -> Iterable[Tuple[Any, metrics.PerPartitionMetrics]]:
-    """Yields each configuration's metrics keyed by (config, None) for the
-    global report and (config, size_bucket) for the histogram."""
-    for i, metric in enumerate(per_partition):
-        yield ((i, None), metric)
-        if per_partition[0].metric_errors:
-            partition_size = per_partition[0].metric_errors[0].sum
-        else:
-            # Select-partitions case.
-            partition_size = per_partition[0].raw_statistics.privacy_id_count
-        bucket = _get_lower_bound(partition_size)
-        yield ((i, bucket), metric)
-
-
-def _group_utility_reports(
-        configuration_index: int,
-        reports: List[Tuple[Any, metrics.UtilityReport]]
-) -> metrics.UtilityReport:
-    """Combines a configuration's global report with its size-bucket reports
-    into one UtilityReport with utility_report_histogram set."""
-    global_report = None
-    histogram_reports = []
-    for lower_bucket_bound, report in reports:
-        report = copy.deepcopy(report)
-        report.configuration_index = configuration_index
-        if lower_bucket_bound is None:
-            global_report = report
-        else:
-            histogram_reports.append((lower_bucket_bound, report))
-    if global_report is None:
-        return None
-    if not histogram_reports:
-        # Select-partitions case.
-        return global_report
-    histogram_reports.sort(key=lambda kv: kv[0])
-    global_report.utility_report_histogram = [
-        metrics.UtilityReportBin(lower_bound, _get_upper_bound(lower_bound),
-                                 report)
-        for lower_bound, report in histogram_reports
-    ]
-    return global_report
+def _finalize_distributed(bucket_accs, aggregator, analyzer, options, public):
+    """Builds the per-config reports from per-bucket accumulated vectors."""
+    noise_stds, _ = analyzer.resolve_mechanisms()
+    noise_kinds = [p.noise_kind for p in analyzer.config_params]
+    strategies = (None if public else
+                  data_structures.get_partition_selection_strategy(options))
+    k = len(analyzer.config_params)
+    n_metrics = len(analyzer.metric_list)
+    zero = (np.zeros((k, n_metrics, em.REPORT_WIDTH)),
+            np.zeros((k, em.INFO_WIDTH)))
+    total = zero
+    for _, acc in bucket_accs:
+        total = aggregator.merge_accumulators(total, acc)
+    global_reports = aggregator.compute_reports(total, noise_stds,
+                                                noise_kinds, strategies)
+    histograms = [[] for _ in range(k)]
+    if n_metrics:
+        for bucket, acc in sorted(bucket_accs, key=lambda kv: kv[0]):
+            for ki, sub in enumerate(
+                    aggregator.compute_reports(acc, noise_stds, noise_kinds,
+                                               strategies)):
+                sub.configuration_index = ki
+                histograms[ki].append(
+                    metrics.UtilityReportBin(
+                        partition_size_from=BUCKET_BOUNDS[bucket],
+                        partition_size_to=(BUCKET_BOUNDS[bucket + 1]
+                                           if bucket + 1 < len(BUCKET_BOUNDS)
+                                           else -1),
+                        report=sub))
+    for ki, report in enumerate(global_reports):
+        report.configuration_index = ki
+        if n_metrics:
+            report.utility_report_histogram = histograms[ki]
+        yield report

@@ -1,163 +1,151 @@
-"""DPEngine subclass for utility analysis.
+"""Utility-analysis engine: builds the per-partition analysis pipeline.
 
-Capability parity with the reference ``analysis/utility_analysis_engine.py``:
-reuses the DP computation graph from DPEngine, swapping nodes — analysis
-contribution bounder (no bounding, emits aggregates), one combiner set per
-parameter configuration, no-op private partition selection, no annotation.
+Capability parity with the reference ``analysis/utility_analysis_engine.py``
+(analyze() returns a lazy collection of (partition_key, flat per-config
+results); budget requests mirror the real aggregation's split). Re-designed:
+the reference subclasses DPEngine and swaps graph nodes (combiners, bounders,
+selection) to bend the DP dataflow into an analysis dataflow; here the
+analysis pipeline is built directly — extract -> public filter ->
+preaggregate -> group by partition -> one vectorized
+``PerPartitionAnalyzer`` pass — since none of the DP stages (noising,
+thresholding, selection) actually run during analysis.
+
+The TPU path (``utility_analysis.perform_utility_analysis`` on a
+LocalBackend/TPUBackend) bypasses this pipeline entirely and lowers the same
+math to ``analysis/kernels.sweep_kernel``.
 """
 
 from typing import Optional, Union
 
 from pipelinedp_tpu import aggregate_params as agg
 from pipelinedp_tpu import budget_accounting
-from pipelinedp_tpu import combiners as dp_combiners
-from pipelinedp_tpu import contribution_bounders as dp_bounders
 from pipelinedp_tpu import data_extractors as extractors
-from pipelinedp_tpu import dp_engine
 from pipelinedp_tpu import pipeline_backend
 from pipelinedp_tpu.analysis import contribution_bounders as analysis_bounders
 from pipelinedp_tpu.analysis import data_structures
+from pipelinedp_tpu.analysis import error_model as em
 from pipelinedp_tpu.analysis import per_partition_combiners
 
 
-class UtilityAnalysisEngine(dp_engine.DPEngine):
+class UtilityAnalysisEngine:
     """Performs utility analysis for DP aggregations."""
 
     def __init__(self, budget_accountant: budget_accounting.BudgetAccountant,
                  backend: pipeline_backend.PipelineBackend):
-        super().__init__(budget_accountant, backend)
-        self._is_public_partitions = None
-        self._options = None
+        self._budget_accountant = budget_accountant
+        self._backend = backend
 
-    def aggregate(self,
-                  col,
-                  params: agg.AggregateParams,
-                  data_extractors: extractors.DataExtractors,
-                  public_partitions=None):
+    def aggregate(self, col, params, data_extractors, public_partitions=None):
         raise ValueError("UtilityAnalysisEngine.aggregate can't be called.\n"
                          "If you'd like to perform utility analysis, use "
                          "UtilityAnalysisEngine.analyze.\n"
                          "If you'd like to perform DP computations, use "
                          "DPEngine.aggregate.")
 
+    def request_budgets(
+            self, options: 'data_structures.UtilityAnalysisOptions',
+            public_partitions) -> per_partition_combiners.PerPartitionAnalyzer:
+        """Requests the budget split the real aggregation would make and
+        returns the analyzer bound to the (lazily finalized) specs.
+
+        One GENERIC request models private partition selection, one request
+        per metric models its noise mechanism; all configurations share these
+        specs (the sweep varies sensitivities, not the budget split).
+        """
+        params = options.aggregate_params
+        metric_list = em.ordered_metrics(params)
+        with self._budget_accountant.scope(weight=params.budget_weight):
+            selection_spec = None
+            if public_partitions is None:
+                selection_spec = self._budget_accountant.request_budget(
+                    agg.MechanismType.GENERIC, weight=params.budget_weight)
+            mechanism_type = params.noise_kind.convert_to_mechanism_type()
+            metric_specs = [
+                self._budget_accountant.request_budget(
+                    mechanism_type, weight=params.budget_weight)
+                for _ in metric_list
+            ]
+        return per_partition_combiners.PerPartitionAnalyzer(
+            config_params=list(data_structures.get_aggregate_params(options)),
+            metric_list=metric_list,
+            metric_specs=metric_specs,
+            selection_spec=selection_spec)
+
+    def preaggregated_rows(
+            self, col, options: 'data_structures.UtilityAnalysisOptions',
+            data_extractors: Union[extractors.DataExtractors,
+                                   extractors.PreAggregateExtractors],
+            public_partitions):
+        """(partition_key, (count, sum, n_partitions, n_contributions)) rows.
+
+        Public filtering happens before cross-partition statistics are taken
+        (matching DPEngine._aggregate's stage order), so n_partitions counts
+        only partitions that survive the public filter.
+        """
+        backend = self._backend
+        if options.pre_aggregated_data:
+            col = backend.map(
+                col, lambda row: (data_extractors.partition_extractor(row),
+                                  data_extractors.preaggregate_extractor(row)),
+                "Extract (partition_key, preaggregate_data)")
+            if public_partitions is not None:
+                col = backend.filter_by_key(
+                    col, public_partitions,
+                    "Filter out non-public partitions")
+            return col
+        col = backend.map(
+            col, lambda row: (data_extractors.privacy_id_extractor(row),
+                              data_extractors.partition_extractor(row),
+                              data_extractors.value_extractor(row)),
+            "Extract (privacy_id, partition_key, value)")
+        if public_partitions is not None:
+            col = backend.map(col, lambda row: (row[1], row),
+                              "Key by partition")
+            col = backend.filter_by_key(col, public_partitions,
+                                        "Filter out non-public partitions")
+            col = backend.values(col, "Drop key")
+        bounder = analysis_bounders.AnalysisContributionBounder(
+            options.partitions_sampling_prob)
+        col = bounder.bound_contributions(col,
+                                          params=None,
+                                          backend=backend,
+                                          report_generator=None,
+                                          aggregate_fn=lambda x: x)
+        # ((privacy_id, partition_key), preaggregated row)
+        return backend.map(col, lambda row: (row[0][1], row[1]),
+                           "Drop privacy id")
+
     def analyze(self,
                 col,
                 options: 'data_structures.UtilityAnalysisOptions',
                 data_extractors: Union[extractors.DataExtractors,
                                        extractors.PreAggregateExtractors],
-                public_partitions=None):
-        """Utility analysis per partition.
+                public_partitions=None,
+                analyzer: Optional[
+                    per_partition_combiners.PerPartitionAnalyzer] = None):
+        """Per-partition utility analysis.
 
-        Returns a collection of (partition_key, per-partition utility
-        metrics) — one flat tuple of results per partition, covering every
-        parameter configuration in 'options'.
+        Returns a lazy collection of (partition_key, flat results tuple) —
+        see PerPartitionAnalyzer.analyze_rows for the tuple layout. Iterate
+        only after BudgetAccountant.compute_budgets().
         """
         _check_utility_analysis_params(options, data_extractors)
-        self._options = options
-        self._is_public_partitions = public_partitions is not None
-        # Build the computation graph via the parent class.
-        result = super().aggregate(col, options.aggregate_params,
-                                   data_extractors, public_partitions)
-        self._is_public_partitions = None
-        self._options = None
-        return result
-
-    def _use_tpu_path(self, params: agg.AggregateParams) -> bool:
-        # The analysis graph swaps combiners/bounders; route through the
-        # generic graph (its per-partition kernels are numpy-vectorized).
-        return False
-
-    def _create_contribution_bounder(
-            self, params: agg.AggregateParams,
-            expects_per_partition_sampling: bool
-    ) -> dp_bounders.ContributionBounder:
-        if self._options.pre_aggregated_data:
-            return analysis_bounders.NoOpContributionBounder()
-        return analysis_bounders.AnalysisContributionBounder(
-            self._options.partitions_sampling_prob)
-
-    def _create_compound_combiner(
-            self, aggregate_params: agg.AggregateParams
-    ) -> dp_combiners.CompoundCombiner:
-        mechanism_type = aggregate_params.noise_kind.convert_to_mechanism_type(
-        )
-        # One budget request for private partition selection and one per
-        # metric — SHARED by all parameter configurations (the analysis
-        # models the same budget split the real run would have).
-        if not self._is_public_partitions:
-            private_partition_selection_budget = (
-                self._budget_accountant.request_budget(
-                    agg.MechanismType.GENERIC,
-                    weight=aggregate_params.budget_weight))
-        budgets = {}
-        for metric in aggregate_params.metrics:
-            budgets[metric] = self._budget_accountant.request_budget(
-                mechanism_type, weight=aggregate_params.budget_weight)
-
-        # Internal combiners: RawStatistics first, then per configuration:
-        # [partition selection?, SUM?, COUNT?, PRIVACY_ID_COUNT?].
-        # Order matters — _pack_per_partition_metrics depends on it.
-        internal_combiners = [per_partition_combiners.RawStatisticsCombiner()]
-        for params in data_structures.get_aggregate_params(self._options):
-            if not self._is_public_partitions:
-                internal_combiners.append(
-                    per_partition_combiners.PartitionSelectionCombiner(
-                        dp_combiners.CombinerParams(
-                            private_partition_selection_budget, params)))
-            if agg.Metrics.SUM in aggregate_params.metrics:
-                internal_combiners.append(
-                    per_partition_combiners.SumCombiner(
-                        dp_combiners.CombinerParams(
-                            budgets[agg.Metrics.SUM], params)))
-            if agg.Metrics.COUNT in aggregate_params.metrics:
-                internal_combiners.append(
-                    per_partition_combiners.CountCombiner(
-                        dp_combiners.CombinerParams(
-                            budgets[agg.Metrics.COUNT], params)))
-            if agg.Metrics.PRIVACY_ID_COUNT in aggregate_params.metrics:
-                internal_combiners.append(
-                    per_partition_combiners.PrivacyIdCountCombiner(
-                        dp_combiners.CombinerParams(
-                            budgets[agg.Metrics.PRIVACY_ID_COUNT], params)))
-
-        return per_partition_combiners.CompoundCombiner(
-            internal_combiners, return_named_tuple=False)
-
-    def _select_private_partitions_internal(
-            self, col, max_partitions_contributed: int,
-            max_rows_per_privacy_id: int,
-            strategy: agg.PartitionSelectionStrategy,
-            pre_threshold: Optional[int]):
-        # Analysis of private partition selection happens in the
-        # PartitionSelectionCombiner; no partitions are dropped here.
-        return col
-
-    def _extract_columns(
-            self, col, data_extractors: Union[
-                extractors.DataExtractors,
-                extractors.PreAggregateExtractors]):
-        if self._options.pre_aggregated_data:
-            # (privacy_id=None, partition_key, preaggregate_data)
-            return self._backend.map(
-                col, lambda row: (None, data_extractors.partition_extractor(
-                    row), data_extractors.preaggregate_extractor(row)),
-                "Extract (partition_key, preaggregate_data)")
-        return super()._extract_columns(col, data_extractors)
-
-    def _check_aggregate_params(self,
-                                col,
-                                params: agg.AggregateParams,
-                                data_extractors,
-                                check_data_extractors: bool = True):
-        # PreAggregateExtractors are checked by _check_utility_analysis_params.
-        super()._check_aggregate_params(col,
-                                        params,
-                                        data_extractors=None,
-                                        check_data_extractors=False)
-
-    def _annotate(self, col, params, budget):
-        # No DP computations are performed — nothing to annotate.
-        return col
+        backend = self._backend
+        if analyzer is None:
+            analyzer = self.request_budgets(options, public_partitions)
+        col = self.preaggregated_rows(col, options, data_extractors,
+                                      public_partitions)
+        if public_partitions is not None:
+            # Empty-partition markers so missing public partitions surface.
+            publics = backend.to_collection(public_partitions, col,
+                                            "Public partitions to collection")
+            markers = backend.map(publics, lambda pk: (pk, None),
+                                  "Empty public partition markers")
+            col = backend.flatten((col, markers),
+                                  "Join markers with dataset rows")
+        col = backend.group_by_key(col, "Group by partition key")
+        return backend.map_values(col, analyzer.analyze_rows,
+                                  "Per-partition utility analysis")
 
 
 def _check_utility_analysis_params(

@@ -10,16 +10,26 @@ The host keeps the string-key vocabularies (partition id <-> original key),
 which is exactly the host/device split called for in SURVEY.md §5: the
 device never sees Python objects.
 
-Large-scale users skip this module entirely and feed integer/float arrays
-straight to executor.aggregate_arrays.
+Encoding is vectorized: extraction is one pass building object arrays, and
+vocabulary assignment is hash factorization at C speed (pandas.factorize
+when available, np.unique otherwise) instead of a per-row Python dict loop —
+the difference between hours and seconds of host time at 10^9 rows. Callers
+that already hold raw columns (e.g. file readers) should use
+``encode_columns`` and skip per-row extractor calls entirely; large-scale
+users can feed integer/float arrays straight to executor.aggregate_arrays.
 """
 
-from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Sequence
+from dataclasses import dataclass
+from typing import Any, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from pipelinedp_tpu.data_extractors import DataExtractors
+
+try:
+    import pandas as _pd
+except ImportError:  # pragma: no cover - pandas is in the standard image
+    _pd = None
 
 
 @dataclass
@@ -44,6 +54,65 @@ class EncodedData:
         return self.pk >= 0
 
 
+def _as_key_array(x) -> np.ndarray:
+    """1-D key array; composite keys (tuples) stay single object elements."""
+    if isinstance(x, np.ndarray) and x.ndim == 1:
+        return x
+    x = list(x)
+    arr = np.fromiter(x, dtype=object, count=len(x))
+    return arr
+
+
+def factorize(raw: np.ndarray) -> Tuple[np.ndarray, List[Any]]:
+    """First-occurrence-order integer encoding of a key column (C speed).
+
+    Returns (codes int32[n], vocabulary list). Falls back to np.unique
+    (sorted vocabulary order — equally valid, ids are internal) when pandas
+    is unavailable.
+    """
+    if _pd is not None:
+        codes, uniques = _pd.factorize(raw)
+        return codes.astype(np.int32), list(uniques)
+    uniques, inverse = np.unique(raw, return_inverse=True)
+    return inverse.astype(np.int32), list(uniques)
+
+
+def encode_with_vocab(raw: np.ndarray, vocab: Sequence[Any]) -> np.ndarray:
+    """Integer-encodes a key column against a FIXED vocabulary; -1 = absent."""
+    if _pd is not None:
+        return _pd.Index(vocab).get_indexer(raw).astype(np.int32)
+    lookup = {key: i for i, key in enumerate(vocab)}
+    return np.fromiter((lookup.get(k, -1) for k in raw),
+                       dtype=np.int32,
+                       count=len(raw))
+
+
+def encode_columns(
+        pid_raw: Sequence[Any],
+        pk_raw: Sequence[Any],
+        values: Sequence[float],
+        public_partitions: Optional[Sequence[Any]] = None) -> EncodedData:
+    """Vectorized encoding of raw key/value COLUMNS (no per-row Python).
+
+    This is the bulk-ingest entry point: file readers hand over whole
+    columns (numpy arrays of keys/values) and every vocabulary assignment
+    runs as one hash-factorization pass.
+    """
+    pid_raw = _as_key_array(pid_raw)
+    pk_raw = _as_key_array(pk_raw)
+    pid, pid_vocab = factorize(pid_raw)
+    if public_partitions is not None:
+        partition_vocab = list(dict.fromkeys(public_partitions))
+        pk = encode_with_vocab(pk_raw, partition_vocab)
+    else:
+        pk, partition_vocab = factorize(pk_raw)
+    return EncodedData(pid=pid,
+                       pk=pk,
+                       values=np.asarray(values, dtype=np.float64),
+                       partition_vocab=partition_vocab,
+                       n_privacy_ids=len(pid_vocab))
+
+
 def encode(col,
            data_extractors: DataExtractors,
            public_partitions: Optional[Sequence[Any]] = None) -> EncodedData:
@@ -57,34 +126,11 @@ def encode(col,
     pid_extractor = data_extractors.privacy_id_extractor or (lambda row: 0)
     pk_extractor = data_extractors.partition_extractor
     value_extractor = data_extractors.value_extractor or (lambda row: 0.0)
-
-    pid_vocab: Dict[Any, int] = {}
-    pk_vocab: Dict[Any, int] = {}
-    partition_vocab: List[Any] = []
-    if public_partitions is not None:
-        for pk in public_partitions:
-            if pk not in pk_vocab:
-                pk_vocab[pk] = len(partition_vocab)
-                partition_vocab.append(pk)
-    public = public_partitions is not None
-
-    pids, pks, values = [], [], []
-    for row in col:
-        pid_raw = pid_extractor(row)
-        pk_raw = pk_extractor(row)
-        pid_id = pid_vocab.setdefault(pid_raw, len(pid_vocab))
-        if public:
-            pk_id = pk_vocab.get(pk_raw, -1)
-        else:
-            pk_id = pk_vocab.setdefault(pk_raw, len(partition_vocab))
-            if pk_id == len(partition_vocab):
-                partition_vocab.append(pk_raw)
-        pids.append(pid_id)
-        pks.append(pk_id)
-        values.append(value_extractor(row))
-
-    return EncodedData(pid=np.asarray(pids, dtype=np.int32),
-                       pk=np.asarray(pks, dtype=np.int32),
-                       values=np.asarray(values, dtype=np.float64),
-                       partition_vocab=partition_vocab,
-                       n_privacy_ids=len(pid_vocab))
+    if not isinstance(col, (list, tuple, np.ndarray)):
+        col = list(col)
+    # Per-row extractor calls are the only remaining Python loop; all
+    # vocabulary work is vectorized in encode_columns.
+    pid_raw = [pid_extractor(row) for row in col]
+    pk_raw = [pk_extractor(row) for row in col]
+    values = [value_extractor(row) for row in col]
+    return encode_columns(pid_raw, pk_raw, values, public_partitions)

@@ -1,7 +1,8 @@
 """Tests for the analysis package (modeled on the reference's
-analysis/tests/: data-structure validation, Poisson-binomial, per-partition
-combiners, cross-partition combiners, utility-analysis e2e, tuning e2e,
-pre-aggregation parity, dataset summary)."""
+analysis/tests/: data-structure validation, Poisson-binomial, the error-model
+math, cross-partition reduction, utility-analysis e2e, tuning e2e,
+pre-aggregation parity, dataset summary) — plus dense-kernel vs distributed
+path parity, which the reference cannot test (it has only one path)."""
 
 import dataclasses
 
@@ -10,11 +11,12 @@ import pytest
 
 import pipelinedp_tpu as pdp
 from pipelinedp_tpu import analysis
-from pipelinedp_tpu import combiners as dp_combiners
+from pipelinedp_tpu import partition_selection
 from pipelinedp_tpu.analysis import (cross_partition_combiners,
-                                     data_structures, metrics,
+                                     data_structures, error_model as em,
+                                     kernels, metrics, parameter_tuning as pt,
                                      per_partition_combiners,
-                                     poisson_binomial)
+                                     poisson_binomial, utility_analysis)
 from pipelinedp_tpu.budget_accounting import MechanismSpec
 from pipelinedp_tpu.aggregate_params import MechanismType
 from pipelinedp_tpu.dataset_histograms import computing_histograms as ch
@@ -104,142 +106,313 @@ class TestPoissonBinomial:
         np.testing.assert_array_equal(pmf.probabilities, [1.0])
 
 
-def _combiner_params(eps=1e6,
-                     delta=1e-6,
-                     metrics_list=None,
-                     **kwargs) -> dp_combiners.CombinerParams:
-    spec = MechanismSpec(MechanismType.GAUSSIAN)
-    spec.set_eps_delta(eps, delta)
-    return dp_combiners.CombinerParams(spec,
-                                       _agg_params(metrics_list, **kwargs))
+class TestErrorModel:
+    """Unit tests of the closed-form stats math (same numeric expectations as
+    the reference's per-partition combiner tests)."""
 
+    def test_sum_stats(self):
+        params = _agg_params([pdp.Metrics.SUM], max_partitions_contributed=2)
+        stats = em.partition_stats(
+            counts=np.array([1, 1, 1]),
+            sums=np.array([3.0, 7.0, -1.0]),  # clip to [0, 5]
+            n_partitions=np.array([4, 1, 2]),
+            config_params=[params],
+            metric_list=[pdp.Metrics.SUM])
+        row = stats[0, 0]
+        assert row[em.RAW] == pytest.approx(9.0)
+        assert row[em.CLIP_MIN] == pytest.approx(1.0)  # -1 → 0
+        assert row[em.CLIP_MAX] == pytest.approx(-2.0)  # 7 → 5
+        # keep fractions: min(1, 2/4)=0.5, 1, 1 → 3*0.5 expected dropped
+        assert row[em.L0_MEAN] == pytest.approx(-1.5)
+        assert row[em.L0_VAR] == pytest.approx(3.0**2 * 0.25)
 
-class TestPerPartitionCombiners:
+    def test_count_stats_use_counts(self):
+        params = _agg_params(max_partitions_contributed=1,
+                             max_contributions_per_partition=2)
+        stats = em.partition_stats(
+            counts=np.array([3, 1]),
+            sums=np.array([100.0, 100.0]),  # ignored for COUNT
+            n_partitions=np.array([1, 1]),
+            config_params=[params],
+            metric_list=[pdp.Metrics.COUNT])
+        row = stats[0, 0]
+        assert row[em.RAW] == pytest.approx(4.0)
+        assert row[em.CLIP_MAX] == pytest.approx(-1.0)  # 3 clipped to 2
+        assert row[em.L0_MEAN] == pytest.approx(0.0)
 
-    def test_sum_combiner_accumulator(self):
-        params = _combiner_params(metrics_list=[pdp.Metrics.SUM],
-                                  max_partitions_contributed=2)
-        combiner = per_partition_combiners.SumCombiner(params)
-        counts = np.array([1, 1, 1])
-        sums = np.array([3.0, 7.0, -1.0])  # clip to [0, 5]
-        n_partitions = np.array([4, 1, 2])
-        acc = combiner.create_accumulator((counts, sums, n_partitions))
-        partition_sum, min_err, max_err, l0_err, l0_var = acc
-        assert partition_sum == pytest.approx(9.0)
-        assert min_err == pytest.approx(1.0)  # -1 → 0
-        assert max_err == pytest.approx(-2.0)  # 7 → 5
-        # keep probs: min(1, 2/4)=0.5, 1, 1 → contributions 3*0.5 dropped
-        assert l0_err == pytest.approx(-(3.0 * 0.5))
-        assert l0_var == pytest.approx(3.0**2 * 0.5 * 0.5)
+    def test_privacy_id_count_stats(self):
+        stats = em.partition_stats(counts=np.array([5, 2, 0]),
+                                   sums=np.zeros(3),
+                                   n_partitions=np.array([1, 1, 1]),
+                                   config_params=[_agg_params()],
+                                   metric_list=[pdp.Metrics.PRIVACY_ID_COUNT])
+        assert stats[0, 0, em.RAW] == pytest.approx(2.0)  # indicators 1+1+0
 
-    def test_count_combiner_uses_counts(self):
-        params = _combiner_params(max_partitions_contributed=1,
-                                  max_contributions_per_partition=2)
-        combiner = per_partition_combiners.CountCombiner(params)
-        counts = np.array([3, 1])
-        sums = np.array([100.0, 100.0])  # ignored
-        n_partitions = np.array([1, 1])
-        acc = combiner.create_accumulator((counts, sums, n_partitions))
-        partition_sum, _, max_err, l0_err, _ = acc
-        assert partition_sum == pytest.approx(4.0)
-        assert max_err == pytest.approx(-1.0)  # 3 clipped to 2
-        assert l0_err == pytest.approx(0.0)
+    def test_multi_config_broadcast(self):
+        # 3 configs analyzed in one call: l0 = 1, 2, 4 against n_partitions=4.
+        configs = [
+            _agg_params(max_partitions_contributed=l0) for l0 in (1, 2, 4)
+        ]
+        stats = em.partition_stats(counts=np.array([1]),
+                                   sums=np.zeros(1),
+                                   n_partitions=np.array([4]),
+                                   config_params=configs,
+                                   metric_list=[pdp.Metrics.COUNT])
+        np.testing.assert_allclose(stats[:, 0, em.L0_MEAN],
+                                   [-0.75, -0.5, 0.0])
 
-    def test_privacy_id_count_combiner(self):
-        params = _combiner_params()
-        combiner = per_partition_combiners.PrivacyIdCountCombiner(params)
-        counts = np.array([5, 2, 0])
-        acc = combiner.create_accumulator(
-            (counts, np.zeros(3), np.array([1, 1, 1])))
-        assert acc[0] == pytest.approx(2.0)  # indicators: 1+1+0
-
-    def test_partition_selection_combiner_high_eps(self):
-        params = _combiner_params(eps=1e3, delta=1e-4)
-        combiner = per_partition_combiners.PartitionSelectionCombiner(params)
-        counts = np.array([1] * 50)
-        acc = combiner.create_accumulator(
-            (counts, np.zeros(50), np.ones(50, dtype=int)))
-        prob = combiner.compute_metrics(acc)
+    def test_keep_probability_high_eps(self):
+        selector = partition_selection.create_partition_selection_strategy(
+            pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC, 1e3, 1e-4, 1,
+            None)
+        prob = em.host_keep_probability(np.ones(50), selector)
         assert prob == pytest.approx(1.0, abs=1e-6)
 
-    def test_merge_switches_to_moments(self):
-        params = _combiner_params()
-        combiner = per_partition_combiners.PartitionSelectionCombiner(params)
-        big = ([0.5] * 80, None)
-        other = ([0.5] * 40, None)
-        probs, moments = combiner.merge_accumulators(big, other)
-        assert probs is None
-        assert moments.count == 120
-        assert moments.expectation == pytest.approx(60.0)
+    def test_keep_probability_empty_partition(self):
+        selector = partition_selection.create_partition_selection_strategy(
+            pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC, 1.0, 1e-6, 1,
+            None)
+        assert em.host_keep_probability(np.zeros(0), selector) == 0.0
 
-    def test_raw_statistics_combiner(self):
-        combiner = per_partition_combiners.RawStatisticsCombiner()
-        acc = combiner.create_accumulator(
-            (np.array([2, 3, 1]), np.zeros(3), np.ones(3, dtype=int)))
-        assert combiner.compute_metrics(acc) == metrics.RawStatistics(
-            privacy_id_count=3, count=6)
+    def test_report_terms(self):
+        # SumMetrics(sum=10, min_err=0, max_err=-2, l0_err=-3, l0_std=2,
+        # noise_std=4) — same numbers as the reference's value-error test.
+        stats = np.array([10.0, 0.0, -2.0, -3.0, 4.0])
+        row = em.metric_report_terms(stats, 1.0, 1.0, 4.0)
+        assert row[em.ABS_MEAN] == pytest.approx(-5.0)
+        assert row[em.ABS_VAR] == pytest.approx(4.0 + 16.0)
+        assert row[em.ABS_RMSE] == pytest.approx(np.sqrt(25.0 + 20.0))
+        assert row[em.REL_RMSE] == pytest.approx(np.sqrt(45.0) / 10.0)
 
-    def test_compound_sparse_to_dense(self):
-        params = _combiner_params()
-        compound = per_partition_combiners.CompoundCombiner(
-            [per_partition_combiners.CountCombiner(params)],
-            return_named_tuple=False)
-        acc = compound.create_accumulator((2, 4.0, 3))
-        assert acc[0] == ([2], [4.0], [3])
-        assert acc[1] is None
-        # merging > 2*n_combiners rows converts to dense (later small sparse
-        # residue may coexist with the dense part until compute_metrics)
-        for i in range(5):
-            acc = compound.merge_accumulators(
-                acc, compound.create_accumulator((1, 1.0, 1)))
-        _, dense = acc
-        assert dense is not None
-        result = compound.compute_metrics(acc)
-        assert len(result) == 1
-        assert result[0].sum == pytest.approx(7.0)  # counts 2+5*1
+    def test_report_terms_data_dropped(self):
+        stats = np.array([10.0, 0.0, -2.0, -3.0, 4.0])
+        row = em.metric_report_terms(stats, 0.5, 1.0, 4.0)
+        assert row[em.DROP_L0] == pytest.approx(3.0)
+        assert row[em.DROP_LINF] == pytest.approx(2.0)
+        # survived = 10 - 3 - 2 = 5, half dropped by selection
+        assert row[em.DROP_PS] == pytest.approx(2.5)
+
+    def test_report_terms_zero_value_relative(self):
+        row = em.metric_report_terms(np.zeros(5), 1.0, 1.0, 4.0)
+        assert row[em.REL_RMSE] == 0.0
+        assert row[em.ABS_RMSE] == pytest.approx(4.0)
 
 
-class TestCrossPartitionCombiners:
+def _make_analyzer(metrics_list=None, configs=None, private=True, **kwargs):
+    params_list = configs or [_agg_params(metrics_list, **kwargs)]
+    metric_list = em.ordered_metrics(params_list[0])
+    spec = MechanismSpec(MechanismType.GAUSSIAN)
+    spec.set_eps_delta(1e3, 1e-4)
+    sel_spec = None
+    if private:
+        sel_spec = MechanismSpec(MechanismType.GENERIC)
+        sel_spec.set_eps_delta(1e3, 1e-4)
+    return per_partition_combiners.PerPartitionAnalyzer(
+        config_params=params_list,
+        metric_list=metric_list,
+        metric_specs=[spec] * len(metric_list),
+        selection_spec=sel_spec)
 
-    def _sum_metrics(self, value=10.0):
-        return metrics.SumMetrics(aggregation=pdp.Metrics.COUNT,
-                                  sum=value,
-                                  clipping_to_min_error=0.0,
-                                  clipping_to_max_error=-2.0,
-                                  expected_l0_bounding_error=-3.0,
-                                  std_l0_bounding_error=2.0,
-                                  std_noise=4.0,
-                                  noise_kind=pdp.NoiseKind.GAUSSIAN)
 
-    def test_data_dropped(self):
-        info = cross_partition_combiners._sum_metrics_to_data_dropped(
-            self._sum_metrics(), 0.5, pdp.Metrics.COUNT)
-        assert info.l0 == pytest.approx(3.0)
-        assert info.linf == pytest.approx(2.0)
-        # survived = 10 - 3 - 2 = 5, dropped half
-        assert info.partition_selection == pytest.approx(2.5)
+class TestPerPartitionAnalyzer:
 
-    def test_value_errors(self):
-        err = cross_partition_combiners._sum_metrics_to_value_error(
-            self._sum_metrics(), keep_prob=1.0, weight=1.0)
-        assert err.mean == pytest.approx(-5.0)
-        assert err.variance == pytest.approx(4.0 + 16.0)
-        assert err.rmse == pytest.approx(np.sqrt(25.0 + 20.0))
+    def test_flat_output_layout(self):
+        analyzer = _make_analyzer([pdp.Metrics.COUNT, pdp.Metrics.SUM])
+        flat = analyzer.analyze_rows([(2, 3.0, 1, 2), (1, 1.0, 2, 3)])
+        assert isinstance(flat[0], metrics.RawStatistics)
+        assert flat[0] == metrics.RawStatistics(privacy_id_count=2, count=3)
+        assert isinstance(flat[1], float)  # keep probability
+        # canonical metric order: SUM before COUNT
+        assert flat[2].aggregation == pdp.Metrics.SUM
+        assert flat[3].aggregation == pdp.Metrics.COUNT
+        assert len(flat) == 4
 
-    def test_combiner_roundtrip_public(self):
-        combiner = cross_partition_combiners.CrossPartitionCombiner(
+    def test_none_markers_ignored(self):
+        analyzer = _make_analyzer(private=False)
+        flat = analyzer.analyze_rows([None])
+        assert flat[0] == metrics.RawStatistics(privacy_id_count=0, count=0)
+        assert flat[1].sum == 0.0
+
+    def test_high_eps_keep_probability(self):
+        analyzer = _make_analyzer()
+        flat = analyzer.analyze_rows([(1, 0.0, 1, 1)] * 50)
+        assert flat[1] == pytest.approx(1.0, abs=1e-6)
+
+    def test_pickle_roundtrip(self):
+        import pickle
+        analyzer = _make_analyzer()
+        analyzer.resolve_mechanisms()
+        clone = pickle.loads(pickle.dumps(analyzer))
+        flat = clone.analyze_rows([(1, 2.0, 1, 1)])
+        assert flat[2].sum == pytest.approx(1.0)  # COUNT raw
+
+
+class TestCrossPartitionAggregator:
+
+    def _per_partition(self, value=10.0):
+        sm = metrics.SumMetrics(aggregation=pdp.Metrics.COUNT,
+                                sum=value,
+                                clipping_to_min_error=0.0,
+                                clipping_to_max_error=-2.0,
+                                expected_l0_bounding_error=-3.0,
+                                std_l0_bounding_error=2.0,
+                                std_noise=4.0,
+                                noise_kind=pdp.NoiseKind.GAUSSIAN)
+        return metrics.PerPartitionMetrics(1.0, metrics.RawStatistics(3, 6),
+                                           [sm])
+
+    def test_roundtrip_public(self):
+        aggregator = cross_partition_combiners.CrossPartitionAggregator(
             [pdp.Metrics.COUNT], public_partitions=True)
-        per_partition = metrics.PerPartitionMetrics(
-            1.0, metrics.RawStatistics(3, 6), [self._sum_metrics()])
-        acc = combiner.create_accumulator(per_partition)
-        acc = combiner.merge_accumulators(
-            acc, combiner.create_accumulator(per_partition))
-        report = combiner.compute_metrics(acc)
+        acc = aggregator.create_accumulator([self._per_partition()])
+        acc = aggregator.merge_accumulators(
+            acc, aggregator.create_accumulator([self._per_partition()]))
+        reports = aggregator.compute_reports(
+            acc, np.array([[4.0]]), [pdp.NoiseKind.GAUSSIAN])
+        assert len(reports) == 1
+        report = reports[0]
         assert report.partitions_info.num_dataset_partitions == 2
-        assert len(report.metric_errors) == 1
         # two identical partitions → averaged rmse equals single-partition
         assert report.metric_errors[0].absolute_error.rmse == pytest.approx(
             np.sqrt(45.0))
+        drop = report.metric_errors[0].ratio_data_dropped
+        assert drop.l0 == pytest.approx(3.0 / 10.0)
+
+    def test_merge_is_vector_addition(self):
+        aggregator = cross_partition_combiners.CrossPartitionAggregator(
+            [pdp.Metrics.COUNT], public_partitions=False)
+        a1 = aggregator.create_accumulator([self._per_partition(10.0)])
+        a2 = aggregator.create_accumulator([self._per_partition(20.0)])
+        merged = aggregator.merge_accumulators(a1, a2)
+        np.testing.assert_allclose(merged[0], a1[0] + a2[0])
+        np.testing.assert_allclose(merged[1], a1[1] + a2[1])
+
+
+def _numeric_leaves(obj, path=""):
+    """Yields (path, float) for every numeric field of nested dataclasses."""
+    if dataclasses.is_dataclass(obj):
+        for f in dataclasses.fields(obj):
+            yield from _numeric_leaves(getattr(obj, f.name),
+                                       f"{path}.{f.name}")
+    elif isinstance(obj, (list, tuple)):
+        for i, item in enumerate(obj):
+            yield from _numeric_leaves(item, f"{path}[{i}]")
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        yield path, float(obj)
+
+
+def assert_reports_close(r1, r2, rel=1e-9, abs_tol=1e-9):
+    leaves1 = dict(_numeric_leaves(r1))
+    leaves2 = dict(_numeric_leaves(r2))
+    assert leaves1.keys() == leaves2.keys()
+    for path, v1 in leaves1.items():
+        assert v1 == pytest.approx(leaves2[path], rel=rel, abs=abs_tol), path
+
+
+def _run_distributed(data, options, data_extractors, public=None):
+    """Drives the distributed cross-partition path (the one Beam/Spark use)
+    over the LocalBackend op vocabulary."""
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=options.epsilon,
+                                           total_delta=options.delta)
+    engine = analysis.UtilityAnalysisEngine(accountant, BACKEND)
+    reports, per_part = utility_analysis._perform_distributed(
+        data, BACKEND, engine, accountant, options, data_extractors, public)
+    return list(reports), list(per_part)
+
+
+class TestDenseDistributedParity:
+    """The dense XLA sweep and the distributed per-partition path implement
+    the same error model; their reports must agree."""
+
+    def _options(self, public, multi=True, metrics_list=None):
+        config = None
+        if multi:
+            config = data_structures.MultiParameterConfiguration(
+                max_partitions_contributed=[1, 2, 3],
+                max_contributions_per_partition=[1, 2, 2])
+        return data_structures.UtilityAnalysisOptions(
+            epsilon=10,
+            delta=1e-5,
+            aggregate_params=_agg_params(metrics_list),
+            multi_param_configuration=config)
+
+    def test_public_exact_parity(self):
+        options = self._options(public=True,
+                                metrics_list=[pdp.Metrics.COUNT,
+                                              pdp.Metrics.SUM])
+        public = ["pk0", "pk1", "pk2", "pk_missing"]
+        dense_reports, dense_pp = analysis.perform_utility_analysis(
+            DATA, BACKEND, options, EXTRACTORS, public_partitions=public)
+        dist_reports, dist_pp = _run_distributed(DATA, options, EXTRACTORS,
+                                                 public)
+        dense_reports = sorted(dense_reports,
+                               key=lambda r: r.configuration_index)
+        dist_reports = sorted(dist_reports,
+                              key=lambda r: r.configuration_index)
+        assert len(dense_reports) == len(dist_reports) == 3
+        for d, h in zip(dense_reports, dist_reports):
+            # Public path has no PMF approximation → tight agreement.
+            assert_reports_close(d, h, rel=1e-6, abs_tol=1e-9)
+        assert len(dense_pp) == len(dist_pp) == 4 * 3
+        assert dict((k, v.metric_errors[0].sum) for k, v in dense_pp) == \
+            pytest.approx(dict((k, v.metric_errors[0].sum) for k, v in dist_pp))
+
+    def test_private_parity_within_pmf_tolerance(self):
+        options = self._options(public=False)
+        dense_reports, dense_pp = analysis.perform_utility_analysis(
+            DATA, BACKEND, options, EXTRACTORS)
+        dist_reports, _ = _run_distributed(DATA, options, EXTRACTORS)
+        dense_reports = sorted(dense_reports,
+                               key=lambda r: r.configuration_index)
+        dist_reports = sorted(dist_reports,
+                              key=lambda r: r.configuration_index)
+        for d, h in zip(dense_reports, dist_reports):
+            # Private selection: the device integrates a windowed
+            # refined-normal PMF, the host the exact Poisson binomial for
+            # small partitions — a few % drift is expected.
+            assert_reports_close(d, h, rel=0.05, abs_tol=0.05)
+
+    def test_private_parity_large_partitions(self):
+        # >100 privacy ids per partition: both paths use the moment-based
+        # approximation → tighter agreement.
+        data = [(uid, f"pk{uid % 2}", 1.0) for uid in range(300)]
+        options = self._options(public=False, multi=False)
+        dense_reports, _ = analysis.perform_utility_analysis(
+            data, BACKEND, options, EXTRACTORS)
+        dist_reports, _ = _run_distributed(data, options, EXTRACTORS)
+        assert_reports_close(
+            sorted(dense_reports, key=lambda r: r.configuration_index)[0],
+            sorted(dist_reports, key=lambda r: r.configuration_index)[0],
+            rel=0.01,
+            abs_tol=0.01)
+
+
+class TestKeepProbBatchKernel:
+
+    @pytest.mark.parametrize("strategy", [
+        pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC,
+        pdp.PartitionSelectionStrategy.LAPLACE_THRESHOLDING,
+        pdp.PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING,
+    ])
+    def test_matches_host_selector(self, strategy):
+        import jax.numpy as jnp
+        params = [
+            _agg_params(max_partitions_contributed=l0,
+                        partition_selection_strategy=strategy)
+            for l0 in (1, 3)
+        ]
+        cfg = kernels.build_config_arrays(params, [pdp.Metrics.COUNT],
+                                          np.ones((2, 1)), (2.0, 1e-5))
+        counts = np.arange(0, 60, dtype=np.float64)
+        got = np.asarray(
+            kernels._keep_prob_batch(jnp.asarray(np.tile(counts, (2, 1))),
+                                     cfg))
+        for ki, p in enumerate(params):
+            selector = partition_selection.create_partition_selection_strategy(
+                strategy, 2.0, 1e-5, p.max_partitions_contributed, None)
+            expected = selector.probability_of_keep_vec(
+                counts.astype(np.int64))
+            np.testing.assert_allclose(got[ki], expected, atol=1e-9)
 
 
 class TestUtilityAnalysisE2E:
@@ -269,6 +442,19 @@ class TestUtilityAnalysisE2E:
         per_partition = list(per_partition_col)
         assert len(per_partition) == 3
         assert all(key[1] == 0 for key, _ in per_partition)
+
+    def test_empty_public_partition_counted(self):
+        options = data_structures.UtilityAnalysisOptions(
+            epsilon=1e3,
+            delta=1e-5,
+            aggregate_params=_agg_params([pdp.Metrics.COUNT]))
+        public = ["pk0", "pk1", "pk2", "pk_unused"]
+        reports_col, per_partition_col = analysis.perform_utility_analysis(
+            DATA, BACKEND, options, EXTRACTORS, public_partitions=public)
+        report = list(reports_col)[0]
+        assert report.partitions_info.num_dataset_partitions == 3
+        assert report.partitions_info.num_empty_partitions == 1
+        assert len(list(per_partition_col)) == 4
 
     def test_private_partitions_multi_config(self):
         config = data_structures.MultiParameterConfiguration(
@@ -376,20 +562,40 @@ class TestPreAggregation:
 
 class TestParameterTuning:
 
-    def test_constant_relative_step_candidates(self):
-        from pipelinedp_tpu.analysis import parameter_tuning as pt
-        h = ch._frequencies_to_histogram(
-            np.array([1, 10, 100]), np.array([5, 5, 5]),
-            name=__import__(
-                'pipelinedp_tpu.dataset_histograms.histograms',
-                fromlist=['HistogramType']).HistogramType.L0_CONTRIBUTIONS)
-        candidates = pt._find_candidates_constant_relative_step(h, 5)
+    def test_geometric_candidates(self):
+        candidates = pt.geometric_candidates(100, 5)
         assert candidates[0] == 1
         assert candidates[-1] == 100
         assert candidates == sorted(set(candidates))
+        assert len(candidates) <= 5
+
+    def test_geometric_candidates_edge_cases(self):
+        assert pt.geometric_candidates(1, 10) == [1]
+        assert pt.geometric_candidates(5, 1) == [1]
+        # n > max_value → every integer
+        assert pt.geometric_candidates(3, 100) == [1, 2, 3]
+
+    def test_quantile_candidates_cover_max(self):
+        histograms = list(
+            ch.compute_dataset_histograms(DATA, EXTRACTORS, BACKEND))[0]
+        hist = histograms.linf_sum_contributions_histogram
+        candidates = pt.quantile_candidates(hist, 4)
+        assert candidates == sorted(set(candidates))
+        assert candidates[-1] == pytest.approx(hist.max_value())
+
+    def test_cross_product_budget(self):
+        c1, c2 = pt.cross_product_candidates(
+            lambda n: pt.geometric_candidates(100, n),
+            lambda n: pt.geometric_candidates(100, n), 9)
+        assert len(c1) == len(c2) <= 9
+        # short axis re-spends budget on the other one
+        c1, c2 = pt.cross_product_candidates(
+            lambda n: pt.geometric_candidates(1, n),
+            lambda n: pt.geometric_candidates(10**6, n), 9)
+        assert set(c1) == {1}
+        assert len(c2) == 9
 
     def test_tune_e2e_count(self):
-        from pipelinedp_tpu.analysis import parameter_tuning as pt
         histograms = list(
             ch.compute_dataset_histograms(DATA, EXTRACTORS, BACKEND))[0]
         options = pt.TuneOptions(
@@ -411,7 +617,6 @@ class TestParameterTuning:
         assert len(result.utility_reports) == n
 
     def test_tune_rejects_two_metrics(self):
-        from pipelinedp_tpu.analysis import parameter_tuning as pt
         options = pt.TuneOptions(
             epsilon=1,
             delta=1e-5,
