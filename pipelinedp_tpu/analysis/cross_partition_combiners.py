@@ -107,14 +107,3 @@ class CrossPartitionAggregator:
                 report.partitions_info.strategy = strategies[ki]
             reports.append(report)
         return reports
-
-    # Compatibility with the backend combiner protocol (values are already
-    # accumulators when combine_accumulators_per_key runs).
-    def compute_metrics(self, acc: AccumulatorType) -> AccumulatorType:
-        return acc
-
-    def metrics_names(self):
-        return []
-
-    def explain_computation(self):
-        return None

@@ -166,15 +166,21 @@ def info_terms(n_users, keep_prob, weight, public: bool, xp=np):
 # ---------------------------------------------------------------------------
 
 
+def _pmf_keep_probability(pmf, selector) -> float:
+    """Integrates the selector's keep probability over an id-count PMF —
+    one vectorized dot product instead of per-integer strategy calls."""
+    counts = np.arange(pmf.start, pmf.start + len(pmf.probabilities))
+    keep = selector.probability_of_keep_vec(counts)
+    return float(np.clip(np.dot(pmf.probabilities, keep), 0.0, 1.0))
+
+
 def host_keep_probability(per_row_q: np.ndarray,
                           selector) -> float:
     """P(partition kept) for one partition and one config.
 
     per_row_q: [M] keep fraction per contributing privacy id. Uses the exact
     Poisson-binomial PMF for at most EXACT_PMF_LIMIT ids, the refined-normal
-    approximation beyond — then integrates the selector's keep probability
-    over the PMF (reference ``per_partition_combiners.py:96-150``, but as one
-    vectorized dot product instead of per-integer strategy calls).
+    approximation beyond (reference ``per_partition_combiners.py:96-150``).
     """
     m = len(per_row_q)
     if m == 0:
@@ -185,9 +191,19 @@ def host_keep_probability(per_row_q: np.ndarray,
         exp, std, skew = poisson_binomial.compute_exp_std_skewness(
             list(per_row_q))
         pmf = poisson_binomial.compute_pmf_approximation(exp, std, skew, m)
-    counts = np.arange(pmf.start, pmf.start + len(pmf.probabilities))
-    keep = selector.probability_of_keep_vec(counts)
-    return float(np.clip(np.dot(pmf.probabilities, keep), 0.0, 1.0))
+    return _pmf_keep_probability(pmf, selector)
+
+
+def host_keep_probability_from_moments(mu: float, var: float, third: float,
+                                       n_users: int, selector) -> float:
+    """P(partition kept) from accumulated Bernoulli moments (the dense
+    accumulator path — per-row keep fractions no longer available)."""
+    if n_users == 0:
+        return 0.0
+    std = math.sqrt(max(var, 0.0))
+    skew = 0.0 if std == 0 else third / std**3
+    pmf = poisson_binomial.compute_pmf_approximation(mu, std, skew, n_users)
+    return _pmf_keep_probability(pmf, selector)
 
 
 # ---------------------------------------------------------------------------

@@ -33,6 +33,11 @@ from pipelinedp_tpu.analysis import metrics as metrics_dc
 # n_contributions) for one (privacy_id, partition_key) pair.
 PreaggregatedRow = Tuple[int, float, int, int]
 
+# Rows kept raw in a sparse accumulator before switching to fixed-size dense
+# statistics; also the exact-Poisson-binomial cutoff (mirrors the reference's
+# MAX_PROBABILITIES_IN_ACCUMULATOR cap, ``per_partition_combiners.py:40``).
+SPARSE_CAP = em.EXACT_PMF_LIMIT
+
 
 class PerPartitionAnalyzer:
     """Analyzes one partition's rows under every parameter configuration.
@@ -104,9 +109,56 @@ class PerPartitionAnalyzer:
         return state
 
     def analyze_rows(self, rows: List[Optional[PreaggregatedRow]]) -> Tuple:
-        """Analyzes one partition. ``None`` rows (empty-public markers) are
-        ignored."""
+        """Analyzes one partition's full row list. ``None`` rows
+        (empty-public markers) are ignored."""
         rows = [r for r in rows if r is not None]
+        if len(rows) <= SPARSE_CAP:
+            return self._compute_sparse(rows)
+        return self._compute_dense(self._densify(rows))
+
+    # --- Mergeable accumulator protocol (distributed combine_per_key). ---
+    #
+    # Accumulators stay SPARSE (the raw row list) up to SPARSE_CAP rows —
+    # preserving the exact Poisson-binomial keep probability for small
+    # partitions — then switch to DENSE fixed-size sufficient statistics
+    # ([K, n_metrics, STAT_WIDTH] + [K, SEL_WIDTH] selection moments), so a
+    # hot partition costs O(K) memory per worker, never O(rows).
+
+    def create_accumulator(self, row: Optional[PreaggregatedRow]):
+        return "s", ([] if row is None else [row])
+
+    def _densify(self, rows: List[PreaggregatedRow]):
+        counts = np.array([r[0] for r in rows], dtype=np.float64)
+        sums = np.array([r[1] for r in rows], dtype=np.float64)
+        contributed = np.array([r[2] for r in rows], dtype=np.float64)
+        stats = em.partition_stats(counts, sums, contributed,
+                                   self._config_params, self._metric_list)
+        sel = np.zeros((len(self._config_params), em.SEL_WIDTH))
+        if self.private and len(rows):
+            l0 = np.array([[p.max_partitions_contributed]
+                           for p in self._config_params], dtype=np.float64)
+            q = em.keep_fraction(contributed[None, :], l0)
+            sel = em.selection_moment_terms(q).sum(axis=-2)
+        return "d", stats, sel, len(rows), int(counts.sum())
+
+    def merge_accumulators(self, acc1, acc2):
+        if acc1[0] == "s" and acc2[0] == "s":
+            if len(acc1[1]) + len(acc2[1]) <= SPARSE_CAP:
+                return "s", acc1[1] + acc2[1]
+        if acc1[0] == "s":
+            acc1 = self._densify(acc1[1])
+        if acc2[0] == "s":
+            acc2 = self._densify(acc2[1])
+        return ("d", acc1[1] + acc2[1], acc1[2] + acc2[2], acc1[3] + acc2[3],
+                acc1[4] + acc2[4])
+
+    def compute(self, acc) -> Tuple:
+        """Finalizes an accumulator into the flat results tuple."""
+        if acc[0] == "s":
+            return self._compute_sparse(acc[1])
+        return self._compute_dense(acc)
+
+    def _compute_sparse(self, rows: List[PreaggregatedRow]) -> Tuple:
         noise_stds, selectors = self.resolve_mechanisms()
         counts = np.array([r[0] for r in rows], dtype=np.float64)
         sums = np.array([r[1] for r in rows], dtype=np.float64)
@@ -128,3 +180,30 @@ class PerPartitionAnalyzer:
                                             float(noise_stds[ki, mi]),
                                             params.noise_kind))
         return tuple(result)
+
+    def _compute_dense(self, acc) -> Tuple:
+        _, stats, sel, n_users, n_rows = acc
+        noise_stds, selectors = self.resolve_mechanisms()
+        result = [
+            metrics_dc.RawStatistics(privacy_id_count=n_users, count=n_rows)
+        ]
+        for ki, params in enumerate(self._config_params):
+            if self.private:
+                result.append(
+                    em.host_keep_probability_from_moments(
+                        sel[ki, em.SEL_MU], sel[ki, em.SEL_VAR],
+                        sel[ki, em.SEL_SKEW3], n_users, selectors[ki]))
+            for mi, metric in enumerate(self._metric_list):
+                result.append(
+                    em.stats_to_sum_metrics(stats[ki, mi], metric,
+                                            float(noise_stds[ki, mi]),
+                                            params.noise_kind))
+        return tuple(result)
+
+    # Backend combiner-protocol stubs (combine_accumulators_per_key only
+    # calls merge_accumulators; these satisfy isinstance-free duck typing).
+    def metrics_names(self) -> List[str]:
+        return []
+
+    def explain_computation(self):
+        return None

@@ -126,28 +126,46 @@ def _perform_dense(col, engine, budget_accountant, options, data_extractors,
     return reports, per_partition
 
 
+class _LazyCollection:
+    """Re-iterable lazy collection (LocalBackend collection semantics):
+    Python objects are only built when (and each time) iterated."""
+
+    def __init__(self, gen_fn):
+        self._gen_fn = gen_fn
+
+    def __iter__(self):
+        return self._gen_fn()
+
+
 def _dense_per_partition(out, keys, analyzer, public):
-    """((pk, config_index), PerPartitionMetrics) rows from kernel outputs."""
-    stats = np.asarray(out["stats"], dtype=np.float64)
-    keep_prob = np.asarray(out["keep_prob"], dtype=np.float64)
-    n_users = np.asarray(out["n_users"])
-    n_rows = np.asarray(out["n_rows"])
-    noise_stds, _ = analyzer.resolve_mechanisms()
-    result = []
-    for pi, pk in enumerate(keys):
-        raw = metrics.RawStatistics(privacy_id_count=int(round(n_users[pi])),
-                                    count=int(round(n_rows[pi])))
-        for ki, params in enumerate(analyzer.config_params):
-            errors = [
-                em.stats_to_sum_metrics(stats[ki, pi, mi], metric,
-                                        float(noise_stds[ki, mi]),
-                                        params.noise_kind)
-                for mi, metric in enumerate(analyzer.metric_list)
-            ]
-            prob = 1.0 if public else float(keep_prob[ki, pi])
-            result.append(
-                ((pk, ki), metrics.PerPartitionMetrics(prob, raw, errors)))
-    return result
+    """((pk, config_index), PerPartitionMetrics) rows from kernel outputs.
+
+    Lazy: a 64-config x 10^5-partition sweep would otherwise materialize
+    millions of dataclasses that callers like parameter_tuning never read.
+    """
+
+    def gen():
+        stats = np.asarray(out["stats"], dtype=np.float64)
+        keep_prob = np.asarray(out["keep_prob"], dtype=np.float64)
+        n_users = np.asarray(out["n_users"])
+        n_rows = np.asarray(out["n_rows"])
+        noise_stds, _ = analyzer.resolve_mechanisms()
+        for pi, pk in enumerate(keys):
+            raw = metrics.RawStatistics(
+                privacy_id_count=int(round(n_users[pi])),
+                count=int(round(n_rows[pi])))
+            for ki, params in enumerate(analyzer.config_params):
+                errors = [
+                    em.stats_to_sum_metrics(stats[ki, pi, mi], metric,
+                                            float(noise_stds[ki, mi]),
+                                            params.noise_kind)
+                    for mi, metric in enumerate(analyzer.metric_list)
+                ]
+                prob = 1.0 if public else float(keep_prob[ki, pi])
+                yield ((pk, ki), metrics.PerPartitionMetrics(
+                    prob, raw, errors))
+
+    return _LazyCollection(gen)
 
 
 def _build_reports(bucket_rows, bucket_info, analyzer, options,
@@ -256,45 +274,27 @@ def _perform_distributed(col, backend, engine, budget_accountant, options,
         "Per-bucket report vectors")
     combined = backend.combine_accumulators_per_key(
         keyed, aggregator, "Combine cross-partition metrics")
-    listed = backend.to_list(combined, "To list")
+    # Collapse the (at most N_BUCKETS) bucket vectors to one worker via
+    # group_by_key — available on every backend, unlike to_list — and reuse
+    # the dense path's report builder so the two paths cannot diverge.
+    rekeyed = backend.map_tuple(combined, lambda bucket, acc:
+                                (None, (bucket, acc)), "Key all buckets")
+    grouped = backend.group_by_key(rekeyed, "Gather bucket vectors")
     reports = backend.flat_map(
-        listed, lambda bucket_accs: _finalize_distributed(
-            bucket_accs, aggregator, analyzer, options, public),
+        grouped, lambda kv: _finalize_distributed(
+            list(kv[1]), analyzer, options, public),
         "Finalize utility reports")
     return reports, per_partition_out
 
 
-def _finalize_distributed(bucket_accs, aggregator, analyzer, options, public):
-    """Builds the per-config reports from per-bucket accumulated vectors."""
-    noise_stds, _ = analyzer.resolve_mechanisms()
-    noise_kinds = [p.noise_kind for p in analyzer.config_params]
-    strategies = (None if public else
-                  data_structures.get_partition_selection_strategy(options))
+def _finalize_distributed(bucket_accs, analyzer, options, public):
+    """Scatters the per-bucket vectors into dense [K, B, ...] arrays and
+    finalizes them with the same builder the dense path uses."""
     k = len(analyzer.config_params)
     n_metrics = len(analyzer.metric_list)
-    zero = (np.zeros((k, n_metrics, em.REPORT_WIDTH)),
-            np.zeros((k, em.INFO_WIDTH)))
-    total = zero
-    for _, acc in bucket_accs:
-        total = aggregator.merge_accumulators(total, acc)
-    global_reports = aggregator.compute_reports(total, noise_stds,
-                                                noise_kinds, strategies)
-    histograms = [[] for _ in range(k)]
-    if n_metrics:
-        for bucket, acc in sorted(bucket_accs, key=lambda kv: kv[0]):
-            for ki, sub in enumerate(
-                    aggregator.compute_reports(acc, noise_stds, noise_kinds,
-                                               strategies)):
-                sub.configuration_index = ki
-                histograms[ki].append(
-                    metrics.UtilityReportBin(
-                        partition_size_from=BUCKET_BOUNDS[bucket],
-                        partition_size_to=(BUCKET_BOUNDS[bucket + 1]
-                                           if bucket + 1 < len(BUCKET_BOUNDS)
-                                           else -1),
-                        report=sub))
-    for ki, report in enumerate(global_reports):
-        report.configuration_index = ki
-        if n_metrics:
-            report.utility_report_histogram = histograms[ki]
-        yield report
+    bucket_rows = np.zeros((k, kernels.N_BUCKETS, n_metrics, em.REPORT_WIDTH))
+    bucket_info = np.zeros((k, kernels.N_BUCKETS, em.INFO_WIDTH))
+    for bucket, (rows, info) in bucket_accs:
+        bucket_rows[:, bucket] += rows
+        bucket_info[:, bucket] += info
+    return _build_reports(bucket_rows, bucket_info, analyzer, options, public)

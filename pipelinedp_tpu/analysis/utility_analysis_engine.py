@@ -143,8 +143,14 @@ class UtilityAnalysisEngine:
                                   "Empty public partition markers")
             col = backend.flatten((col, markers),
                                   "Join markers with dataset rows")
-        col = backend.group_by_key(col, "Group by partition key")
-        return backend.map_values(col, analyzer.analyze_rows,
+        # Mergeable bounded accumulators (sparse rows -> dense moments above
+        # SPARSE_CAP) so hot partitions reduce incrementally on distributed
+        # backends instead of materializing every row on one worker.
+        col = backend.map_values(col, analyzer.create_accumulator,
+                                 "Wrap rows into analysis accumulators")
+        col = backend.combine_accumulators_per_key(
+            col, analyzer, "Merge analysis accumulators per partition")
+        return backend.map_values(col, analyzer.compute,
                                   "Per-partition utility analysis")
 
 

@@ -66,15 +66,24 @@ def _as_key_array(x) -> np.ndarray:
 def factorize(raw: np.ndarray) -> Tuple[np.ndarray, List[Any]]:
     """First-occurrence-order integer encoding of a key column (C speed).
 
-    Returns (codes int32[n], vocabulary list). Falls back to np.unique
-    (sorted vocabulary order — equally valid, ids are internal) when pandas
-    is unavailable.
+    Returns (codes int32[n], vocabulary list). None/NaN are ordinary keys
+    (use_na_sentinel=False) — a None partition key forms a partition, same
+    as any dict-based grouping would. Falls back to np.unique (sorted
+    vocabulary order — equally valid, ids are internal), and to a Python
+    dict loop for key types neither library can handle.
     """
     if _pd is not None:
-        codes, uniques = _pd.factorize(raw)
+        codes, uniques = _pd.factorize(raw, use_na_sentinel=False)
         return codes.astype(np.int32), list(uniques)
-    uniques, inverse = np.unique(raw, return_inverse=True)
-    return inverse.astype(np.int32), list(uniques)
+    try:
+        uniques, inverse = np.unique(raw, return_inverse=True)
+        return inverse.astype(np.int32), list(uniques)
+    except TypeError:  # unorderable mixed-type keys
+        vocab: dict = {}
+        codes = np.empty(len(raw), dtype=np.int32)
+        for i, key in enumerate(raw):
+            codes[i] = vocab.setdefault(key, len(vocab))
+        return codes, list(vocab)
 
 
 def encode_with_vocab(raw: np.ndarray, vocab: Sequence[Any]) -> np.ndarray:

@@ -33,6 +33,7 @@ the engine wraps execution in a lazy generator that runs on first iteration.
 """
 
 import functools
+import math
 from dataclasses import dataclass
 from typing import Any, List, Optional, Sequence, Tuple
 
@@ -218,7 +219,16 @@ def compute_noise_sensitivities(compound: dp_combiners.CompoundCombiner,
                 dp_computations.vector_noise_sensitivity(
                     child._params.additive_vector_noise_params))
         elif isinstance(child, dp_combiners.QuantileCombiner):
-            sens.append(0.0)  # quantile slot: secure mode rejects it
+            # Per tree level each privacy id touches <= l0 partitions x linf
+            # rows, one node per row: l1 = l0*linf (Laplace), l2 =
+            # sqrt(l0)*linf (Gaussian) — matching per_level_noise_std's
+            # calibration.
+            l0 = params.max_partitions_contributed
+            linf = params.max_contributions_per_partition
+            if params.noise_kind == NoiseKind.LAPLACE:
+                sens.append(float(l0 * linf))
+            else:
+                sens.append(math.sqrt(l0) * linf)
         else:
             raise NotImplementedError(type(child))
     return np.asarray(sens, dtype=np.float64)
@@ -611,7 +621,8 @@ def _descend_quantiles(noisy_levels, min_v, max_v, cfg: KernelConfig):
 
 
 def quantile_outputs(qrows, min_v, max_v, stds, key: jax.Array,
-                     cfg: KernelConfig, psum_axis: Optional[str] = None):
+                     cfg: KernelConfig, psum_axis: Optional[str] = None,
+                     secure_tables=None):
     """Per-partition DP quantiles from the bounded row stream.
 
     Builds the dense per-partition tree histograms chunk-by-chunk over the
@@ -634,8 +645,12 @@ def quantile_outputs(qrows, min_v, max_v, stds, key: jax.Array,
     C = cfg.quantile_chunk
     n_chunks = -(-P // C)
     f = _ftype()
-    std = stds[quantile_std_index(cfg.plan)].astype(f)
+    qidx = quantile_std_index(cfg.plan)
+    std = stds[qidx].astype(f)
     plan_names = next(e.outputs for e in cfg.plan if e.kind == 'quantiles')
+    if cfg.secure and secure_tables is None:
+        raise ValueError("cfg.secure requires secure_tables "
+                         "(secure_noise.build_tables)")
 
     def chunk_fn(c):
         base = c * C
@@ -660,11 +675,21 @@ def quantile_outputs(qrows, min_v, max_v, stds, key: jax.Array,
             counts.append(counts[-1].reshape(C, B**level, B).sum(axis=-1))
         counts.reverse()  # counts[l-1] : (C, B^l)
         ckey = jax.random.fold_in(key, c)
-        noisy = [
-            counts[l].astype(f) + noise_ops.additive_noise(
-                jax.random.fold_in(ckey, l), counts[l].shape, std,
-                cfg.noise_kind) for l in range(h)
-        ]
+        noisy = []
+        for l in range(h):
+            nkey = jax.random.fold_in(ckey, l)
+            if cfg.secure:
+                # Node counts are integers: snapping to the secure grid +
+                # table-sampled discrete noise, same release discipline as
+                # the scalar metric slots (ops/secure_noise.py).
+                thr_hi, thr_lo, gran = secure_tables
+                noisy.append(
+                    secure_noise.snapped_noisy(counts[l].astype(f), nkey,
+                                               thr_hi[qidx], thr_lo[qidx],
+                                               gran[qidx]))
+            else:
+                noisy.append(counts[l].astype(f) + noise_ops.additive_noise(
+                    nkey, counts[l].shape, std, cfg.noise_kind))
         return _descend_quantiles(noisy, min_v, max_v, cfg)
 
     if n_chunks == 1:
@@ -692,7 +717,8 @@ def aggregate_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
     if cfg.quantiles:
         qkey = jax.random.fold_in(rng_key, 7919)
         outputs.update(
-            quantile_outputs(qrows, min_v, max_v, stds, qkey, cfg))
+            quantile_outputs(qrows, min_v, max_v, stds, qkey, cfg,
+                             secure_tables=secure_tables))
     return outputs, keep, row_count
 
 
@@ -731,10 +757,6 @@ def make_kernel_config(
         # extra chunk costs another pass over the row stream.
         n_leaves = branching**tree_height
         quantile_chunk = max(1, min(n_partitions, (1 << 25) // n_leaves))
-    if secure and quantiles:
-        raise NotImplementedError(
-            "Secure discrete noise does not yet cover the percentile tree "
-            "path; drop PERCENTILE metrics or disable secure_noise.")
     return KernelConfig(
         n_partitions=n_partitions,
         linf=params.max_contributions_per_partition or 0,

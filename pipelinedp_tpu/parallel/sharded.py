@@ -86,7 +86,8 @@ def _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
             qkey = jax.random.fold_in(key_r, 7919)
             outputs.update(
                 executor.quantile_outputs(qrows, min_v, max_v, stds_r, qkey,
-                                          cfg, psum_axis=SHARD_AXIS))
+                                          cfg, psum_axis=SHARD_AXIS,
+                                          secure_tables=tables_r))
         return outputs, keep, row_count
 
     fn = jax.shard_map(per_shard,

@@ -243,8 +243,42 @@ class TestPerPartitionAnalyzer:
         analyzer = _make_analyzer()
         analyzer.resolve_mechanisms()
         clone = pickle.loads(pickle.dumps(analyzer))
-        flat = clone.analyze_rows([(1, 2.0, 1, 1)])
+        flat = clone.compute(clone.create_accumulator((1, 2.0, 1, 1)))
         assert flat[2].sum == pytest.approx(1.0)  # COUNT raw
+
+    def test_accumulator_switches_to_dense(self):
+        analyzer = _make_analyzer()
+        cap = per_partition_combiners.SPARSE_CAP
+        acc = analyzer.create_accumulator((1, 0.0, 1, 1))
+        for _ in range(cap + 10):
+            acc = analyzer.merge_accumulators(
+                acc, analyzer.create_accumulator((1, 0.0, 1, 1)))
+        assert acc[0] == "d"  # bounded: O(K) memory, not O(rows)
+        flat = analyzer.compute(acc)
+        assert flat[0].privacy_id_count == cap + 11
+        assert flat[2].sum == pytest.approx(cap + 11)
+
+    def test_accumulator_matches_full_row_analysis(self):
+        # Incremental merge (crossing the sparse->dense switch) must agree
+        # with analyzing the complete row list at once.
+        analyzer = _make_analyzer()
+        rng = np.random.default_rng(3)
+        rows = [(int(c), float(s), int(n), int(c))
+                for c, s, n in zip(rng.integers(1, 5, 150),
+                                   rng.random(150) * 4,
+                                   rng.integers(1, 9, 150))]
+        acc = analyzer.create_accumulator(rows[0])
+        for row in rows[1:]:
+            acc = analyzer.merge_accumulators(
+                acc, analyzer.create_accumulator(row))
+        merged = analyzer.compute(acc)
+        direct = analyzer.analyze_rows(list(rows))
+        assert merged[0] == direct[0]
+        assert merged[1] == pytest.approx(direct[1], abs=1e-9)  # keep prob
+        for a, b in zip(merged[2:], direct[2:]):
+            assert a.sum == pytest.approx(b.sum)
+            assert a.expected_l0_bounding_error == pytest.approx(
+                b.expected_l0_bounding_error)
 
 
 class TestCrossPartitionAggregator:
@@ -343,6 +377,7 @@ class TestDenseDistributedParity:
         public = ["pk0", "pk1", "pk2", "pk_missing"]
         dense_reports, dense_pp = analysis.perform_utility_analysis(
             DATA, BACKEND, options, EXTRACTORS, public_partitions=public)
+        dense_pp = list(dense_pp)
         dist_reports, dist_pp = _run_distributed(DATA, options, EXTRACTORS,
                                                  public)
         dense_reports = sorted(dense_reports,

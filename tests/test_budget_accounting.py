@@ -6,6 +6,7 @@ proportions, scope normalization, restriction enforcement, PLD binary search.
 
 import math
 
+import numpy as np
 import pytest
 
 import pipelinedp_tpu as pdp
@@ -258,6 +259,87 @@ class TestPldGoldenValues:
         pld = pldlib.from_gaussian_mechanism(1.0)
         assert pld.get_delta_for_epsilon(1.0) == pytest.approx(0.12693674,
                                                                rel=1e-3)
+
+
+class TestPldIndependentCrossChecks:
+    """Cross-validation against implementations NOT sharing code with the
+    production PLD pipeline.
+
+    Google's dp_accounting (the reference's library,
+    /root/reference/pipeline_dp/budget_accounting.py:579-619) cannot be
+    installed in this environment (no package index access), so its golden
+    outputs cannot be generated here. These checks substitute two fully
+    independent derivations:
+
+      * An RDP (Renyi) accountant bound for composed Gaussians — a different
+        accounting formalism entirely. PLD is exact, RDP is an upper bound,
+        so eps_PLD <= eps_RDP must hold (and eps_PLD >= the Balle-Wang exact
+        value, asserted in TestPldGoldenValues).
+      * A from-scratch dense-convolution PLD for composed Laplace mechanisms
+        written in ~20 lines of numpy here in the test: the exact loss
+        distribution (two atoms + interior density) discretized with ceil
+        rounding and composed with np.convolve — no FFT, no shared
+        discretization code with accounting/pld.py.
+    """
+
+    @pytest.mark.parametrize("sigma,k,delta", [(1.0, 1, 1e-5), (2.0, 4, 1e-6),
+                                               (1.0, 16, 1e-5),
+                                               (3.0, 30, 1e-5)])
+    def test_gaussian_below_rdp_bound(self, sigma, k, delta):
+        pld = pldlib.from_gaussian_mechanism(sigma)
+        if k > 1:
+            pld = pld.self_compose(k)
+        eps_pld = pld.get_epsilon_for_delta(delta)
+        # RDP of k Gaussians: rdp(alpha) = k * alpha / (2 sigma^2); convert
+        # with the improved bound (Balle et al. 2020):
+        #   eps = min_a rdp(a) + log1p(-1/a) - log(delta * a) / (a - 1).
+        alphas = np.linspace(1.0 + 1e-3, 200.0, 20000)
+        rdp = k * alphas / (2.0 * sigma**2)
+        eps_rdp = np.min(rdp + np.log1p(-1.0 / alphas) -
+                         (np.log(delta) + np.log(alphas)) / (alphas - 1.0))
+        assert eps_pld <= eps_rdp + 1e-3
+
+    @staticmethod
+    def _laplace_loss_pmf(b: float, grid: float):
+        """Pessimistically discretized privacy-loss PMF of Laplace(b),
+        sensitivity 1: atoms at +-1/b, interior density e^{-(1-bl)/(2b)}/4."""
+        n_bins = int(np.ceil(1.0 / (b * grid)))
+        losses = (np.arange(-n_bins, n_bins + 1)) * grid
+        pmf = np.zeros_like(losses)
+        # Interior mass of bin (l-grid, l] assigned to its UPPER edge (ceil
+        # rounding = pessimistic, losses only rounded up).
+        edges = np.clip(losses, -1.0 / b, 1.0 / b)
+        cdf = lambda l: 0.5 * (np.exp((b * l - 1.0) / (2.0 * b)) - np.exp(
+            -1.0 / b))  # integral of interior density from -1/b to l
+        pmf[1:] = cdf(edges[1:]) - cdf(edges[:-1])
+        pmf[-1] += 0.5  # atom at +1/b: P(x < 0)
+        pmf[0] += np.exp(-1.0 / b) / 2.0  # atom at -1/b: P(x > 1)
+        return losses, pmf
+
+    @pytest.mark.parametrize("b,k,delta", [(1.0, 4, 1e-5), (0.8, 3, 1e-4),
+                                           (2.0, 6, 1e-6)])
+    def test_laplace_matches_dense_convolution(self, b, k, delta):
+        grid = 1e-4
+        losses, pmf = self._laplace_loss_pmf(b, grid)
+        composed = pmf
+        for _ in range(k - 1):
+            composed = np.convolve(composed, pmf)
+        n = (len(losses) - 1) // 2
+        composed_losses = np.arange(-k * n, k * n + 1) * grid
+        # Hockey-stick divergence at eps from the composed PMF.
+        eps_grid = np.linspace(0.0, k / b, 4000)
+        deltas = np.array([
+            np.sum(
+                np.where(composed_losses > e,
+                         composed * -np.expm1(e - composed_losses), 0.0))
+            for e in eps_grid
+        ])
+        eps_ref = float(np.interp(-delta, -deltas, eps_grid))
+        eps_pld = pldlib.from_laplace_mechanism(b).self_compose(
+            k).get_epsilon_for_delta(delta)
+        # Both are pessimistic discretizations of the same exact object on
+        # unrelated grids; they must agree to grid resolution.
+        assert eps_pld == pytest.approx(eps_ref, rel=2e-3, abs=2e-3)
 
 
 class TestPLDBudgetAccountant:
