@@ -174,6 +174,73 @@ def _bench_eps_sweep(jax, jnp, on_tpu):
     }
 
 
+def _bench_large_p(jax, on_tpu):
+    """10^7-partition aggregation in bounded memory via the blocked
+    partition-axis path (parallel/large_p.py)."""
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import combiners, executor
+    from pipelinedp_tpu.aggregate_params import MechanismType
+    from pipelinedp_tpu.ops import selection_ops
+    from pipelinedp_tpu.parallel import large_p
+
+    P = 10_000_000
+    n = 2**22 if on_tpu else 2**18
+    params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+                                 noise_kind=pdp.NoiseKind.LAPLACE,
+                                 max_partitions_contributed=4,
+                                 max_contributions_per_partition=8,
+                                 min_value=0.0,
+                                 max_value=5.0)
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                           total_delta=1e-6)
+    compound = combiners.create_compound_combiner(params, accountant)
+    budget = accountant.request_budget(MechanismType.GENERIC)
+    accountant.compute_budgets()
+    selection = selection_ops.selection_params_from_host(
+        params.partition_selection_strategy, budget.eps, budget.delta,
+        params.max_partitions_contributed, None)
+    cfg = executor.make_kernel_config(params, compound, P,
+                                      private_selection=True,
+                                      selection_params=selection)
+    stds = executor.compute_noise_stds(compound, params)
+    min_v, max_v, min_s, max_s, mid = executor.kernel_scalars(params)
+
+    rng = np.random.default_rng(5)
+    pid = rng.integers(0, 1_000_000, n).astype(np.int32)
+    # Partition popularity: heavy head + tail across the full 10^7 space.
+    u = rng.random(n)
+    pk = (np.power(u, 6.0) * P).astype(np.int32)
+    values = rng.uniform(0, 5, n)
+    valid = np.ones(n, dtype=bool)
+
+    def run(key_seed):
+        return large_p.aggregate_blocked(pid,
+                                         pk,
+                                         values,
+                                         valid,
+                                         min_v,
+                                         max_v,
+                                         min_s,
+                                         max_s,
+                                         mid,
+                                         np.asarray(stds),
+                                         jax.random.PRNGKey(key_seed),
+                                         cfg,
+                                         block_partitions=1 << 20)
+
+    run(8)  # warm the jit caches (bounded-rows + block kernels)
+    start = time.perf_counter()
+    kept, _ = run(9)
+    elapsed = time.perf_counter() - start
+    return {
+        "large_p_partitions": P,
+        "large_p_rows": n,
+        "large_p_sec": round(elapsed, 3),
+        "large_p_rows_per_sec": round(n / elapsed),
+        "large_p_kept": int(len(kept)),
+    }
+
+
 def _bench_ingest():
     """Host ingest throughput: raw key columns -> vocab-encoded int arrays
     (columnar.encode_columns, the 1B-row bottleneck flagged in round 2)."""
@@ -296,6 +363,9 @@ def main():
     # --- Host ingest: vectorized vocab factorization (columnar.encode). ---
     ingest_detail = _bench_ingest()
 
+    # --- 10^7-partition blocked aggregation (bounded memory). ---
+    large_p_detail = _bench_large_p(jax, on_tpu)
+
     # Noise-distribution fidelity: KS statistic of 1M device noise draws
     # vs the CPU reference distribution at the same calibrated stddev
     # (BASELINE.json metric "noise-dist KS-stat vs CPU ref").
@@ -328,6 +398,7 @@ def main():
                 "noise_ks_stat_vs_cpu_ref": round(ks, 5),
                 **sweep_detail,
                 **ingest_detail,
+                **large_p_detail,
                 **({"device_fallback": fallback} if fallback else {}),
             },
         }))

@@ -1,0 +1,15 @@
+"""Example scripts.
+
+The environment may pre-register an external TPU platform plugin via
+sitecustomize, which overrides the JAX_PLATFORMS environment variable.
+Honor the variable programmatically (the same reset tests/conftest.py does)
+so `JAX_PLATFORMS=cpu python examples/...` runs CPU-only even when the
+accelerator plugin is present but unreachable.
+"""
+
+import os
+
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])

@@ -1,0 +1,84 @@
+"""Netflix-prize file format IO (the reference's movie-view dataset shape).
+
+File format (reference examples/movie_view_ratings/common_utils.py:33-60):
+
+    <movie_id>:
+    <user_id>,<rating>,<date>
+    <user_id>,<rating>,<date>
+    <next_movie_id>:
+    ...
+
+Parsing is vectorized: the whole file is split into a string array, header
+lines are detected in one pass, and each data line picks up its movie id by
+a cumulative-header index — no per-line Python loop, feeding straight into
+the columnar ingest path (pipelinedp_tpu.columnar.encode_columns).
+"""
+
+from dataclasses import dataclass
+from typing import Tuple
+
+import numpy as np
+
+
+@dataclass
+class MovieView:
+    user_id: int
+    movie_id: int
+    rating: int
+
+
+def parse_file_columns(
+        filename: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Parses a Netflix-format file into (user_ids, movie_ids, ratings)."""
+    with open(filename) as f:
+        lines = np.array(f.read().split("\n"))
+    lines = lines[np.char.str_len(lines) > 0]
+    is_header = np.char.endswith(lines, ":")
+    movie_ids = np.char.rstrip(lines[is_header], ":").astype(np.int64)
+    if len(movie_ids) == 0:
+        raise ValueError(f"{filename}: no 'movie_id:' header lines found")
+    # Each data line belongs to the most recent header above it.
+    movie_of_line = np.cumsum(is_header) - 1
+    data_lines = lines[~is_header]
+    movie_col = movie_ids[movie_of_line[~is_header]]
+    # "user_id,rating,date" -> first two comma-separated fields.
+    first = np.char.partition(data_lines, ",")
+    users = first[:, 0].astype(np.int64)
+    ratings = np.char.partition(first[:, 2], ",")[:, 0].astype(np.int64)
+    return users, movie_col, ratings
+
+
+def parse_file(filename: str):
+    """Parses a Netflix-format file into MovieView rows (reference API)."""
+    users, movies, ratings = parse_file_columns(filename)
+    return [
+        MovieView(int(u), int(m), int(r))
+        for u, m, r in zip(users, movies, ratings)
+    ]
+
+
+def generate_file(filename: str,
+                  n_rows: int,
+                  n_users: int = 1000,
+                  n_movies: int = 99,
+                  seed: int = 0) -> None:
+    """Writes a synthetic dataset in the Netflix file format."""
+    rng = np.random.default_rng(seed)
+    # Zipf-ish movie popularity, uniform users, ratings skewed high.
+    movies = (np.power(rng.random(n_rows), 2.5) * n_movies).astype(int) + 1
+    users = rng.integers(0, n_users, n_rows)
+    ratings = rng.choice([1, 2, 3, 4, 5], n_rows,
+                         p=[0.05, 0.1, 0.2, 0.35, 0.3])
+    order = np.argsort(movies, kind="stable")
+    with open(filename, "w") as f:
+        last_movie = None
+        for i in order:
+            if movies[i] != last_movie:
+                f.write(f"{movies[i]}:\n")
+                last_movie = movies[i]
+            f.write(f"{users[i]},{ratings[i]},2023-01-01\n")
+
+
+def write_to_file(col, filename: str) -> None:
+    with open(filename, "w") as out:
+        out.write("\n".join(sorted(map(str, col))))

@@ -289,28 +289,24 @@ def _sort_rows(keys, payloads):
     return out[:len(keys)], out[len(keys):]
 
 
-def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
-                    valid: jnp.ndarray, min_v, max_v, min_s, max_s, mid,
-                    rows_key: jax.Array, cfg: KernelConfig):
-    """Phase 1: contribution bounding + per-partition partial columns.
+def bounded_row_columns(pid: jnp.ndarray, pk: jnp.ndarray,
+                        values: jnp.ndarray, valid: jnp.ndarray, min_v, max_v,
+                        min_s, max_s, mid, rows_key: jax.Array,
+                        cfg: KernelConfig):
+    """Phase 1a: contribution bounding -> per-row reduction columns.
 
-    Runs per shard on the multi-chip path (each privacy unit's rows must be
-    co-located on one shard). Returns (cols, qrows): a dict of f[P] dense
-    columns (count / sum / nsum / nsum2 / pid_count / row_count) plus, in
-    percentile mode, the bounded row stream (pk, tree_leaf, keep) feeding the
-    per-partition quantile histograms (None otherwise).
+    Returns (spk, keep_row, pair_start, reduce_cols, qrows): the bounded row
+    stream in (pid, pair-hash) sort order. Independent of the partition-axis
+    size except as an invalid-row sentinel — this is the seam the blocked
+    large-partition-space path (parallel/large_p.py) splits at, resuming the
+    reduction per partition block.
 
-    TPU-shaped plan (scatter/gather-free hot path):
-      1. ONE payload-carrying sort by (pid, pair_hash, pk, row_rand). Pairs
-         are then contiguous, ordered within each pid by a salted uniform
-         hash — so cross-partition (L0) bounding is just "pair rank < l0",
-         computed with scans; Linf bounding is "row rank < linf" within the
-         pair. No pair slots are materialized, no scatter-back.
-      2. ONE payload-carrying sort by kept-partition id, then per-partition
-         reductions as cumsum differences at searchsorted boundaries —
-         counts are exact integers, float sums use a chunked cumsum to
-         bound f32 rounding bias.
-    The reference's three shuffles (SURVEY.md §3.1) cost two sorts total.
+    TPU-shaped plan (scatter/gather-free hot path): ONE payload-carrying
+    sort by (pid, pair_hash, pk, row_rand). Pairs are then contiguous,
+    ordered within each pid by a salted uniform hash — so cross-partition
+    (L0) bounding is just "pair rank < l0", computed with scans; Linf
+    bounding is "row rank < linf" within the pair. No pair slots are
+    materialized, no scatter-back.
     """
     f = _ftype()
     n = pid.shape[0]
@@ -413,8 +409,23 @@ def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
             reduce_cols['nsum'] = ncontrib
             if need_nsum2:
                 reduce_cols['nsum2'] = ncontrib * ncontrib
+    return spk, keep_row, pair_start, reduce_cols, qrows
 
-    # --- Partition reduction: sort by kept-pk, cumsum-diff at boundaries. --
+
+def reduce_rows_to_partitions(spk, keep_row, pair_start, reduce_cols,
+                              n_partitions: int, vector_size: int):
+    """Phase 1b: dense [0, n_partitions) partition columns from the bounded
+    row stream.
+
+    ONE payload-carrying sort by kept-partition id, then per-partition
+    reductions as cumsum differences at searchsorted boundaries — counts are
+    exact integers, float sums use a chunked cumsum to bound f32 rounding
+    bias. Together with the bounding sort, the reference's three shuffles
+    (SURVEY.md §3.1) cost two sorts total.
+    """
+    f = _ftype()
+    i32 = jnp.int32
+    P = n_partitions
     key2 = jnp.where(keep_row, spk, P).astype(i32)
     names = list(reduce_cols)
     (spk2,), pay2 = _sort_rows([key2],
@@ -435,11 +446,30 @@ def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
                 pid_count=part_pid_count,
                 row_count=part_pid_count)
     reduced = {m: seg_reduce(pay2[1 + j]) for j, m in enumerate(names)}
-    if vector:
+    if vector_size:
         cols['vsum'] = jnp.stack(
-            [reduced['v%d' % d] for d in range(cfg.vector_size)], axis=1)
+            [reduced['v%d' % d] for d in range(vector_size)], axis=1)
     else:
         cols.update(reduced)
+    return cols
+
+
+def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
+                    valid: jnp.ndarray, min_v, max_v, min_s, max_s, mid,
+                    rows_key: jax.Array, cfg: KernelConfig):
+    """Phase 1: contribution bounding + per-partition partial columns.
+
+    Runs per shard on the multi-chip path (each privacy unit's rows must be
+    co-located on one shard). Returns (cols, qrows): a dict of f[P] dense
+    columns (count / sum / nsum / nsum2 / pid_count / row_count) plus, in
+    percentile mode, the bounded row stream (pk, tree_leaf, keep) feeding
+    the per-partition quantile histograms (None otherwise).
+    """
+    spk, keep_row, pair_start, reduce_cols, qrows = bounded_row_columns(
+        pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, rows_key,
+        cfg)
+    cols = reduce_rows_to_partitions(spk, keep_row, pair_start, reduce_cols,
+                                     cfg.n_partitions, cfg.vector_size)
     return cols, qrows
 
 

@@ -1,0 +1,210 @@
+"""Very large partition spaces: blocked partition-axis execution.
+
+The dense fused kernel materializes [0, P) columns — ideal up to P ~ 10^6,
+but at P = 10^7..10^9 (the reference's unbounded-key shuffle regime,
+``pipeline_dp/pipeline_backend.py:339-352``) a replicated dense partition
+axis no longer fits. This module shards the PARTITION axis instead:
+
+  1. **Bound once** (device, chunked over rows): contribution bounding is a
+     row-space computation (executor.bounded_row_columns) independent of P.
+     Row chunks split on privacy-id boundaries so every id's pairs stay in
+     one chunk — the same co-location invariant the pid-sharded multi-chip
+     path uses.
+  2. **Bin by partition block** (host, vectorized argsort): bounded rows are
+     ordered by partition id; block b owns partitions [b*C, (b+1)*C).
+  3. **Finalize per block** (device): each block segment-sums its own rows
+     into a dense [C] slice and runs DP selection + noise on just that slice
+     (selection and noise are pointwise over partitions, so blocks are
+     independent — no collective, no rescans: total work is O(n log n + P)).
+  4. **Compact**: only kept partitions are emitted, so output size is
+     O(kept), not O(P).
+
+Peak device memory is O(row_chunk + C) regardless of P.
+"""
+
+import dataclasses
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipelinedp_tpu import executor
+
+
+def round_capacity(x: int, min_cap: int = 8) -> int:
+    """Round up keeping 4 significant bits (<= 1/16 ~ 6.25% slack, 12.5%
+    worst-case just above a power of two).
+
+    Bounds the number of distinct padded shapes (so the jit cache stays
+    small) without the up-to-2x waste of next-power-of-two padding.
+    """
+    x = max(int(x), min_cap)
+    step = 1 << max((x - 1).bit_length() - 4, 3)
+    return -(-x // step) * step
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _bounded_rows_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s,
+                         mid, key, cfg: executor.KernelConfig):
+    spk, keep_row, pair_start, reduce_cols, _ = executor.bounded_row_columns(
+        pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, key, cfg)
+    return spk, keep_row, pair_start, reduce_cols
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _block_kernel(spk_rel, keep_row, pair_start, reduce_cols, min_v, mid,
+                  stds, key, cfg: executor.KernelConfig, secure_tables=None):
+    cols = executor.reduce_rows_to_partitions(spk_rel, keep_row, pair_start,
+                                              reduce_cols, cfg.n_partitions,
+                                              cfg.vector_size)
+    return executor.finalize(cols, min_v, mid, stds, key, cfg, secure_tables)
+
+
+def _chunk_ends(pid_sorted: np.ndarray, row_chunk: int) -> np.ndarray:
+    """Chunk end offsets, each extended to the next privacy-id boundary.
+
+    A privacy id's rows must stay in one chunk (L0 bounding is global per
+    id), so a single id with more rows than row_chunk forces an oversized
+    chunk — the one irreducible violation of the O(row_chunk) memory bound;
+    it is logged so the operator knows which workload property caused it.
+    """
+    import logging
+    n = len(pid_sorted)
+    ends = []
+    start = 0
+    while start < n:
+        end = min(start + row_chunk, n)
+        if end < n:
+            end = int(
+                np.searchsorted(pid_sorted, pid_sorted[end - 1],
+                                side="right"))
+        if end - start > 2 * row_chunk:
+            logging.warning(
+                "large_p: a single privacy id spans %d rows (> 2x row_chunk="
+                "%d); its chunk cannot be split without breaking per-id "
+                "contribution bounding. Device memory for this chunk scales "
+                "with that id's row count.", end - start, row_chunk)
+        ends.append(end)
+        start = end
+    return np.asarray(ends)
+
+
+def aggregate_blocked(pid,
+                      pk,
+                      values,
+                      valid,
+                      min_v,
+                      max_v,
+                      min_s,
+                      max_s,
+                      mid,
+                      stds,
+                      rng_key,
+                      cfg: executor.KernelConfig,
+                      *,
+                      block_partitions: int = 1 << 20,
+                      row_chunk: int = 1 << 24,
+                      secure_tables=None
+                      ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+    """DP aggregation over an arbitrarily large partition space.
+
+    Same semantics as executor.aggregate_kernel (minus percentiles), but the
+    partition axis is processed in blocks of `block_partitions` and only
+    kept partitions are returned.
+
+    Returns (kept_partition_ids int64[M], {metric: f[M]}).
+    """
+    if cfg.quantiles:
+        raise NotImplementedError(
+            "PERCENTILE is not supported on the blocked large-partition "
+            "path; use the dense kernel (quantile trees already chunk the "
+            "partition axis internally).")
+    P = cfg.n_partitions
+    pid = np.asarray(pid)
+    pk = np.asarray(pk)
+    values = np.asarray(values)
+    valid = np.asarray(valid)
+
+    rows_key, final_key = jax.random.split(rng_key, 2)
+
+    # --- Pass 1: bound rows, chunked on privacy-id boundaries. ------------
+    order = np.argsort(pid, kind="stable")
+    pid_s, pk_s, values_s, valid_s = (pid[order], pk[order], values[order],
+                                      valid[order])
+    b_pk, b_pair, b_cols = [], [], None
+    start = 0
+    for ci, end in enumerate(_chunk_ends(pid_s, row_chunk)):
+        sl = slice(start, end)
+        cap = round_capacity(end - start)
+        pad = cap - (end - start)
+
+        def padded(a, fill=0):
+            widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+            return np.pad(a[sl], widths, constant_values=fill)
+
+        spk, keep, pair, cols = _bounded_rows_kernel(
+            padded(pid_s), padded(pk_s), padded(values_s),
+            padded(valid_s, False), min_v, max_v, min_s, max_s, mid,
+            jax.random.fold_in(rows_key, ci), cfg)
+        keep = np.asarray(keep)
+        b_pk.append(np.asarray(spk)[keep])
+        b_pair.append(np.asarray(pair)[keep])
+        cols = {name: np.asarray(col)[keep] for name, col in cols.items()}
+        if b_cols is None:
+            b_cols = {name: [col] for name, col in cols.items()}
+        else:
+            for name, col in cols.items():
+                b_cols[name].append(col)
+        start = end
+
+    spk_all = np.concatenate(b_pk) if b_pk else np.zeros(0, np.int32)
+    pair_all = np.concatenate(b_pair) if b_pair else np.zeros(0, bool)
+    cols_all = {
+        name: np.concatenate(chunks)
+        for name, chunks in (b_cols or {}).items()
+    }
+
+    # --- Pass 2: bin by partition block, finalize each block. -------------
+    order2 = np.argsort(spk_all, kind="stable")
+    spk_all = spk_all[order2]
+    pair_all = pair_all[order2]
+    cols_all = {name: col[order2] for name, col in cols_all.items()}
+
+    C = min(block_partitions, P)
+    n_blocks = -(-P // C)
+    block_starts = np.searchsorted(spk_all,
+                                   np.arange(n_blocks + 1) * C,
+                                   side="left")
+    kept_ids, kept_outputs = [], {}
+    for b in range(n_blocks):
+        lo, hi = int(block_starts[b]), int(block_starts[b + 1])
+        c_actual = min(C, P - b * C)
+        cfg_block = dataclasses.replace(cfg, n_partitions=c_actual)
+        cap = round_capacity(hi - lo)
+        pad = cap - (hi - lo)
+
+        def padded(a, fill):
+            widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
+            return np.pad(a, widths, constant_values=fill)
+
+        spk_rel = (spk_all[lo:hi].astype(np.int64) - b * C).astype(np.int32)
+        outputs, keep, _ = _block_kernel(
+            padded(spk_rel, c_actual),
+            padded(np.ones(hi - lo, bool), False),
+            padded(pair_all[lo:hi], False),
+            {name: padded(col[lo:hi], 0) for name, col in cols_all.items()},
+            min_v, mid, jnp.asarray(stds), jax.random.fold_in(final_key, b),
+            cfg_block, secure_tables)
+        keep = np.asarray(keep)
+        idx = np.nonzero(keep)[0]
+        kept_ids.append(idx.astype(np.int64) + b * C)
+        for name, col in outputs.items():
+            kept_outputs.setdefault(name, []).append(np.asarray(col)[idx])
+
+    kept = (np.concatenate(kept_ids) if kept_ids else np.zeros(0, np.int64))
+    return kept, {
+        name: np.concatenate(chunks)
+        for name, chunks in kept_outputs.items()
+    }

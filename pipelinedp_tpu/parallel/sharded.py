@@ -3,9 +3,10 @@
 Strategy (SURVEY.md §2.5 "TPU-native equivalent"): the reference's three
 keyed shuffles become zero cross-device shuffles —
 
-  1. Rows are sharded by privacy-unit id (pid % n_shards) at ingest, so all
-     of a privacy unit's rows live on one shard and contribution bounding
-     (the by-pid "shuffle") is shard-local.
+  1. Rows are sharded by privacy-unit id at ingest (load-balanced: heavy
+     ids greedy-LPT, tail serpentine — see shard_rows_by_pid), so all of a
+     privacy unit's rows live on one shard and contribution bounding (the
+     by-pid "shuffle") is shard-local.
   2. Each shard computes dense per-partition partial columns
      (executor.partial_columns) — the by-partition "shuffle" is a local
      segment-sum into the dense [0, P) layout.
@@ -32,20 +33,45 @@ from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
 
 def shard_rows_by_pid(pid: np.ndarray, pk: np.ndarray, values: np.ndarray,
                       valid: np.ndarray, n_shards: int):
-    """Reorders + pads rows so shard s holds exactly the rows with
-    pid % n_shards == s, all shards equal-sized.
+    """Reorders + pads rows so each privacy id's rows land on exactly one
+    shard, with shards load-balanced by ROW COUNT, all shards equal-sized.
+
+    Assignment is two-phase load balancing: the heaviest few thousand ids go
+    greedy-LPT (each to the least-loaded shard, catching hot-id skew), and
+    the long tail — whose counts are near-uniform — is laid out serpentine
+    over the shards in one vectorized pass, so the host cost stays O(U)
+    numpy, not O(U) Python, at hundreds of millions of unique ids. Per-shard
+    capacity is rounded up keeping 4 significant bits (<= 12.5% slack —
+    bounded jit-cache shapes without power-of-two's up-to-2x waste).
 
     Returns arrays of length n_shards * rows_per_shard whose s-th block is
     shard s's rows (invalid-padded) — the layout shard_map expects for a
     leading-axis split.
     """
-    shard = pid.astype(np.int64) % n_shards
+    import heapq
+    from pipelinedp_tpu.parallel.large_p import round_capacity
+    _, inverse, ucounts = np.unique(pid, return_inverse=True,
+                                    return_counts=True)
+    heavy_first = np.argsort(-ucounts, kind="stable")
+    shard_of_uid = np.empty(len(ucounts), dtype=np.int64)
+    n_greedy = min(len(ucounts), max(n_shards * 64, 4096))
+    heap = [(0, s) for s in range(n_shards)]
+    for uid in heavy_first[:n_greedy]:
+        load, s = heapq.heappop(heap)
+        shard_of_uid[uid] = s
+        heapq.heappush(heap, (load + int(ucounts[uid]), s))
+    tail = heavy_first[n_greedy:]
+    if len(tail):
+        # Serpentine over shards ordered lightest-first after phase 1.
+        shard_order = np.array([s for _, s in sorted(heap)], dtype=np.int64)
+        rank = np.arange(len(tail))
+        block, offset = divmod(rank, n_shards)
+        pos = np.where(block % 2 == 0, offset, n_shards - 1 - offset)
+        shard_of_uid[tail] = shard_order[pos]
+    shard = shard_of_uid[inverse]
     order = np.argsort(shard, kind="stable")
     counts = np.bincount(shard, minlength=n_shards)
-    # Round the per-shard length up to a power of two: shapes stay stable
-    # across datasets of similar size, so the jit cache hits instead of
-    # recompiling the whole fused program per aggregation.
-    per_shard = max(8, 1 << int(int(counts.max()) - 1).bit_length())
+    per_shard = round_capacity(int(counts.max()))
     n_out = n_shards * per_shard
 
     out_pid = np.zeros(n_out, dtype=pid.dtype)

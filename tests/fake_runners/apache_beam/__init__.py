@@ -1,0 +1,299 @@
+"""Minimal eager in-memory Apache Beam fake (the fake-runner harness).
+
+apache_beam cannot be installed in this environment, so this package
+implements just enough of its public API — transforms, pipelines, labels,
+side inputs, combiners — for pipelinedp_tpu's BeamBackend and private_beam
+adapters to EXECUTE end-to-end rather than importorskip. Semantics mirrored
+deliberately:
+
+  * label uniqueness is enforced per pipeline (duplicate labels raise, the
+    failure mode UniqueLabelsGenerator exists to prevent);
+  * every transform is applied through Pipeline.apply via `|` / `>>`
+    plumbing, exactly as the adapters compose them;
+  * CoGroupByKey produces (key, {tag: [values]}), CombinePerKey takes a
+    callable over the iterable of values, side inputs arrive as extra args.
+
+Execution is eager over Python lists — a DirectRunner without the runner.
+"""
+
+import random as _random
+
+from apache_beam import pvalue
+from apache_beam.pvalue import PCollection
+from apache_beam.transforms.ptransform import PTransform
+
+
+class _PipelineResult:
+
+    def wait_until_finish(self):
+        return "DONE"
+
+
+class Pipeline:
+
+    def __init__(self, *args, **kwargs):
+        self._labels = set()
+
+    def apply(self, transform, pvalueish):
+        if not isinstance(transform, PTransform):
+            raise TypeError(f"Expected a PTransform object, got {transform}")
+        label = transform.label
+        if label in self._labels:
+            raise RuntimeError(
+                f"A transform with label {label!r} already exists in the "
+                "pipeline. To apply a transform with a specified label, use "
+                "the label >> transform syntax.")
+        self._labels.add(label)
+        return transform.expand(pvalueish)
+
+    def __or__(self, transform):
+        return self.apply(transform, self)
+
+    def run(self):
+        return _PipelineResult()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is None:
+            self.run().wait_until_finish()
+
+
+def _data(pcoll):
+    return list(pcoll._data)
+
+
+def _resolve_sides(sides):
+    return [s.resolve() if isinstance(s, pvalue.AsList) else s for s in sides]
+
+
+def _out(pvalueish, data):
+    if isinstance(pvalueish, Pipeline):
+        return PCollection(pvalueish, data)
+    return PCollection(pvalueish.pipeline, data)
+
+
+class Create(PTransform):
+
+    def __init__(self, values):
+        super().__init__()
+        self._values = list(values)
+
+    def expand(self, pipeline):
+        return PCollection(pipeline, list(self._values))
+
+
+class Map(PTransform):
+
+    def __init__(self, fn, *sides):
+        super().__init__()
+        self._fn, self._sides = fn, sides
+
+    def expand(self, pcoll):
+        return _out(pcoll, lambda: [
+            self._fn(x, *_resolve_sides(self._sides)) for x in _data(pcoll)
+        ])
+
+
+class MapTuple(PTransform):
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def expand(self, pcoll):
+        return _out(pcoll, lambda: [self._fn(*x) for x in _data(pcoll)])
+
+
+class FlatMap(PTransform):
+
+    def __init__(self, fn, *sides):
+        super().__init__()
+        self._fn, self._sides = fn, sides
+
+    def expand(self, pcoll):
+
+        def thunk():
+            sides = _resolve_sides(self._sides)
+            out = []
+            for x in _data(pcoll):
+                out.extend(self._fn(x, *sides))
+            return out
+
+        return _out(pcoll, thunk)
+
+
+class Filter(PTransform):
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def expand(self, pcoll):
+        return _out(pcoll,
+                    lambda: [x for x in _data(pcoll) if self._fn(x)])
+
+
+class GroupByKey(PTransform):
+
+    def expand(self, pcoll):
+
+        def thunk():
+            grouped = {}
+            for k, v in _data(pcoll):
+                grouped.setdefault(k, []).append(v)
+            return list(grouped.items())
+
+        return _out(pcoll, thunk)
+
+
+class Keys(PTransform):
+
+    def expand(self, pcoll):
+        return _out(pcoll, lambda: [k for k, _ in _data(pcoll)])
+
+
+class Values(PTransform):
+
+    def expand(self, pcoll):
+        return _out(pcoll, lambda: [v for _, v in _data(pcoll)])
+
+
+class Distinct(PTransform):
+
+    def expand(self, pcoll):
+        return _out(pcoll, lambda: list(dict.fromkeys(_data(pcoll))))
+
+
+class Flatten(PTransform):
+
+    def expand(self, pcolls):
+
+        def thunk():
+            out = []
+            for pcoll in pcolls:
+                out.extend(_data(pcoll))
+            return out
+
+        return PCollection(pcolls[0].pipeline, thunk)
+
+
+class CoGroupByKey(PTransform):
+    """(key, {tag: [values]}) join of a dict of keyed PCollections."""
+
+    def expand(self, tagged):
+
+        def thunk():
+            joined = {}
+            for tag, pcoll in tagged.items():
+                for k, v in _data(pcoll):
+                    joined.setdefault(k,
+                                      {t: [] for t in tagged})[tag].append(v)
+            return list(joined.items())
+
+        pipeline = next(iter(tagged.values())).pipeline
+        return PCollection(pipeline, thunk)
+
+
+class DoFn:
+
+    def process(self, element):
+        raise NotImplementedError
+
+
+class ParDo(PTransform):
+
+    def __init__(self, dofn):
+        super().__init__()
+        self._dofn = dofn
+
+    def expand(self, pcoll):
+
+        def thunk():
+            out = []
+            for x in _data(pcoll):
+                result = self._dofn.process(x)
+                if result is not None:
+                    out.extend(result)
+            return out
+
+        return _out(pcoll, thunk)
+
+
+class CombinePerKey(PTransform):
+    """fn receives the iterable of all values of a key."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def expand(self, pcoll):
+
+        def thunk():
+            grouped = {}
+            for k, v in _data(pcoll):
+                grouped.setdefault(k, []).append(v)
+            return [(k, self._fn(vs)) for k, vs in grouped.items()]
+
+        return _out(pcoll, thunk)
+
+
+class _Sample:
+
+    @staticmethod
+    def FixedSizePerKey(n):
+
+        class _SampleT(PTransform):
+
+            def expand(self, pcoll):
+
+                def thunk():
+                    grouped = {}
+                    for k, v in _data(pcoll):
+                        grouped.setdefault(k, []).append(v)
+                    return [(k, _random.sample(vs, min(n, len(vs))))
+                            for k, vs in grouped.items()]
+
+                return _out(pcoll, thunk)
+
+        return _SampleT()
+
+
+class _Count:
+
+    @staticmethod
+    def PerElement():
+
+        class _CountT(PTransform):
+
+            def expand(self, pcoll):
+
+                def thunk():
+                    counts = {}
+                    for x in _data(pcoll):
+                        counts[x] = counts.get(x, 0) + 1
+                    return list(counts.items())
+
+                return _out(pcoll, thunk)
+
+        return _CountT()
+
+
+def _ToList():
+
+    class _ToListT(PTransform):
+
+        def expand(self, pcoll):
+            return _out(pcoll, lambda: [_data(pcoll)])
+
+    return _ToListT()
+
+
+class _Combiners:
+    Sample = _Sample
+    Count = _Count
+    ToList = staticmethod(_ToList)
+
+
+combiners = _Combiners()

@@ -1,0 +1,38 @@
+"""pvalue module of the in-memory Beam fake."""
+
+
+class PCollection:
+    """Deferred PCollection: a pipeline handle plus a thunk producing the
+    element list, materialized (and cached) on first iteration — mirroring
+    Beam's run-at-pipeline-execution semantics, which the DP engine relies
+    on (noise parameters are only final after compute_budgets())."""
+
+    def __init__(self, pipeline, thunk):
+        self.pipeline = pipeline
+        if not callable(thunk):
+            values = list(thunk)
+            thunk = lambda: values
+        self._thunk = thunk
+        self._materialized = None
+
+    @property
+    def _data(self):
+        if self._materialized is None:
+            self._materialized = list(self._thunk())
+        return self._materialized
+
+    def __or__(self, transform):
+        return self.pipeline.apply(transform, self)
+
+    def __iter__(self):
+        return iter(self._data)
+
+
+class AsList:
+    """Side-input marker: resolved to a list at transform expansion."""
+
+    def __init__(self, pcoll):
+        self.pcoll = pcoll
+
+    def resolve(self):
+        return list(self.pcoll._data)

@@ -1,0 +1,1 @@
+from apache_beam.transforms import ptransform  # noqa: F401

@@ -1,0 +1,33 @@
+"""PTransform base for the in-memory Beam fake (see package __init__)."""
+
+
+class PTransform:
+    """Labeled transform. Mirrors the real API points the adapters touch:
+    `label >> transform` relabeling (__rrshift__), application via `|` from
+    PCollections / dicts / tuples, and expand()."""
+
+    def __init__(self, label=None):
+        self.label = label or type(self).__name__
+
+    def __rrshift__(self, label):
+        self.label = label
+        return self
+
+    def __ror__(self, left):
+        # dict | CoGroupByKey(), tuple | Flatten(): Python falls through to
+        # __ror__ because dict/tuple don't implement | with a PTransform.
+        pipeline = _find_pipeline(left)
+        return pipeline.apply(self, left)
+
+    def expand(self, pvalueish):
+        raise NotImplementedError
+
+
+def _find_pipeline(pvalueish):
+    values = (pvalueish.values()
+              if isinstance(pvalueish, dict) else list(pvalueish))
+    for value in values:
+        pipeline = getattr(value, "pipeline", None)
+        if pipeline is not None:
+            return pipeline
+    raise ValueError("no PCollection (hence no pipeline) in %r" % (pvalueish,))

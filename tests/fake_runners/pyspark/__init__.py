@@ -1,0 +1,161 @@
+"""Minimal eager in-memory PySpark fake (the fake-runner harness).
+
+pyspark cannot be installed in this environment; this module implements the
+RDD/SparkContext surface pipelinedp_tpu's SparkRDDBackend and private_spark
+adapters use, executing eagerly over Python lists — local[1] without the
+JVM. groupByKey values are one-shot iterables (like Spark's ResultIterable
+consumers must list() them), join has inner-join semantics, and union
+concatenates.
+"""
+
+import random as _random
+
+
+class ResultIterable:
+    """Re-iterable group value (mirrors pyspark.resultiterable)."""
+
+    def __init__(self, values):
+        self._values = list(values)
+
+    def __iter__(self):
+        return iter(self._values)
+
+    def __len__(self):
+        return len(self._values)
+
+
+class RDD:
+    """Deferred RDD: transformations build thunks; actions (collect/count)
+    materialize — mirroring Spark's lazy evaluation, which the DP engine
+    relies on (noise parameters are final only after compute_budgets())."""
+
+    def __init__(self, data, ctx):
+        if callable(data):
+            self._thunk = data
+        else:
+            values = list(data)
+            self._thunk = lambda: values
+        self._materialized = None
+        self.ctx = ctx
+
+    @property
+    def _data(self):
+        if self._materialized is None:
+            self._materialized = list(self._thunk())
+        return self._materialized
+
+    @property
+    def context(self):
+        return self.ctx
+
+    def map(self, fn):
+        return RDD(lambda: [fn(x) for x in self._data], self.ctx)
+
+    def flatMap(self, fn):
+
+        def thunk():
+            out = []
+            for x in self._data:
+                out.extend(fn(x))
+            return out
+
+        return RDD(thunk, self.ctx)
+
+    def mapValues(self, fn):
+        return RDD(lambda: [(k, fn(v)) for k, v in self._data], self.ctx)
+
+    def flatMapValues(self, fn):
+
+        def thunk():
+            out = []
+            for k, v in self._data:
+                out.extend((k, w) for w in fn(v))
+            return out
+
+        return RDD(thunk, self.ctx)
+
+    def groupByKey(self):
+
+        def thunk():
+            grouped = {}
+            for k, v in self._data:
+                grouped.setdefault(k, []).append(v)
+            # Spark yields re-iterable ResultIterables, not iterators.
+            return [(k, ResultIterable(vs)) for k, vs in grouped.items()]
+
+        return RDD(thunk, self.ctx)
+
+    def filter(self, fn):
+        return RDD(lambda: [x for x in self._data if fn(x)], self.ctx)
+
+    def join(self, other):
+
+        def thunk():
+            right = {}
+            for k, v in other._data:
+                right.setdefault(k, []).append(v)
+            out = []
+            for k, v in self._data:
+                for w in right.get(k, []):
+                    out.append((k, (v, w)))
+            return out
+
+        return RDD(thunk, self.ctx)
+
+    def keys(self):
+        return RDD(lambda: [k for k, _ in self._data], self.ctx)
+
+    def values(self):
+        return RDD(lambda: [v for _, v in self._data], self.ctx)
+
+    def reduceByKey(self, fn):
+
+        def thunk():
+            grouped = {}
+            for k, v in self._data:
+                grouped[k] = fn(grouped[k], v) if k in grouped else v
+            return list(grouped.items())
+
+        return RDD(thunk, self.ctx)
+
+    def distinct(self):
+        return RDD(lambda: list(dict.fromkeys(self._data)), self.ctx)
+
+    def sample(self, withReplacement, fraction, seed=None):
+
+        def thunk():
+            rng = _random.Random(seed)
+            return [x for x in self._data if rng.random() < fraction]
+
+        return RDD(thunk, self.ctx)
+
+    def collect(self):
+        return list(self._data)
+
+    def count(self):
+        return len(self._data)
+
+    def cache(self):
+        return self
+
+
+class SparkContext:
+
+    def __init__(self, *args, **kwargs):
+        pass
+
+    def parallelize(self, data, numSlices=None):
+        return RDD(data, self)
+
+    def union(self, rdds):
+
+        def thunk():
+            out = []
+            for rdd in rdds:
+                out.extend(rdd._data)
+            return out
+
+        return RDD(thunk, self)
+
+    def stop(self):
+        pass

@@ -1,4 +1,9 @@
-"""Smoke tests: every example must run end to end."""
+"""Smoke tests: every example must run end to end.
+
+Examples run CPU-pinned for determinism; additionally, when a healthy
+accelerator is reachable, the movie-ratings example re-runs on the actual
+device path (fused TPUBackend) with no platform pin.
+"""
 
 import os
 import subprocess
@@ -10,15 +15,55 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 EXAMPLES = [
     ["examples/movie_view_ratings/run_local.py", "--rows", "5000"],
+    [
+        "examples/movie_view_ratings/run_without_frameworks.py",
+        "--generate_rows", "5000", "--local"
+    ],
+    [
+        "examples/movie_view_ratings/run_without_frameworks.py",
+        "--generate_rows", "5000", "--pld_accounting", "--local"
+    ],
     ["examples/restaurant_visits/run_private_api.py", "--rows", "1000"],
     ["examples/restaurant_visits/run_parameter_tuning.py", "--rows", "1000"],
 ]
 
 
-@pytest.mark.parametrize("cmd", EXAMPLES, ids=lambda c: c[0])
+@pytest.mark.parametrize("cmd", EXAMPLES,
+                         ids=lambda c: " ".join([c[0]] + c[3:]))
 def test_example_runs(cmd):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.run([sys.executable] + cmd, cwd=REPO, env=env,
                           capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stderr[-2000:]
     assert proc.stdout.strip(), "example produced no output"
+
+
+def _accelerator_platform():
+    """Probes (in a killable subprocess) for a healthy non-CPU device."""
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            capture_output=True, text=True, timeout=90, env=env)
+    except subprocess.TimeoutExpired:
+        return None
+    if probe.returncode != 0 or not probe.stdout.strip():
+        return None
+    platform = probe.stdout.strip().splitlines()[-1]
+    return platform if platform != "cpu" else None
+
+
+def test_movie_example_on_device():
+    """The real-file-format example on the actual device path (TPU smoke)."""
+    platform = _accelerator_platform()
+    if platform is None:
+        pytest.skip("no healthy accelerator reachable")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    proc = subprocess.run(
+        [sys.executable,
+         "examples/movie_view_ratings/run_without_frameworks.py",
+         "--generate_rows", "20000"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=480)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "computed DP metrics" in proc.stdout

@@ -1,0 +1,196 @@
+"""Tests for the blocked large-partition-space path (parallel/large_p.py)."""
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import combiners, executor
+from pipelinedp_tpu.aggregate_params import MechanismType
+from pipelinedp_tpu.ops import selection_ops
+from pipelinedp_tpu.parallel import large_p
+
+import jax
+
+
+def _spec(n_partitions, private=True, metrics_list=None, l0=4, linf=8,
+          eps=1.0):
+    params = pdp.AggregateParams(
+        metrics=metrics_list or [pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=l0,
+        max_contributions_per_partition=linf,
+        min_value=0.0,
+        max_value=5.0)
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=eps,
+                                           total_delta=1e-6)
+    compound = combiners.create_compound_combiner(params, accountant)
+    selection = None
+    if private:
+        budget = accountant.request_budget(MechanismType.GENERIC)
+    accountant.compute_budgets()
+    if private:
+        selection = selection_ops.selection_params_from_host(
+            params.partition_selection_strategy, budget.eps, budget.delta,
+            params.max_partitions_contributed, None)
+    cfg = executor.make_kernel_config(params, compound, n_partitions,
+                                      private_selection=private,
+                                      selection_params=selection)
+    stds = executor.compute_noise_stds(compound, params)
+    scalars = executor.kernel_scalars(params)
+    return cfg, stds, scalars
+
+
+class TestRoundCapacity:
+
+    def test_slack_bounded(self):
+        for x in [1, 7, 8, 9, 100, 1000, 12345, 1 << 20, (1 << 20) + 1]:
+            cap = large_p.round_capacity(x)
+            assert cap >= max(x, 8)
+            assert cap <= max(x, 8) * 1.125 + 8
+
+
+class TestBlockedAggregation:
+
+    def _data(self, n, n_ids, P, seed=0):
+        rng = np.random.default_rng(seed)
+        pid = rng.integers(0, n_ids, n).astype(np.int32)
+        pk = rng.integers(0, P, n).astype(np.int32)
+        values = rng.uniform(0, 5, n)
+        valid = np.ones(n, dtype=bool)
+        return pid, pk, values, valid
+
+    def test_matches_dense_kernel_public_noise_free(self):
+        # Public (no selection), zero noise, loose bounds -> blocked result
+        # must EXACTLY match the dense kernel and the raw aggregate.
+        P = 1000
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P,
+                                                            private=False,
+                                                            l0=P,
+                                                            linf=64)
+        stds = np.zeros_like(np.asarray(stds))
+        pid, pk, values, valid = self._data(20_000, 500, P)
+        key = jax.random.PRNGKey(0)
+        kept, outputs = large_p.aggregate_blocked(pid,
+                                                  pk,
+                                                  values,
+                                                  valid,
+                                                  min_v,
+                                                  max_v,
+                                                  min_s,
+                                                  max_s,
+                                                  mid,
+                                                  stds,
+                                                  key,
+                                                  cfg,
+                                                  block_partitions=128,
+                                                  row_chunk=4096)
+        assert list(kept) == list(range(P))
+        expected_count = np.bincount(pk, minlength=P)
+        expected_sum = np.bincount(pk,
+                                   weights=np.clip(values, 0, 5),
+                                   minlength=P)
+        np.testing.assert_allclose(outputs["count"], expected_count,
+                                   atol=1e-4)
+        np.testing.assert_allclose(outputs["sum"], expected_sum, rtol=1e-5)
+
+    def test_private_selection_blocked(self):
+        # Partitions with many ids are kept, single-id partitions dropped —
+        # across block boundaries.
+        P = 300
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P, l0=20,
+                                                             linf=4, eps=30)
+        stds = np.zeros_like(np.asarray(stds))
+        # Dense partitions 0..9 and 290..299 (first and last block); sparse
+        # singles elsewhere.
+        rows = []
+        for p in list(range(10)) + list(range(290, 300)):
+            for u in range(200):
+                rows.append((u, p))
+        for p in range(100, 110):
+            rows.append((10_000 + p, p))
+        pid = np.array([r[0] for r in rows], dtype=np.int32)
+        pk = np.array([r[1] for r in rows], dtype=np.int32)
+        values = np.ones(len(rows))
+        kept, outputs = large_p.aggregate_blocked(pid,
+                                                  pk,
+                                                  values,
+                                                  np.ones(len(rows), bool),
+                                                  min_v,
+                                                  max_v,
+                                                  min_s,
+                                                  max_s,
+                                                  mid,
+                                                  stds,
+                                                  jax.random.PRNGKey(1),
+                                                  cfg,
+                                                  block_partitions=64,
+                                                  row_chunk=2048)
+        kept = set(kept.tolist())
+        assert set(range(10)).issubset(kept)
+        assert set(range(290, 300)).issubset(kept)
+        assert not kept & set(range(100, 110))
+
+    def test_bounding_is_global_across_blocks(self):
+        # One privacy id contributing to many partitions must be l0-bounded
+        # globally even though its partitions land in different blocks.
+        P = 256
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(
+            P, private=False, l0=4, linf=1, metrics_list=[pdp.Metrics.COUNT])
+        stds = np.zeros_like(np.asarray(stds))
+        pid = np.zeros(P, dtype=np.int32)
+        pk = np.arange(P, dtype=np.int32)
+        kept, outputs = large_p.aggregate_blocked(pid,
+                                                  pk,
+                                                  np.ones(P),
+                                                  np.ones(P, bool),
+                                                  min_v,
+                                                  max_v,
+                                                  min_s,
+                                                  max_s,
+                                                  mid,
+                                                  stds,
+                                                  jax.random.PRNGKey(2),
+                                                  cfg,
+                                                  block_partitions=32,
+                                                  row_chunk=10_000)
+        assert outputs["count"].sum() == pytest.approx(4.0, abs=1e-6)
+
+    def test_ten_million_partitions_smoke(self):
+        # P = 10^7 with tiny blocks of data: bounded memory, only kept
+        # partitions returned.
+        P = 10_000_000
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P, l0=20,
+                                                             linf=8, eps=30)
+        rng = np.random.default_rng(7)
+        n = 50_000
+        pid = rng.integers(0, 2000, n).astype(np.int32)
+        # Rows concentrated on 20 partitions spread across the huge space.
+        hot = rng.integers(0, P, 20)
+        pk = hot[rng.integers(0, 20, n)].astype(np.int32)
+        kept, outputs = large_p.aggregate_blocked(pid,
+                                                  pk,
+                                                  rng.uniform(0, 5, n),
+                                                  np.ones(n, bool),
+                                                  min_v,
+                                                  max_v,
+                                                  min_s,
+                                                  max_s,
+                                                  mid,
+                                                  np.asarray(stds),
+                                                  jax.random.PRNGKey(3),
+                                                  cfg,
+                                                  block_partitions=1 << 20)
+        assert set(kept.tolist()).issubset(set(hot.tolist()))
+        assert len(kept) > 0
+        assert len(outputs["count"]) == len(kept)
+
+    def test_percentile_rejected(self):
+        P = 100
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(
+            P, private=False, metrics_list=[pdp.Metrics.PERCENTILE(50)])
+        with pytest.raises(NotImplementedError, match="PERCENTILE"):
+            large_p.aggregate_blocked(np.zeros(4, np.int32),
+                                      np.zeros(4, np.int32), np.ones(4),
+                                      np.ones(4, bool), min_v, max_v, min_s,
+                                      max_s, mid, np.asarray(stds),
+                                      jax.random.PRNGKey(0), cfg)

@@ -209,6 +209,13 @@ def test_multiple_aggregations_on_same_collection(backend_name):
     assert dict(count_res)["NY"] == pytest.approx(4, abs=0.1)
 
 
+# The Beam/Spark adapters execute end-to-end (real BeamBackend /
+# SparkRDDBackend / private_beam / private_spark code) over in-memory fake
+# runners in tests/test_fake_runners.py — apache_beam/pyspark themselves are
+# not installable in this environment. These two checks only assert the
+# import gating works when the real libraries are present.
+
+
 def test_beam_adapter_requires_beam():
     pytest.importorskip("apache_beam")
     from pipelinedp_tpu import private_beam  # noqa: F401

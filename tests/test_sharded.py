@@ -27,7 +27,7 @@ def _aggregate(backend, rows, params, public=None, eps=HUGE_EPS):
 
 class TestShardRows:
 
-    def test_shard_rows_by_pid_partitions_and_pads(self):
+    def test_shard_rows_by_pid_colocates_and_pads(self):
         pid = np.arange(100, dtype=np.int32)
         pk = np.zeros(100, dtype=np.int32)
         values = np.ones(100)
@@ -36,10 +36,13 @@ class TestShardRows:
             pid, pk, values, valid, 8)
         assert len(spid) % 8 == 0
         per_shard = len(spid) // 8
+        # Every privacy id's rows land on exactly one shard.
+        shard_of = {}
         for s in range(8):
             block_pid = spid[s * per_shard:(s + 1) * per_shard]
             block_valid = svalid[s * per_shard:(s + 1) * per_shard]
-            assert np.all(block_pid[block_valid] % 8 == s)
+            for p in block_pid[block_valid]:
+                assert shard_of.setdefault(int(p), s) == s
         assert svalid.sum() == 100
         assert svalues[svalid].sum() == 100
 
@@ -48,6 +51,38 @@ class TestShardRows:
         spid, spk, sval, svalid = shard_rows_by_pid(pid, pid, pid.astype(
             float), np.ones(10, bool), 4)
         assert svalid.sum() == 10
+
+    def test_skewed_pids_bounded_padding(self):
+        # Zipf-ish skew: a few very hot ids plus a long tail. The two-phase
+        # balancing (greedy LPT for heavy ids, serpentine tail) must keep
+        # total padded size < 1.2x the ideal equal-split layout (the old
+        # pid%n scheme + pow2 rounding could inflate this past 2x).
+        rng = np.random.default_rng(0)
+        n_ids = 2000
+        counts = (rng.zipf(1.5, n_ids) % 500 + 1)
+        pid = np.repeat(np.arange(n_ids, dtype=np.int32), counts)
+        n = len(pid)
+        pk = rng.integers(0, 16, n).astype(np.int32)
+        spid, _, _, svalid = shard_rows_by_pid(pid, pk, np.ones(n),
+                                               np.ones(n, bool), 8)
+        ideal = 8 * (-(-n // 8))
+        assert len(spid) < 1.2 * ideal, (len(spid), ideal)
+        assert svalid.sum() == n
+
+    def test_one_dominant_pid_padding(self):
+        # One id holds half the rows; its shard is irreducibly hot, but the
+        # other shards must share the remainder evenly.
+        n_tail = 7000
+        pid = np.concatenate([
+            np.zeros(7000, dtype=np.int32),
+            np.arange(1, 1 + n_tail, dtype=np.int32)
+        ])
+        n = len(pid)
+        spid, _, _, svalid = shard_rows_by_pid(pid, pid, np.ones(n),
+                                               np.ones(n, bool), 8)
+        # Capacity is set by the hot shard (7000 rows) with <=12.5% slack.
+        assert len(spid) <= 8 * 7000 * 1.125
+        assert svalid.sum() == n
 
 
 class TestShardedEngineParity:
