@@ -39,6 +39,9 @@ def parse_file_columns(
         raise ValueError(f"{filename}: no 'movie_id:' header lines found")
     # Each data line belongs to the most recent header above it.
     movie_of_line = np.cumsum(is_header) - 1
+    if not is_header[0]:
+        raise ValueError(
+            f"{filename}: data lines before the first 'movie_id:' header")
     data_lines = lines[~is_header]
     movie_col = movie_ids[movie_of_line[~is_header]]
     # "user_id,rating,date" -> first two comma-separated fields.

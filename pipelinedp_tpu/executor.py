@@ -412,6 +412,22 @@ def bounded_row_columns(pid: jnp.ndarray, pk: jnp.ndarray,
     return spk, keep_row, pair_start, reduce_cols, qrows
 
 
+def reduce_column_names(cfg: KernelConfig) -> List[str]:
+    """The reduce_cols keys bounded_row_columns emits for this config —
+    callers that assemble row columns out-of-band (the blocked large-P path
+    on empty inputs) build them from here, not from observed outputs."""
+    if cfg.vector_size:
+        return ['v%d' % d for d in range(cfg.vector_size)]
+    names = []
+    if any(e.kind == 'sum' for e in cfg.plan):
+        names.append('sum')
+    if any(e.kind in ('mean', 'variance') for e in cfg.plan):
+        names.append('nsum')
+    if any(e.kind == 'variance' for e in cfg.plan):
+        names.append('nsum2')
+    return names
+
+
 def reduce_rows_to_partitions(spk, keep_row, pair_start, reduce_cols,
                               n_partitions: int, vector_size: int):
     """Phase 1b: dense [0, n_partitions) partition columns from the bounded
@@ -650,6 +666,139 @@ def _descend_quantiles(noisy_levels, min_v, max_v, cfg: KernelConfig):
     return mono[:, inverse]
 
 
+def _node_noise_keys(level_key: jax.Array, node_ids: jnp.ndarray,
+                     partition_ids: jnp.ndarray) -> jax.Array:
+    """Deterministic PRNG key per (level, partition, node).
+
+    Lazy tree noising must give a node the SAME noise on every visit (two
+    noisy copies of one count would double-spend budget), so keys derive
+    from the node's identity, not the visit order.
+    """
+    pkeys = jax.vmap(jax.random.fold_in,
+                     in_axes=(None, 0))(level_key, partition_ids)  # [P]
+    return jax.vmap(
+        lambda kp, row: jax.vmap(lambda nid: jax.random.fold_in(kp, nid))
+        (row))(pkeys, node_ids)  # [P, B]
+
+
+def _noisy_node_counts(counts: jnp.ndarray, keys: jax.Array, std,
+                       cfg: KernelConfig, secure_tables, qidx: int):
+    """Adds per-node-keyed noise to lazily-computed tree node counts."""
+    f = _ftype()
+    if cfg.secure:
+        thr_hi, thr_lo, gran = secure_tables
+        uhi = jax.vmap(
+            jax.vmap(lambda k: jax.random.bits(k, (), jnp.uint32)))(
+                jax.vmap(jax.vmap(lambda k: jax.random.fold_in(k, 0)))(keys))
+        ulo = jax.vmap(
+            jax.vmap(lambda k: jax.random.bits(k, (), jnp.uint32)))(
+                jax.vmap(jax.vmap(lambda k: jax.random.fold_in(k, 1)))(keys))
+        return secure_noise.snapped_release(counts.astype(f), uhi, ulo,
+                                            thr_hi[qidx], thr_lo[qidx],
+                                            gran[qidx])
+    draws = jax.vmap(jax.vmap(lambda k: jax.random.normal(k, ())))(keys) \
+        if cfg.noise_kind == NoiseKind.GAUSSIAN else \
+        jax.vmap(jax.vmap(lambda k: jax.random.laplace(k, ())))(keys)
+    scale = std if cfg.noise_kind == NoiseKind.GAUSSIAN else std / jnp.sqrt(
+        2.0)
+    return counts.astype(f) + draws.astype(f) * scale
+
+
+def _lazy_quantile_outputs(qrows, min_v, max_v, stds, key: jax.Array,
+                           cfg: KernelConfig,
+                           psum_axis: Optional[str] = None,
+                           secure_tables=None):
+    """Per-partition DP quantiles by lazy root-to-leaf descent.
+
+    Instead of materializing (and rescanning rows for) every chunk of the
+    dense [P, leaves] histogram, each descent level segment-sums the rows
+    into only the B children of every partition's CURRENT node ([P, B]
+    memory), noising them with per-node deterministic noise
+    (_node_noise_keys) — the released values are identical in distribution
+    to noising the whole tree and reading the descent path. Total work is
+    O(n_quantiles * height * n_rows + P * B) regardless of P, replacing the
+    chunked path's O(n_rows * ceil(P / quantile_chunk)).
+    """
+    row_pk, row_leaf, row_keep = qrows
+    B, h = cfg.branching, cfg.tree_height
+    P = cfg.n_partitions
+    f = _ftype()
+    i32 = jnp.int32
+    qidx = quantile_std_index(cfg.plan)
+    std = stds[qidx].astype(f)
+    plan_names = next(e.outputs for e in cfg.plan if e.kind == 'quantiles')
+    if cfg.secure and secure_tables is None:
+        raise ValueError("cfg.secure requires secure_tables "
+                         "(secure_noise.build_tables)")
+    arange_b = jnp.arange(B, dtype=i32)
+    partition_ids = jnp.arange(P, dtype=i32)
+
+    def noisy_children(level, parent):
+        """Noisy counts of each partition's `parent` node's B children at
+        `level` (levels 1..h; parent ids live at level-1)."""
+        shift = B**(h - level)
+        row_node = (row_leaf // shift).astype(i32)
+        par = parent[jnp.minimum(row_pk, P - 1)]
+        in_path = row_keep & (row_node // B == par) & (row_pk < P)
+        seg = jnp.where(in_path, row_pk * B + (row_node % B), P * B)
+        counts = jax.ops.segment_sum(in_path.astype(i32), seg,
+                                     num_segments=P * B + 1)[:P * B].reshape(
+                                         P, B)
+        if psum_axis is not None:
+            counts = jax.lax.psum(counts, psum_axis)
+        node_ids = parent * B  # level-l ids of child 0
+        node_ids = node_ids[:, None] + arange_b
+        keys = _node_noise_keys(jax.random.fold_in(key, level), node_ids,
+                                partition_ids)
+        return _noisy_node_counts(counts, keys, std, cfg, secure_tables,
+                                  qidx)
+
+    mid_value = min_v + (max_v - min_v) / 2
+    results = []
+    for q in cfg.quantiles:
+        node = jnp.zeros(P, dtype=i32)
+        children = jnp.maximum(noisy_children(1, node), 0.0)
+        total = children.sum(axis=-1)
+        target = q * total
+        for level in range(1, h + 1):
+            cum = jnp.cumsum(children, axis=-1)
+            child = jnp.minimum(
+                jnp.sum(cum < target[:, None], axis=-1).astype(i32), B - 1)
+            before = jnp.where(
+                child > 0,
+                jnp.take_along_axis(cum,
+                                    jnp.maximum(child - 1, 0)[:, None],
+                                    axis=1)[:, 0], 0.0)
+            target = target - before
+            node = node * B + child
+            if level < h:
+                nxt = jnp.maximum(noisy_children(level + 1, node), 0.0)
+                child_mass = jnp.take_along_axis(children, child[:, None],
+                                                 axis=1)[:, 0]
+                target = target / jnp.maximum(child_mass, 1e-12) * nxt.sum(
+                    axis=-1)
+                children = nxt
+            else:
+                leaf_count = jnp.maximum(
+                    jnp.take_along_axis(children, child[:, None],
+                                        axis=1)[:, 0], 1e-12)
+        L = B**h
+        leaf_width = (max_v - min_v) / L
+        leaf_lo = min_v + node.astype(f) * leaf_width
+        frac = jnp.clip(target / leaf_count, 0.0, 1.0)
+        value = jnp.clip(leaf_lo + frac * leaf_width, min_v, max_v)
+        results.append(jnp.where(total <= 0, mid_value, value))
+    stacked = jnp.stack(results, axis=-1)  # (P, n_q)
+    order = np.argsort(np.asarray(cfg.quantiles), kind="stable")
+    inverse = np.argsort(order, kind="stable")
+    mono = jax.lax.cummax(stacked[:, order], axis=1)
+    per_partition = mono[:, inverse]
+    return {
+        name: per_partition[:, j].astype(f)
+        for j, name in enumerate(plan_names)
+    }
+
+
 def quantile_outputs(qrows, min_v, max_v, stds, key: jax.Array,
                      cfg: KernelConfig, psum_axis: Optional[str] = None,
                      secure_tables=None):
@@ -662,18 +811,20 @@ def quantile_outputs(qrows, min_v, max_v, stds, key: jax.Array,
     the device form of quantile-tree merge — and noise/descent run
     replicated (same key on every shard).
 
-    Compute/memory trade-off: every chunk rescans the full row stream, so
-    histogram work is O(n_rows * ceil(P / quantile_chunk)). With the default
-    tree (65536 leaves) one chunk covers 512 partitions — a single pass for
-    typical percentile workloads; beyond that, memory stays bounded at the
-    cost of extra passes.
+    Two regimes: when one chunk covers every partition (the default 65536-
+    leaf tree covers 512 partitions per chunk) the dense histogram is built
+    in a single pass. Larger partition spaces switch to the lazy descent
+    (_lazy_quantile_outputs): O(n_q * height) row passes total instead of
+    one per chunk, with [P, branching] peak memory.
     """
+    if -(-cfg.n_partitions // max(cfg.quantile_chunk, 1)) > 1:
+        return _lazy_quantile_outputs(qrows, min_v, max_v, stds, key, cfg,
+                                      psum_axis, secure_tables)
     row_pk, row_leaf, row_keep = qrows
     B, h = cfg.branching, cfg.tree_height
     L = B**h
     P = cfg.n_partitions
     C = cfg.quantile_chunk
-    n_chunks = -(-P // C)
     f = _ftype()
     qidx = quantile_std_index(cfg.plan)
     std = stds[qidx].astype(f)
@@ -722,13 +873,9 @@ def quantile_outputs(qrows, min_v, max_v, stds, key: jax.Array,
                     nkey, counts[l].shape, std, cfg.noise_kind))
         return _descend_quantiles(noisy, min_v, max_v, cfg)
 
-    if n_chunks == 1:
-        per_partition = chunk_fn(jnp.int32(0))[:P]
-    else:
-        per_partition = jax.lax.map(chunk_fn,
-                                    jnp.arange(n_chunks,
-                                               dtype=jnp.int32)).reshape(
-                                                   n_chunks * C, -1)[:P]
+    # Multi-chunk configurations were dispatched to the lazy descent above,
+    # so exactly one dense pass remains.
+    per_partition = chunk_fn(jnp.int32(0))[:P]
     return {
         name: per_partition[:, j].astype(f)
         for j, name in enumerate(plan_names)

@@ -162,16 +162,32 @@ def sample_discrete(key: jax.Array, shape, thr_hi: jnp.ndarray,
     return idx - K
 
 
+def snapped_release(col: jnp.ndarray, uhi: jnp.ndarray, ulo: jnp.ndarray,
+                    thr_hi, thr_lo, gran) -> jnp.ndarray:
+    """Snap `col` to the grid and add grid-integer discrete noise drawn from
+    the caller-provided uniform u64 words (uhi, ulo).
+
+    The single place the snap-and-scale release discipline lives: callers
+    differ only in how they derive randomness (sequential key splits for
+    metric columns, per-node deterministic keys for lazy quantile trees).
+    """
+    f = col.dtype
+    gran = gran.astype(f)
+    snapped = jnp.round(col / gran) * gran
+    idx = _lex_search(thr_hi, thr_lo, uhi, ulo)
+    K = (thr_hi.shape[0] - 1) // 2
+    return snapped + (idx - K).astype(f) * gran
+
+
 def snapped_noisy(col: jnp.ndarray, key: jax.Array, thr_hi, thr_lo,
                   gran) -> jnp.ndarray:
-    """Snap `col` to the grid and add grid-integer discrete noise.
+    """snapped_release with randomness from one PRNG key.
 
     gran is a traced scalar; the output lives exactly on the gran-grid
     (modulo float representation of grid points, which is exact for
     power-of-two gran over the magnitudes involved).
     """
-    f = col.dtype
-    gran = gran.astype(f)
-    snapped = jnp.round(col / gran) * gran
-    atoms = sample_discrete(key, col.shape, thr_hi, thr_lo)
-    return snapped + atoms.astype(f) * gran
+    k1, k2 = jax.random.split(key)
+    uhi = jax.random.bits(k1, col.shape, jnp.uint32)
+    ulo = jax.random.bits(k2, col.shape, jnp.uint32)
+    return snapped_release(col, uhi, ulo, thr_hi, thr_lo, gran)

@@ -133,7 +133,8 @@ def aggregate_blocked(pid,
     order = np.argsort(pid, kind="stable")
     pid_s, pk_s, values_s, valid_s = (pid[order], pk[order], values[order],
                                       valid[order])
-    b_pk, b_pair, b_cols = [], [], None
+    b_pk, b_pair = [], []
+    b_cols = {name: [] for name in executor.reduce_column_names(cfg)}
     start = 0
     for ci, end in enumerate(_chunk_ends(pid_s, row_chunk)):
         sl = slice(start, end)
@@ -151,19 +152,15 @@ def aggregate_blocked(pid,
         keep = np.asarray(keep)
         b_pk.append(np.asarray(spk)[keep])
         b_pair.append(np.asarray(pair)[keep])
-        cols = {name: np.asarray(col)[keep] for name, col in cols.items()}
-        if b_cols is None:
-            b_cols = {name: [col] for name, col in cols.items()}
-        else:
-            for name, col in cols.items():
-                b_cols[name].append(col)
+        for name, col in cols.items():
+            b_cols[name].append(np.asarray(col)[keep])
         start = end
 
     spk_all = np.concatenate(b_pk) if b_pk else np.zeros(0, np.int32)
     pair_all = np.concatenate(b_pair) if b_pair else np.zeros(0, bool)
     cols_all = {
-        name: np.concatenate(chunks)
-        for name, chunks in (b_cols or {}).items()
+        name: (np.concatenate(chunks) if chunks else np.zeros(0))
+        for name, chunks in b_cols.items()
     }
 
     # --- Pass 2: bin by partition block, finalize each block. -------------
@@ -177,9 +174,17 @@ def aggregate_blocked(pid,
     block_starts = np.searchsorted(spk_all,
                                    np.arange(n_blocks + 1) * C,
                                    side="left")
-    kept_ids, kept_outputs = [], {}
+    output_names = [name for e in cfg.plan for name in e.outputs]
+    kept_ids = []
+    kept_outputs = {name: [] for name in output_names}
     for b in range(n_blocks):
         lo, hi = int(block_starts[b]), int(block_starts[b + 1])
+        if lo == hi and cfg.private_selection:
+            # Private selection keeps empty partitions with probability 0
+            # (selection_ops.keep_probabilities: n <= 0 -> 0), so row-less
+            # blocks provably emit nothing — skip their device work. In the
+            # sparse 10^9-partition regime this skips nearly every block.
+            continue
         c_actual = min(C, P - b * C)
         cfg_block = dataclasses.replace(cfg, n_partitions=c_actual)
         cap = round_capacity(hi - lo)
@@ -205,6 +210,6 @@ def aggregate_blocked(pid,
 
     kept = (np.concatenate(kept_ids) if kept_ids else np.zeros(0, np.int64))
     return kept, {
-        name: np.concatenate(chunks)
+        name: (np.concatenate(chunks) if chunks else np.zeros(0))
         for name, chunks in kept_outputs.items()
     }

@@ -422,6 +422,33 @@ class TestDenseDistributedParity:
             abs_tol=0.01)
 
 
+class TestAnalysisOnMultiProc:
+    """The distributed analysis path through REAL process boundaries: the
+    PerPartitionAnalyzer and its accumulators must pickle to workers and the
+    reports must match the dense single-program path."""
+
+    def test_matches_dense_path(self):
+        backend = pdp.MultiProcLocalBackend(n_jobs=2)
+        options = data_structures.UtilityAnalysisOptions(
+            epsilon=10,
+            delta=1e-5,
+            aggregate_params=_agg_params([pdp.Metrics.COUNT]),
+            multi_param_configuration=data_structures.
+            MultiParameterConfiguration(max_partitions_contributed=[1, 3]))
+        public = ["pk0", "pk1", "pk2"]
+        mp_reports, mp_pp = analysis.perform_utility_analysis(
+            DATA, backend, options, EXTRACTORS, public_partitions=public)
+        mp_reports = sorted(mp_reports, key=lambda r: r.configuration_index)
+        dense_reports, _ = analysis.perform_utility_analysis(
+            DATA, BACKEND, options, EXTRACTORS, public_partitions=public)
+        dense_reports = sorted(dense_reports,
+                               key=lambda r: r.configuration_index)
+        assert len(mp_reports) == 2
+        for mp, dense in zip(mp_reports, dense_reports):
+            assert_reports_close(mp, dense, rel=1e-6, abs_tol=1e-9)
+        assert len(list(mp_pp)) == 3 * 2
+
+
 class TestKeepProbBatchKernel:
 
     @pytest.mark.parametrize("strategy", [

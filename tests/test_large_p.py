@@ -184,6 +184,35 @@ class TestBlockedAggregation:
         assert len(kept) > 0
         assert len(outputs["count"]) == len(kept)
 
+    def test_empty_input(self):
+        # Zero rows (e.g. everything filtered upstream) must return empty
+        # results, not crash on undiscovered metric columns.
+        P = 300
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P)
+        kept, outputs = large_p.aggregate_blocked(
+            np.zeros(0, np.int32), np.zeros(0, np.int32), np.zeros(0),
+            np.zeros(0, bool), min_v, max_v, min_s, max_s, mid,
+            np.asarray(stds), jax.random.PRNGKey(0), cfg,
+            block_partitions=64)
+        assert len(kept) == 0
+        assert len(outputs["count"]) == 0
+        assert len(outputs["sum"]) == 0
+
+    def test_sparse_blocks_skipped_private(self):
+        # Only blocks containing rows run device kernels in private mode.
+        P = 1 << 22
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P, l0=2,
+                                                             linf=4, eps=30)
+        pid = np.repeat(np.arange(500, dtype=np.int32), 2)
+        pk = np.where(np.arange(1000) % 2 == 0, 7, P - 3).astype(np.int32)
+        kept, outputs = large_p.aggregate_blocked(
+            pid, pk, np.ones(1000), np.ones(1000, bool), min_v, max_v,
+            min_s, max_s, mid,
+            np.zeros_like(np.asarray(stds)), jax.random.PRNGKey(1), cfg,
+            block_partitions=1 << 16)
+        assert set(kept.tolist()) == {7, P - 3}
+        assert outputs["count"].sum() == pytest.approx(1000, abs=1e-6)
+
     def test_percentile_rejected(self):
         P = 100
         cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(

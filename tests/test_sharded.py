@@ -168,10 +168,10 @@ class TestShardedEngineParity:
         assert result["A"].percentile_75 == pytest.approx(75.0, abs=2.0)
 
     def test_percentile_sharded_multichunk(self, monkeypatch):
-        # Forces quantile_chunk=2 so quantile_outputs takes the lax.map
-        # multi-chunk path (psum inside the mapped body) under shard_map —
-        # a collective-inside-scan regression here would otherwise only
-        # surface on real meshes.
+        # Forces quantile_chunk=2 so quantile_outputs dispatches to the
+        # LAZY descent (executor._lazy_quantile_outputs) under shard_map —
+        # its per-level psum of [P, B] child counts is the collective that
+        # would otherwise only be exercised on real meshes.
         import dataclasses
         from pipelinedp_tpu import executor
         orig = executor.make_kernel_config
