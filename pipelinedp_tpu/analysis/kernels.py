@@ -276,12 +276,15 @@ def sweep_kernel(counts,
     kc = min(config_chunk, k_total)
     pad_k = (-k_total) % kc
 
+    n_cfg_chunks = (k_total + pad_k) // kc
+
     def pad_cfg(x):
         widths = ((0, pad_k),) + ((0, 0),) * (x.ndim - 1)
         # Padded configs reuse config 0 so every branch stays numerically
-        # benign; their outputs are sliced off below.
+        # benign; their outputs are sliced off below. Explicit chunk count:
+        # -1 inference fails on zero-width dims (n_metrics == 0).
         return jnp.pad(x, widths, mode="edge").reshape(
-            (-1, kc) + x.shape[1:])
+            (n_cfg_chunks, kc) + x.shape[1:])
 
     cfg_chunks = SweepConfigArrays(*[pad_cfg(jnp.asarray(x)) for x in cfg])
 
@@ -326,7 +329,9 @@ def sweep_kernel(counts,
     outs = jax.lax.map(chunk_fn, cfg_chunks)
 
     def unchunk(x):  # [n_chunks, KC, ...] -> [K, ...]
-        return x.reshape((-1,) + x.shape[2:])[:k_total]
+        # Explicit leading size: -1 inference fails on zero-width trailing
+        # dims (select-partitions analysis has n_metrics == 0).
+        return x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])[:k_total]
 
     result = {
         "bucket_rows": unchunk(outs[0]),

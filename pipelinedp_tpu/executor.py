@@ -316,9 +316,12 @@ def bounded_row_columns(pid: jnp.ndarray, pk: jnp.ndarray,
     key_total, key_linf, key_l0 = jax.random.split(rows_key, 3)
 
     vector = bool(cfg.vector_size)
-    need_sum = any(e.kind == 'sum' for e in cfg.plan)
-    need_nsum = any(e.kind in ('mean', 'variance') for e in cfg.plan)
-    need_nsum2 = any(e.kind == 'variance' for e in cfg.plan)
+    # Single source of truth for which reduce columns exist; out-of-band
+    # assemblers (parallel/large_p.py) read the same list.
+    col_names = reduce_column_names(cfg)
+    need_sum = 'sum' in col_names
+    need_nsum = 'nsum' in col_names
+    need_nsum2 = 'nsum2' in col_names
 
     pk_sent = jnp.where(valid, pk, P).astype(i32)
     pid_sent = jnp.where(valid, pid, jnp.iinfo(i32).max).astype(i32)
@@ -604,31 +607,32 @@ def quantile_std_index(plan: Tuple[MetricPlanEntry, ...]) -> int:
     raise ValueError("plan has no quantiles entry")
 
 
-def _descend_quantiles(noisy_levels, min_v, max_v, cfg: KernelConfig):
-    """Vectorized root-to-leaf descent over a chunk of noisy trees.
+def _descend_trees(children_of, n_trees: int, min_v, max_v,
+                   cfg: KernelConfig):
+    """Vectorized root-to-leaf descent over n_trees noisy quantile trees.
 
     Device mirror of DenseQuantileTree._single_quantile + the monotonicity
-    enforcement of compute_quantiles; vmapped over partitions (axis 0 of
-    every level array) and unrolled over the static tree height.
+    enforcement of compute_quantiles, unrolled over the static tree height.
+    THE single copy of the descent arithmetic: the dense path supplies
+    ``children_of`` as precomputed-histogram gathers, the lazy path as
+    on-demand segment sums — so the two executions cannot drift.
+
+    children_of(level, parent) -> non-negative noisy counts [n_trees, B] of
+    each tree's ``parent`` node's children at ``level`` (parents live at
+    level-1; the root is node 0 at level 0).
     """
     B, h = cfg.branching, cfg.tree_height
     L = B**h
     f = _ftype()
-    C = noisy_levels[0].shape[0]
     mid_value = min_v + (max_v - min_v) / 2
 
     results = []
     for q in cfg.quantiles:
-        children = jnp.maximum(noisy_levels[0], 0.0)  # (C, B): root's kids
+        node = jnp.zeros(n_trees, dtype=jnp.int32)
+        children = children_of(1, node)
         total = children.sum(axis=-1)
         target = q * total
-        node = jnp.zeros(C, dtype=jnp.int32)
         for level in range(1, h + 1):
-            if level > 1:
-                idxs = node[:, None] * B + jnp.arange(B, dtype=jnp.int32)
-                children = jnp.maximum(
-                    jnp.take_along_axis(noisy_levels[level - 1], idxs,
-                                        axis=1), 0.0)
             cum = jnp.cumsum(children, axis=-1)
             # searchsorted(cum, target, side='left'), clamped to B-1.
             child = jnp.minimum(
@@ -642,28 +646,42 @@ def _descend_quantiles(noisy_levels, min_v, max_v, cfg: KernelConfig):
             target = target - before
             node = node * B + child  # node == 0 at level 1
             if level < h:
+                nxt = children_of(level + 1, node)
                 child_mass = jnp.take_along_axis(children, child[:, None],
                                                  axis=1)[:, 0]
-                nidx = node[:, None] * B + jnp.arange(B, dtype=jnp.int32)
-                sub = jnp.maximum(
-                    jnp.take_along_axis(noisy_levels[level], nidx, axis=1),
-                    0.0).sum(axis=-1)
-                target = target / jnp.maximum(child_mass, 1e-12) * sub
+                target = target / jnp.maximum(child_mass,
+                                              1e-12) * nxt.sum(axis=-1)
+                children = nxt
+            else:
+                leaf_count = jnp.maximum(
+                    jnp.take_along_axis(children, child[:, None],
+                                        axis=1)[:, 0], 1e-12)
         leaf_width = (max_v - min_v) / L
         leaf_lo = min_v + node.astype(f) * leaf_width
-        leaf_count = jnp.maximum(
-            jnp.take_along_axis(noisy_levels[h - 1], node[:, None],
-                                axis=1)[:, 0], 1e-12)
         frac = jnp.clip(target / leaf_count, 0.0, 1.0)
         value = jnp.clip(leaf_lo + frac * leaf_width, min_v, max_v)
         results.append(jnp.where(total <= 0, mid_value, value))
-    stacked = jnp.stack(results, axis=-1)  # (C, n_q)
+    stacked = jnp.stack(results, axis=-1)  # (n_trees, n_q)
 
     # Monotonicity in quantile order (compute_quantiles' cummax).
     order = np.argsort(np.asarray(cfg.quantiles), kind="stable")
     inverse = np.argsort(order, kind="stable")
     mono = jax.lax.cummax(stacked[:, order], axis=1)
     return mono[:, inverse]
+
+
+def _descend_quantiles(noisy_levels, min_v, max_v, cfg: KernelConfig):
+    """Descent over precomputed noisy level histograms (the dense path)."""
+    B = cfg.branching
+    C = noisy_levels[0].shape[0]
+    arange_b = jnp.arange(B, dtype=jnp.int32)
+
+    def children_of(level, parent):
+        idxs = parent[:, None] * B + arange_b
+        return jnp.maximum(
+            jnp.take_along_axis(noisy_levels[level - 1], idxs, axis=1), 0.0)
+
+    return _descend_trees(children_of, C, min_v, max_v, cfg)
 
 
 def _node_noise_keys(level_key: jax.Array, node_ids: jnp.ndarray,
@@ -753,46 +771,9 @@ def _lazy_quantile_outputs(qrows, min_v, max_v, stds, key: jax.Array,
         return _noisy_node_counts(counts, keys, std, cfg, secure_tables,
                                   qidx)
 
-    mid_value = min_v + (max_v - min_v) / 2
-    results = []
-    for q in cfg.quantiles:
-        node = jnp.zeros(P, dtype=i32)
-        children = jnp.maximum(noisy_children(1, node), 0.0)
-        total = children.sum(axis=-1)
-        target = q * total
-        for level in range(1, h + 1):
-            cum = jnp.cumsum(children, axis=-1)
-            child = jnp.minimum(
-                jnp.sum(cum < target[:, None], axis=-1).astype(i32), B - 1)
-            before = jnp.where(
-                child > 0,
-                jnp.take_along_axis(cum,
-                                    jnp.maximum(child - 1, 0)[:, None],
-                                    axis=1)[:, 0], 0.0)
-            target = target - before
-            node = node * B + child
-            if level < h:
-                nxt = jnp.maximum(noisy_children(level + 1, node), 0.0)
-                child_mass = jnp.take_along_axis(children, child[:, None],
-                                                 axis=1)[:, 0]
-                target = target / jnp.maximum(child_mass, 1e-12) * nxt.sum(
-                    axis=-1)
-                children = nxt
-            else:
-                leaf_count = jnp.maximum(
-                    jnp.take_along_axis(children, child[:, None],
-                                        axis=1)[:, 0], 1e-12)
-        L = B**h
-        leaf_width = (max_v - min_v) / L
-        leaf_lo = min_v + node.astype(f) * leaf_width
-        frac = jnp.clip(target / leaf_count, 0.0, 1.0)
-        value = jnp.clip(leaf_lo + frac * leaf_width, min_v, max_v)
-        results.append(jnp.where(total <= 0, mid_value, value))
-    stacked = jnp.stack(results, axis=-1)  # (P, n_q)
-    order = np.argsort(np.asarray(cfg.quantiles), kind="stable")
-    inverse = np.argsort(order, kind="stable")
-    mono = jax.lax.cummax(stacked[:, order], axis=1)
-    per_partition = mono[:, inverse]
+    per_partition = _descend_trees(
+        lambda level, parent: jnp.maximum(noisy_children(level, parent), 0.0),
+        P, min_v, max_v, cfg)
     return {
         name: per_partition[:, j].astype(f)
         for j, name in enumerate(plan_names)

@@ -576,6 +576,60 @@ class TestUtilityAnalysisE2E:
         assert report.metric_errors[0].metric == pdp.Metrics.SUM
         assert report.utility_report_histogram is not None
 
+    def test_select_partitions_analysis(self):
+        # metrics=[] analyzes partition selection alone (the reference's
+        # select_partitions tuning input): no metric errors, kept-partition
+        # statistics only, bucketed by privacy-id count.
+        options = data_structures.UtilityAnalysisOptions(
+            epsilon=1e3,
+            delta=1e-5,
+            aggregate_params=pdp.AggregateParams(
+                metrics=[],
+                noise_kind=pdp.NoiseKind.GAUSSIAN,
+                max_partitions_contributed=1,
+                max_contributions_per_partition=1))
+        reports_col, per_partition_col = analysis.perform_utility_analysis(
+            DATA, BACKEND, options, EXTRACTORS)
+        report = list(reports_col)[0]
+        assert report.metric_errors is None
+        assert report.partitions_info.num_dataset_partitions == 3
+        # huge eps -> every partition kept with probability ~1
+        assert report.partitions_info.kept_partitions.mean == pytest.approx(
+            3.0, abs=1e-3)
+        pp = list(per_partition_col)
+        assert len(pp) == 3
+        assert all(m.metric_errors == [] for _, m in pp)
+        # Distributed path agrees.
+        dist_reports, _ = _run_distributed(DATA, options, EXTRACTORS)
+        assert_reports_close(report,
+                             sorted(dist_reports,
+                                    key=lambda r: r.configuration_index)[0],
+                             rel=0.02,
+                             abs_tol=0.02)
+
+    def test_select_partitions_tuning(self):
+        histograms = list(
+            ch.compute_dataset_histograms(DATA, EXTRACTORS, BACKEND))[0]
+        options = pt.TuneOptions(
+            epsilon=10,
+            delta=1e-5,
+            aggregate_params=pdp.AggregateParams(
+                metrics=[],
+                noise_kind=pdp.NoiseKind.GAUSSIAN,
+                max_partitions_contributed=1,
+                max_contributions_per_partition=1),
+            function_to_minimize=pt.MinimizingFunction.ABSOLUTE_ERROR,
+            parameters_to_tune=pt.ParametersToTune(
+                max_partitions_contributed=True),
+            number_of_parameter_candidates=4)
+        result_col, _ = pt.tune(DATA, BACKEND, histograms, options,
+                                EXTRACTORS)
+        result = list(result_col)[0]
+        assert result.index_best == -1  # no RMSE to rank for selection
+        assert len(result.utility_reports) == (
+            result.utility_analysis_parameters.size)
+        assert all(r.metric_errors is None for r in result.utility_reports)
+
     def test_analyze_engine_rejects_aggregate(self):
         accountant = pdp.NaiveBudgetAccountant(total_epsilon=1,
                                                total_delta=1e-6)
