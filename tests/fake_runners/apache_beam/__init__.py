@@ -18,6 +18,7 @@ Execution is eager over Python lists — a DirectRunner without the runner.
 
 import random as _random
 
+from apache_beam import io
 from apache_beam import pvalue
 from apache_beam.pvalue import PCollection
 from apache_beam.transforms.ptransform import PTransform
@@ -33,6 +34,10 @@ class Pipeline:
 
     def __init__(self, *args, **kwargs):
         self._labels = set()
+        self._collections = []
+
+    def _register(self, pcoll):
+        self._collections.append(pcoll)
 
     def apply(self, transform, pvalueish):
         if not isinstance(transform, PTransform):
@@ -50,6 +55,8 @@ class Pipeline:
         return self.apply(transform, self)
 
     def run(self):
+        for pcoll in self._collections:
+            _ = pcoll._data  # force thunks (and their side effects)
         return _PipelineResult()
 
     def __enter__(self):

@@ -1,0 +1,24 @@
+"""io module of the in-memory Beam fake: file sinks."""
+
+from apache_beam.transforms.ptransform import PTransform
+
+
+class WriteToText(PTransform):
+    """Writes one element per line, with real WriteToText's shard naming."""
+
+    def __init__(self, file_path_prefix, file_name_suffix=""):
+        super().__init__()
+        self._prefix = file_path_prefix
+        self._suffix = file_name_suffix
+
+    def expand(self, pcoll):
+        from apache_beam.pvalue import PCollection
+
+        def thunk():
+            name = f"{self._prefix}-00000-of-00001{self._suffix}"
+            with open(name, "w") as out:
+                for element in pcoll._data:
+                    out.write(f"{element}\n")
+            return [name]
+
+        return PCollection(pcoll.pipeline, thunk)

@@ -14,6 +14,11 @@ class PCollection:
             thunk = lambda: values
         self._thunk = thunk
         self._materialized = None
+        # Pipeline.run() forces every collection so side-effecting
+        # transforms (Map(print), io.WriteToText) fire at run time.
+        register = getattr(pipeline, "_register", None)
+        if register is not None:
+            register(self)
 
     @property
     def _data(self):

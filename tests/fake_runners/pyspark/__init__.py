@@ -139,10 +139,19 @@ class RDD:
         return self
 
 
+class SparkConf:
+
+    def setMaster(self, master):
+        return self
+
+    def setAppName(self, name):
+        return self
+
+
 class SparkContext:
 
-    def __init__(self, *args, **kwargs):
-        pass
+    def __init__(self, *args, conf=None, **kwargs):
+        del args, conf, kwargs
 
     def parallelize(self, data, numSlices=None):
         return RDD(data, self)

@@ -38,6 +38,26 @@ def test_example_runs(cmd):
     assert proc.stdout.strip(), "example produced no output"
 
 
+FRAMEWORK_EXAMPLES = [
+    ["examples/movie_view_ratings/run_on_beam.py", "--generate_rows", "5000"],
+    [
+        "examples/movie_view_ratings/run_on_spark.py", "--generate_rows",
+        "5000"
+    ],
+]
+
+
+@pytest.mark.parametrize("cmd", FRAMEWORK_EXAMPLES, ids=lambda c: c[0])
+def test_framework_example_runs(cmd):
+    """Beam/Spark example scripts over the in-memory fake runners."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = os.path.join(REPO, "tests", "fake_runners")
+    proc = subprocess.run([sys.executable] + cmd, cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "computed DP count+sum" in proc.stdout
+
+
 def _accelerator_platform():
     """Probes (in a killable subprocess) for a healthy non-CPU device."""
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
