@@ -420,16 +420,20 @@ def _main_with_device_failover():
         msg = (str(e).splitlines() or [""])[0][:200]
         _log(f"benchmark failed mid-run ({type(e).__name__}: {msg}); "
              "re-running CPU-only")
-        passthrough, skip = [], False
-        for a in argv:
+        passthrough, skip, requested_rows = [], False, None
+        for i, a in enumerate(argv):
             if skip:
                 skip = False
+                requested_rows = int(a)
             elif a == "--rows":
                 skip = True  # drop the flag AND its value token
-            elif not a.startswith("--rows="):
+            elif a.startswith("--rows="):
+                requested_rows = int(a.split("=", 1)[1])
+            else:
                 passthrough.append(a)
+        rerun_rows = min(requested_rows or 4_000_000, 4_000_000)
         r = subprocess.run(
-            [sys.executable, __file__, "--cpu", "--rows", "4000000"] +
+            [sys.executable, __file__, "--cpu", "--rows", str(rerun_rows)] +
             passthrough,
             capture_output=True, text=True)
         if r.returncode == 0 and r.stdout.strip():

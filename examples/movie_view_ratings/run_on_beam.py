@@ -21,11 +21,6 @@ import tempfile
 sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
 
-if os.environ.get("JAX_PLATFORMS"):
-    import jax
-
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
 import apache_beam as beam
 
 import pipelinedp_tpu as pdp
@@ -97,7 +92,8 @@ def main():
 
     print("computed DP count+sum for the public movie set (sample above)")
     if args.output_file:
-        print(f"wrote {args.output_file}")
+        # WriteToText shards its output (real Beam naming).
+        print(f"wrote {args.output_file}-00000-of-00001")
     return 0
 
 

@@ -663,6 +663,41 @@ class TestUtilityAnalysisE2E:
             raw_report.metric_errors[0].absolute_error.rmse)
 
 
+class TestProbabilityComputations:
+
+    def test_exact_quantiles_match_monte_carlo(self):
+        from pipelinedp_tpu.analysis import probability_computations as pc
+        rng = np.random.default_rng(0)
+        for b, s in [(1.0, 1.0), (3.0, 0.5), (0.2, 2.0)]:
+            qs = [0.05, 0.5, 0.95]
+            exact = pc.compute_sum_laplace_gaussian_quantiles(b, s, qs, 0)
+            mc = np.quantile(
+                rng.laplace(scale=b, size=500_000) +
+                rng.normal(scale=s, size=500_000), qs)
+            np.testing.assert_allclose(exact, mc, atol=0.05 * (b + s))
+
+    def test_symmetry_and_degenerate_components(self):
+        from pipelinedp_tpu.analysis import probability_computations as pc
+        assert abs(
+            pc.compute_sum_laplace_gaussian_quantiles(2.0, 3.0, [0.5],
+                                                      0)[0]) < 1e-9
+        # Pure Laplace / pure Gaussian reduce to the component quantiles.
+        from scipy import stats
+        got = pc.compute_sum_laplace_gaussian_quantiles(1.5, 0.0, [0.9], 0)
+        assert got[0] == pytest.approx(stats.laplace.ppf(0.9, scale=1.5),
+                                       abs=1e-9)
+        got = pc.compute_sum_laplace_gaussian_quantiles(0.0, 2.0, [0.9], 0)
+        assert got[0] == pytest.approx(stats.norm.ppf(0.9, scale=2.0),
+                                       abs=1e-9)
+
+    def test_cdf_extreme_tails_finite(self):
+        from pipelinedp_tpu.analysis import probability_computations as pc
+        # The e^{x/b} tilt must not overflow far in the tails.
+        vals = pc.laplace_gaussian_cdf(np.array([-1e4, 0.0, 1e4]), 1.0, 1.0)
+        assert vals[0] == 0.0 and vals[2] == 1.0
+        assert vals[1] == pytest.approx(0.5, abs=1e-12)
+
+
 class TestPreAggregation:
 
     def test_preaggregate_values(self):
