@@ -1,0 +1,124 @@
+"""Codelab: from a raw analysis to a differentially-private one, step by step.
+
+Counterpart of the reference's examples/codelab notebook, as a runnable
+script. The business question: "how many times was each product viewed, and
+what revenue converted?" — answered three times:
+
+  1. RAW: plain pandas groupby (no privacy);
+  2. NAIVE ANONYMIZATION: drop customer ids (shown to be insufficient —
+     a differencing attack re-identifies a customer's contribution);
+  3. DIFFERENTIALLY PRIVATE: the guarded PrivateCollection API with a
+     shared (epsilon, delta) budget across both metrics.
+
+Usage:
+    python codelab.py [--csv customer_journeys.csv]
+    (generates the CSV in a temp dir when --csv is not given)
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
+
+import pandas as pd
+
+import pipelinedp_tpu as pdp
+from examples.codelab import generate_customer_journeys
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--csv", default=None)
+    parser.add_argument("--epsilon", type=float, default=5.0)
+    parser.add_argument("--delta", type=float, default=1e-6)
+    args = parser.parse_args()
+
+    # ------------------------------------------------------------------
+    # Step 0: the dataset — one row per product-view event.
+    # ------------------------------------------------------------------
+    csv = args.csv
+    if csv is None:
+        csv = os.path.join(tempfile.mkdtemp(), "customer_journeys.csv")
+        generate_customer_journeys.generate(1000, 0.2,
+                                            0).to_csv(csv, index=False)
+    frame = pd.read_csv(csv)
+    print(f"dataset: {len(frame)} view events, "
+          f"{frame.customer_id.nunique()} customers\n")
+
+    # ------------------------------------------------------------------
+    # Step 1: the raw (non-private) answer.
+    # ------------------------------------------------------------------
+    frame["revenue"] = frame.price * frame.converted
+    raw = frame.groupby("product").agg(views=("customer_id", "size"),
+                                       revenue=("revenue", "sum"))
+    print("RAW (no privacy):")
+    print(raw, "\n")
+
+    # ------------------------------------------------------------------
+    # Step 2: why dropping ids is not anonymization — a differencing
+    # attack: run the same query with and without one customer.
+    # ------------------------------------------------------------------
+    target = int(frame.customer_id.iloc[0])
+    without = frame[frame.customer_id != target]
+    diff = raw.views - without.groupby("product").size().reindex(
+        raw.index, fill_value=0)
+    print(f"DIFFERENCING ATTACK: query(all) - query(all minus customer "
+          f"{target}) reveals exactly their views:")
+    print(diff[diff > 0], "\n")
+
+    # ------------------------------------------------------------------
+    # Step 3: the differentially-private answer. The PrivateCollection
+    # guards the data: only DP aggregates can leave it, every aggregate is
+    # charged to one shared budget, and per-customer contributions are
+    # bounded before noise.
+    # ------------------------------------------------------------------
+    rows = list(frame.itertuples(index=False))
+    backend = pdp.LocalBackend()
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=args.epsilon,
+                                           total_delta=args.delta)
+    private = pdp.make_private(rows, backend, accountant,
+                               privacy_id_extractor=lambda r: r.customer_id)
+    public_products = sorted(frame["product"].unique())
+
+    dp_views = private.count(
+        pdp.CountParams(noise_kind=pdp.NoiseKind.GAUSSIAN,
+                        max_partitions_contributed=4,
+                        max_contributions_per_partition=6,
+                        partition_extractor=lambda r: r.product),
+        public_partitions=public_products)
+    # Revenue is a higher-sensitivity query: each contribution can move the
+    # answer by up to max_value. Bounding conversions per product at 2
+    # (customers rarely convert more) keeps the noise scale useful.
+    dp_revenue = private.sum(
+        pdp.SumParams(noise_kind=pdp.NoiseKind.GAUSSIAN,
+                      max_partitions_contributed=4,
+                      max_contributions_per_partition=2,
+                      min_value=0.0,
+                      max_value=120.0,
+                      partition_extractor=lambda r: r.product,
+                      value_extractor=lambda r: r.revenue),
+        public_partitions=public_products)
+    accountant.compute_budgets()  # budget split finalized; results readable
+    dp_views, dp_revenue = dict(dp_views), dict(dp_revenue)
+
+    print(f"DIFFERENTIALLY PRIVATE (eps={args.epsilon}, "
+          f"delta={args.delta}):")
+    for product in public_products:
+        print(f"  {product:8s} views={dp_views[product]:8.1f} "
+              f"(raw {raw.views[product]:5d})   "
+              f"revenue={dp_revenue[product]:9.1f} "
+              f"(raw {raw.revenue[product]:8.1f})")
+    print("\nView counts (low sensitivity: each customer moves a count by "
+          "at most a few) are recovered closely; revenue (each conversion "
+          "can move the sum by up to 120) carries visibly more noise — the "
+          "sensitivity/utility trade-off DP makes explicit. Either way the "
+          "differencing attack above now yields noise, not a customer's "
+          "journey.")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""Generates a synthetic customer-journeys CSV for the codelab.
+
+Counterpart of the reference's examples/codelab data generator: each row is
+one product VIEW event — (customer_id, product, views_price, converted) —
+where a customer may view several products and convert (purchase) on some.
+Written vectorized (numpy/pandas) rather than per-customer simulation.
+
+Usage:
+    python generate_customer_journeys.py --n_customers 1000 \\
+        --output customer_journeys.csv
+"""
+
+import argparse
+
+import numpy as np
+import pandas as pd
+
+PRODUCTS = {"jumper": 40.0, "t_shirt": 20.0, "socks": 5.0, "jeans": 70.0}
+
+
+def generate(n_customers: int, conversion_rate: float,
+             seed: int) -> pd.DataFrame:
+    rng = np.random.default_rng(seed)
+    # Each customer views 1-6 products (with repeats possible).
+    views_per_customer = rng.integers(1, 7, n_customers)
+    customer_id = np.repeat(np.arange(n_customers), views_per_customer)
+    n_rows = len(customer_id)
+    names = list(PRODUCTS)
+    product_idx = rng.choice(len(names), n_rows, p=[0.2, 0.4, 0.25, 0.15])
+    base = np.array([PRODUCTS[n] for n in names])[product_idx]
+    price = np.round(base * rng.uniform(1.0, 1.6, n_rows), 2)
+    converted = rng.random(n_rows) < conversion_rate
+    return pd.DataFrame({
+        "customer_id": customer_id,
+        "product": np.array(names)[product_idx],
+        "price": price,
+        "converted": converted.astype(int),
+    })
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--n_customers", type=int, default=1000)
+    parser.add_argument("--conversion_rate", type=float, default=0.2)
+    parser.add_argument("--random_seed", type=int, default=0)
+    parser.add_argument("--output", default="customer_journeys.csv")
+    args = parser.parse_args()
+    frame = generate(args.n_customers, args.conversion_rate,
+                     args.random_seed)
+    frame.to_csv(args.output, index=False)
+    print(f"wrote {len(frame)} journey events for "
+          f"{args.n_customers} customers -> {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -407,6 +407,52 @@ class TestDenseDistributedParity:
             # small partitions — a few % drift is expected.
             assert_reports_close(d, h, rel=0.05, abs_tol=0.05)
 
+    def test_noise_kind_sweep_parity(self):
+        # noise_kind varies per configuration; noise stds must follow each
+        # config's mechanism on both paths.
+        config = data_structures.MultiParameterConfiguration(
+            noise_kind=[pdp.NoiseKind.GAUSSIAN, pdp.NoiseKind.LAPLACE])
+        options = data_structures.UtilityAnalysisOptions(
+            epsilon=5,
+            delta=1e-5,
+            aggregate_params=_agg_params([pdp.Metrics.COUNT]),
+            multi_param_configuration=config)
+        public = ["pk0", "pk1", "pk2"]
+        dense, _ = analysis.perform_utility_analysis(
+            DATA, BACKEND, options, EXTRACTORS, public_partitions=public)
+        dist, _ = _run_distributed(DATA, options, EXTRACTORS, public)
+        dense = sorted(dense, key=lambda r: r.configuration_index)
+        dist = sorted(dist, key=lambda r: r.configuration_index)
+        assert dense[0].metric_errors[0].noise_kind == pdp.NoiseKind.GAUSSIAN
+        assert dense[1].metric_errors[0].noise_kind == pdp.NoiseKind.LAPLACE
+        assert (dense[0].metric_errors[0].noise_std !=
+                dense[1].metric_errors[0].noise_std)
+        for d, h in zip(dense, dist):
+            assert_reports_close(d, h, rel=1e-6, abs_tol=1e-9)
+
+    def test_pre_threshold_parity(self):
+        # pre_threshold shifts the selection curve; both paths must model it.
+        options = data_structures.UtilityAnalysisOptions(
+            epsilon=10,
+            delta=1e-5,
+            aggregate_params=_agg_params([pdp.Metrics.COUNT],
+                                         pre_threshold=10))
+        dense, _ = analysis.perform_utility_analysis(DATA, BACKEND, options,
+                                                     EXTRACTORS)
+        dist, _ = _run_distributed(DATA, options, EXTRACTORS)
+        d, h = list(dense)[0], list(dist)[0]
+        assert_reports_close(d, h, rel=0.05, abs_tol=0.05)
+        # 10 privacy ids per partition, pre_threshold=10: keep probability
+        # must be strictly below the unthresholded run's.
+        base_options = data_structures.UtilityAnalysisOptions(
+            epsilon=10,
+            delta=1e-5,
+            aggregate_params=_agg_params([pdp.Metrics.COUNT]))
+        base, _ = analysis.perform_utility_analysis(DATA, BACKEND,
+                                                    base_options, EXTRACTORS)
+        assert (d.partitions_info.kept_partitions.mean <
+                list(base)[0].partitions_info.kept_partitions.mean)
+
     def test_private_parity_large_partitions(self):
         # >100 privacy ids per partition: both paths use the moment-based
         # approximation → tighter agreement.

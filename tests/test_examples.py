@@ -25,6 +25,7 @@ EXAMPLES = [
     ],
     ["examples/restaurant_visits/run_private_api.py", "--rows", "1000"],
     ["examples/restaurant_visits/run_parameter_tuning.py", "--rows", "1000"],
+    ["examples/codelab/codelab.py"],
 ]
 
 
