@@ -215,7 +215,7 @@ def _windowed_keep_prob(mu, var, third, n_users, cfg: SweepConfigArrays, *,
     jax.jit,
     static_argnames=("n_partitions_total", "metric_codes", "public",
                      "config_chunk", "window", "partition_chunk",
-                     "return_per_partition"))
+                     "return_per_partition", "psum_axis"))
 def sweep_kernel(counts,
                  sums,
                  contributed,
@@ -228,15 +228,22 @@ def sweep_kernel(counts,
                  config_chunk: int = 8,
                  window: int = 64,
                  partition_chunk: int = 4096,
-                 return_per_partition: bool = True):
+                 return_per_partition: bool = True,
+                 psum_axis: Optional[str] = None):
     """The fused analysis sweep.
 
     Args:
       counts/sums/contributed: per-(privacy_id, partition) row arrays [N]
         (contribution count, value sum, partitions contributed by the id).
-      pk_idx: dense partition index per row [N], in [0, n_partitions_total).
+      pk_idx: dense partition index per row [N], in [0, n_partitions_total);
+        out-of-range indices (padding) contribute nothing.
       cfg: SweepConfigArrays with leading config axis K.
       metric_codes: static tuple of METRIC_CODES values, canonical order.
+      psum_axis: when run per-shard under shard_map over row-split inputs,
+        the mesh axis to psum the per-partition sufficient statistics over.
+        Every downstream quantity (keep probabilities, report rows, bucket
+        reduction) is a deterministic function of those sums — the sweep
+        draws no randomness — so it computes replicated on every shard.
       public: public-partition analysis (keep probability 1, empty-partition
         bookkeeping) vs private selection modeling.
 
@@ -253,8 +260,12 @@ def sweep_kernel(counts,
     seg = functools.partial(jax.ops.segment_sum,
                             num_segments=p_total,
                             indices_are_sorted=False)
-    n_users = seg(ones, pk_idx)
-    n_rows = seg(counts, pk_idx)
+
+    def globalize(x):
+        return x if psum_axis is None else jax.lax.psum(x, psum_axis)
+
+    n_users = globalize(seg(ones, pk_idx))
+    n_rows = globalize(seg(counts, pk_idx))
 
     metric_vals = []
     for code in metric_codes:
@@ -266,7 +277,8 @@ def sweep_kernel(counts,
             metric_vals.append(jnp.where(counts > 0, ones, 0.0))
     # Partition size (for the report histogram): first metric's raw sum,
     # privacy-id count for select-partitions analysis.
-    size = seg(metric_vals[0], pk_idx) if metric_codes else n_users
+    size = globalize(seg(metric_vals[0],
+                         pk_idx)) if metric_codes else n_users
     bounds = jnp.asarray(BUCKET_BOUNDS, dtype=f)
     bucket = jnp.clip(
         jnp.searchsorted(bounds, size, side="right") - 1, 0, N_BUCKETS - 1)
@@ -298,14 +310,15 @@ def sweep_kernel(counts,
                                          q,
                                          xp=jnp)  # [KC, N, 5]
             stats.append(jax.vmap(lambda t: seg(t, pk_idx))(terms))
-        stats = (jnp.stack(stats, axis=2) if stats else jnp.zeros(
+        stats = (globalize(jnp.stack(stats, axis=2)) if stats else jnp.zeros(
             (kc, p_total, 0, em.STAT_WIDTH), dtype=f))  # [KC, P, M, 5]
         if public:
             keep_prob = jnp.ones((kc, p_total), dtype=f)
             weight = keep_prob
         else:
             sel_terms = em.selection_moment_terms(q, xp=jnp)  # [KC, N, 3]
-            sel = jax.vmap(lambda t: seg(t, pk_idx))(sel_terms)  # [KC, P, 3]
+            sel = globalize(
+                jax.vmap(lambda t: seg(t, pk_idx))(sel_terms))  # [KC, P, 3]
             keep_prob = _windowed_keep_prob(sel[..., em.SEL_MU],
                                             sel[..., em.SEL_VAR],
                                             sel[..., em.SEL_SKEW3],
@@ -344,3 +357,72 @@ def sweep_kernel(counts,
         result["stats"] = unchunk(outs[2])
         result["keep_prob"] = unchunk(outs[3])
     return result
+
+
+def sharded_sweep(mesh,
+                  counts,
+                  sums,
+                  contributed,
+                  pk_idx,
+                  cfg: SweepConfigArrays,
+                  *,
+                  n_partitions_total: int,
+                  metric_codes: Tuple[int, ...],
+                  public: bool,
+                  return_per_partition: bool = True,
+                  config_chunk: int = 8):
+    """Multi-chip analysis sweep: rows split over a mesh, psum'd statistics.
+
+    BASELINE config 5's v5e-16 shape: each shard segment-sums its row split
+    into per-partition sufficient statistics, psums over ICI make them
+    global (three size-[P] psums for n_users/n_rows/size plus two
+    size-[config_chunk, P, ...] psums per config chunk), and the
+    (randomness-free) keep-probability and report phases run replicated —
+    results identical on every shard. Rows need no co-location (per-row
+    keep fractions depend only on each row's own n_partitions value,
+    computed at preaggregation).
+    """
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
+
+    n_shards = mesh.devices.size
+    n = len(counts)
+    pad = (-n) % n_shards
+
+    def pad_rows(a, fill=0):
+        return np.pad(np.asarray(a), (0, pad), constant_values=fill)
+
+    counts = pad_rows(counts)
+    sums = pad_rows(sums)
+    contributed = pad_rows(contributed)
+    # Out-of-range partition ids are dropped by segment_sum: padding rows
+    # contribute nothing.
+    pk_idx = pad_rows(pk_idx, n_partitions_total)
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    row_args = [
+        jax.device_put(jnp.asarray(a), sharding)
+        for a in (counts, sums, contributed, pk_idx)
+    ]
+    cfg = SweepConfigArrays(*[jnp.asarray(x) for x in cfg])
+
+    def per_shard(counts_s, sums_s, contributed_s, pk_s, cfg_r):
+        return sweep_kernel(counts_s,
+                            sums_s,
+                            contributed_s,
+                            pk_s,
+                            cfg_r,
+                            n_partitions_total=n_partitions_total,
+                            metric_codes=metric_codes,
+                            public=public,
+                            config_chunk=config_chunk,
+                            return_per_partition=return_per_partition,
+                            psum_axis=SHARD_AXIS)
+
+    fn = jax.shard_map(per_shard,
+                       mesh=mesh,
+                       in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                                 P(SHARD_AXIS), P()),
+                       out_specs=P(),
+                       check_vma=False)
+    return fn(*row_args, cfg)

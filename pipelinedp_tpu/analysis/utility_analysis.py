@@ -17,6 +17,7 @@ same error model (``analysis/error_model.py``):
 """
 
 import bisect
+import functools
 from typing import Any, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
@@ -109,15 +110,21 @@ def _perform_dense(col, engine, budget_accountant, options, data_extractors,
         }
         per_partition = []
     else:
-        out = kernels.sweep_kernel(
-            counts,
-            sums,
-            contributed,
-            pk_idx,
-            cfg,
-            n_partitions_total=len(keys),
-            metric_codes=tuple(kernels.METRIC_CODES[m] for m in metric_list),
-            public=public)
+        # Multi-chip sweep when the backend carries a mesh: rows split over
+        # it, per-partition sufficient statistics psum'd (BASELINE config
+        # 5's multi-chip shape). One call site for both paths.
+        mesh = getattr(engine._backend, "mesh", None)
+        sweep = (kernels.sweep_kernel if mesh is None else functools.partial(
+            kernels.sharded_sweep, mesh))
+        out = sweep(counts,
+                    sums,
+                    contributed,
+                    pk_idx,
+                    cfg,
+                    n_partitions_total=len(keys),
+                    metric_codes=tuple(kernels.METRIC_CODES[m]
+                                       for m in metric_list),
+                    public=public)
         per_partition = _dense_per_partition(out, keys, analyzer, public)
     reports = _build_reports(
         np.asarray(out["bucket_rows"], dtype=np.float64),

@@ -468,6 +468,43 @@ class TestDenseDistributedParity:
             abs_tol=0.01)
 
 
+class TestAnalysisSharded:
+    """The ε-sweep over an 8-device mesh: per-shard segment sums + one psum
+    must reproduce the single-device sweep exactly (the sweep draws no
+    randomness)."""
+
+    @pytest.mark.parametrize("public", [True, False])
+    def test_mesh_matches_single_device(self, public):
+        from pipelinedp_tpu.parallel import make_mesh
+        mesh = make_mesh(n_devices=8)
+        config = data_structures.MultiParameterConfiguration(
+            max_partitions_contributed=[1, 2, 3, 5],
+            max_contributions_per_partition=[1, 2, 4, 4])
+        options = data_structures.UtilityAnalysisOptions(
+            epsilon=10,
+            delta=1e-5,
+            aggregate_params=_agg_params(
+                [pdp.Metrics.COUNT, pdp.Metrics.SUM]),
+            multi_param_configuration=config)
+        publics = ["pk0", "pk1", "pk2"] if public else None
+        mesh_reports, mesh_pp = analysis.perform_utility_analysis(
+            DATA,
+            pdp.TPUBackend(mesh=mesh),
+            options,
+            EXTRACTORS,
+            public_partitions=publics)
+        single_reports, _ = analysis.perform_utility_analysis(
+            DATA, BACKEND, options, EXTRACTORS, public_partitions=publics)
+        mesh_reports = sorted(mesh_reports,
+                              key=lambda r: r.configuration_index)
+        single_reports = sorted(single_reports,
+                                key=lambda r: r.configuration_index)
+        assert len(mesh_reports) == 4
+        for m, s in zip(mesh_reports, single_reports):
+            assert_reports_close(m, s, rel=1e-9, abs_tol=1e-9)
+        assert len(list(mesh_pp)) == 3 * 4
+
+
 class TestAnalysisOnMultiProc:
     """The distributed analysis path through REAL process boundaries: the
     PerPartitionAnalyzer and its accumulators must pickle to workers and the
