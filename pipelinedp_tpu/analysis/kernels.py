@@ -370,7 +370,9 @@ def sharded_sweep(mesh,
                   metric_codes: Tuple[int, ...],
                   public: bool,
                   return_per_partition: bool = True,
-                  config_chunk: int = 8):
+                  config_chunk: int = 8,
+                  window: int = 64,
+                  partition_chunk: int = 4096):
     """Multi-chip analysis sweep: rows split over a mesh, psum'd statistics.
 
     BASELINE config 5's v5e-16 shape: each shard segment-sums its row split
@@ -416,6 +418,8 @@ def sharded_sweep(mesh,
                             metric_codes=metric_codes,
                             public=public,
                             config_chunk=config_chunk,
+                            window=window,
+                            partition_chunk=partition_chunk,
                             return_per_partition=return_per_partition,
                             psum_axis=SHARD_AXIS)
 

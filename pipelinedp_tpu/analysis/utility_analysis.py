@@ -56,7 +56,8 @@ def perform_utility_analysis(
         budget_accountant=budget_accountant, backend=backend)
     if isinstance(backend, pipeline_backend.LocalBackend):
         return _perform_dense(col, engine, budget_accountant, options,
-                              data_extractors, public_partitions)
+                              data_extractors, public_partitions,
+                              mesh=getattr(backend, "mesh", None))
     return _perform_distributed(col, backend, engine, budget_accountant,
                                 options, data_extractors, public_partitions)
 
@@ -67,7 +68,7 @@ def perform_utility_analysis(
 
 
 def _perform_dense(col, engine, budget_accountant, options, data_extractors,
-                   public_partitions):
+                   public_partitions, mesh=None):
     utility_analysis_engine._check_utility_analysis_params(
         options, data_extractors)
     analyzer = engine.request_budgets(options, public_partitions)
@@ -113,7 +114,6 @@ def _perform_dense(col, engine, budget_accountant, options, data_extractors,
         # Multi-chip sweep when the backend carries a mesh: rows split over
         # it, per-partition sufficient statistics psum'd (BASELINE config
         # 5's multi-chip shape). One call site for both paths.
-        mesh = getattr(engine._backend, "mesh", None)
         sweep = (kernels.sweep_kernel if mesh is None else functools.partial(
             kernels.sharded_sweep, mesh))
         out = sweep(counts,

@@ -20,7 +20,7 @@ users can feed integer/float arrays straight to executor.aggregate_arrays.
 """
 
 from dataclasses import dataclass
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -38,7 +38,8 @@ class EncodedData:
     pid: np.ndarray  # int32[n]
     pk: np.ndarray  # int32[n], -1 marks rows in no (public) partition
     values: np.ndarray  # float64[n] (or float64[n, d] for vector values)
-    partition_vocab: List[Any]  # partition id -> original partition key
+    # partition id -> original partition key (list or ndarray)
+    partition_vocab: Sequence[Any]
     n_privacy_ids: int
 
     @property
@@ -63,10 +64,10 @@ def _as_key_array(x) -> np.ndarray:
     return arr
 
 
-def factorize(raw: np.ndarray) -> Tuple[np.ndarray, List[Any]]:
+def factorize(raw: np.ndarray) -> Tuple[np.ndarray, Sequence[Any]]:
     """First-occurrence-order integer encoding of a key column (C speed).
 
-    Returns (codes int32[n], vocabulary list). None/NaN are ordinary keys
+    Returns (codes int32[n], vocabulary array). None/NaN are ordinary keys
     (use_na_sentinel=False) — a None partition key forms a partition, same
     as any dict-based grouping would. Falls back to np.unique (sorted
     vocabulary order — equally valid, ids are internal), and to a Python
@@ -74,16 +75,18 @@ def factorize(raw: np.ndarray) -> Tuple[np.ndarray, List[Any]]:
     """
     if _pd is not None:
         codes, uniques = _pd.factorize(raw, use_na_sentinel=False)
-        return codes.astype(np.int32), list(uniques)
+        # Keep the vocabulary as an array: boxing 10^6+ uniques into a
+        # Python list costs more than the factorization itself.
+        return codes.astype(np.int32), np.asarray(uniques)
     try:
         uniques, inverse = np.unique(raw, return_inverse=True)
-        return inverse.astype(np.int32), list(uniques)
+        return inverse.astype(np.int32), uniques
     except TypeError:  # unorderable mixed-type keys
         vocab: dict = {}
         codes = np.empty(len(raw), dtype=np.int32)
         for i, key in enumerate(raw):
             codes[i] = vocab.setdefault(key, len(vocab))
-        return codes, list(vocab)
+        return codes, np.fromiter(vocab, dtype=object, count=len(vocab))
 
 
 def encode_with_vocab(raw: np.ndarray, vocab: Sequence[Any]) -> np.ndarray:
