@@ -78,6 +78,14 @@ def factorize(raw: np.ndarray) -> Tuple[np.ndarray, Sequence[Any]]:
         # Keep the vocabulary as an array: boxing 10^6+ uniques into a
         # Python list costs more than the factorization itself.
         return codes.astype(np.int32), np.asarray(uniques)
+    # No pandas: the native open-addressing encoder handles fixed-width
+    # dtypes at ~5x np.unique's sort-based speed.
+    from pipelinedp_tpu import native
+    if not raw.dtype.hasobject:
+        encoded = native.vocab_encode(raw)
+        if encoded is not None:
+            codes, first_rows = encoded
+            return codes, raw[first_rows]
     try:
         uniques, inverse = np.unique(raw, return_inverse=True)
         return inverse.astype(np.int32), uniques

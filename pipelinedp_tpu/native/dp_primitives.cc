@@ -24,6 +24,7 @@
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 #if defined(__linux__)
 #include <sys/random.h>
@@ -457,6 +458,66 @@ void dpn_sample_keep(const double* probs, uint8_t* out, int64_t n) {
         static_cast<double>(rand_u64() >> 11) * 0x1.0p-53;
     out[i] = u < probs[i] ? 1 : 0;
   }
+}
+
+// --- Vocabulary encoding (columnar ingest) -------------------------------
+//
+// First-occurrence-order integer encoding of a column of fixed-width keys
+// (numpy '<U'/'S'/int rows viewed as raw bytes). One hash-map pass over
+// contiguous memory — the host-side bottleneck of billion-row ingest.
+// Returns the vocabulary size; codes[i] in [0, n_unique); first_rows holds,
+// for each code, the row index of its first occurrence (the caller gathers
+// the original keys from there).
+static inline uint64_t row_hash(const uint8_t* p, int64_t len) {
+  // 8-bytes-at-a-time mix (xxhash-style multiply-rotate).
+  uint64_t h = 0x9E3779B97F4A7C15ull;
+  int64_t i = 0;
+  for (; i + 8 <= len; i += 8) {
+    uint64_t w;
+    std::memcpy(&w, p + i, 8);
+    h = (h ^ w) * 0xFF51AFD7ED558CCDull;
+    h ^= h >> 33;
+  }
+  uint64_t tail = 0;
+  if (i < len) {
+    std::memcpy(&tail, p + i, static_cast<size_t>(len - i));
+    h = (h ^ tail) * 0xC4CEB9FE1A85EC53ull;
+    h ^= h >> 33;
+  }
+  return h;
+}
+
+int64_t dpn_vocab_encode(const uint8_t* data, int64_t itemsize, int64_t n,
+                         int32_t* codes, int64_t* first_rows) {
+  // Open-addressed table of codes (linear probing, pow2 capacity >= 2n):
+  // no per-key allocation, key bytes compared against their first
+  // occurrence in `data` itself.
+  uint64_t cap = 16;
+  while (cap < static_cast<uint64_t>(2 * n)) cap <<= 1;
+  const uint64_t mask = cap - 1;
+  std::vector<int32_t> slots(cap, -1);
+  int32_t next = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* key = data + i * itemsize;
+    uint64_t pos = row_hash(key, itemsize) & mask;
+    for (;;) {
+      int32_t code = slots[pos];
+      if (code < 0) {
+        slots[pos] = next;
+        first_rows[next] = i;
+        codes[i] = next;
+        ++next;
+        break;
+      }
+      if (std::memcmp(data + first_rows[code] * itemsize, key,
+                      static_cast<size_t>(itemsize)) == 0) {
+        codes[i] = code;
+        break;
+      }
+      pos = (pos + 1) & mask;
+    }
+  }
+  return next;
 }
 
 }  // extern "C"

@@ -200,3 +200,71 @@ class TestSecureNoiseMechanismIntegration:
             assert vals.std() == pytest.approx(mech.std, rel=0.15)
         finally:
             dp_computations.use_secure_noise(False)
+
+
+class TestVocabEncode:
+    """Native open-addressing vocabulary encoder (the ingest fallback when
+    pandas is absent; must agree with pandas.factorize exactly)."""
+
+    @pytest.mark.parametrize("make", [
+        lambda rng: np.char.add("key_",
+                                rng.integers(0, 500, 20_000).astype(str)),
+        lambda rng: rng.integers(-1000, 1000, 20_000),
+        lambda rng: rng.random(20_000).round(2),
+        lambda rng: np.char.add("k", rng.integers(0, 3, 17).astype(str)),
+    ])
+    def test_matches_pandas_factorize(self, make):
+        import pandas as pd
+        from pipelinedp_tpu import native
+        if not native.available():
+            pytest.skip("native library unavailable")
+        rng = np.random.default_rng(11)
+        arr = make(rng)
+        encoded = native.vocab_encode(arr)
+        assert encoded is not None
+        codes, first_rows = encoded
+        ref_codes, ref_uniques = pd.factorize(arr, use_na_sentinel=False)
+        np.testing.assert_array_equal(codes, ref_codes)
+        np.testing.assert_array_equal(arr[first_rows],
+                                      np.asarray(ref_uniques))
+
+    def test_rejects_object_dtype(self):
+        from pipelinedp_tpu import native
+        if not native.available():
+            pytest.skip("native library unavailable")
+        arr = np.array([("a", 1), ("b", 2), ("a", 1)], dtype=object)
+        assert native.vocab_encode(np.asarray(arr)) is None
+
+    def test_empty(self):
+        from pipelinedp_tpu import native
+        if not native.available():
+            pytest.skip("native library unavailable")
+        codes, first = native.vocab_encode(np.zeros(0, dtype=np.int64))
+        assert len(codes) == 0 and len(first) == 0
+
+    def test_factorize_without_pandas(self, monkeypatch):
+        # The columnar path must route through the native encoder when
+        # pandas is unavailable.
+        from pipelinedp_tpu import columnar, native
+        if not native.available():
+            pytest.skip("native library unavailable")
+        monkeypatch.setattr(columnar, "_pd", None)
+        arr = np.char.add("pk", np.arange(1000).astype(str))[
+            np.random.default_rng(0).integers(0, 1000, 5000)]
+        codes, vocab = columnar.factorize(arr)
+        assert (np.asarray(vocab)[codes] == arr).all()
+        # first-occurrence order preserved (native path, not sorted unique)
+        assert vocab[codes[0]] == arr[0]
+
+    def test_negative_zero_unified(self):
+        from pipelinedp_tpu import native
+        if not native.available():
+            pytest.skip("native library unavailable")
+        codes, first = native.vocab_encode(np.array([0.0, -0.0, 0.0, -0.0]))
+        assert list(codes) == [0, 0, 0, 0]
+
+    def test_nan_float_keys_fall_back(self):
+        from pipelinedp_tpu import native
+        if not native.available():
+            pytest.skip("native library unavailable")
+        assert native.vocab_encode(np.array([1.0, np.nan, 1.0])) is None
