@@ -230,6 +230,33 @@ def test_private_beam_combine_per_key():
         check("private_beam CombinePerKey", len(got) == 4)
 
 
+def test_private_contribution_bounds_on_beam():
+    # Reference parity: DP L0-bound calculation runs on Beam
+    # (/root/reference/tests/dp_engine_test.py
+    # test_calculate_private_contribution_works_on_beam).
+    backend = pipeline_backend.BeamBackend()
+    pipeline = beam.Pipeline()
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                           total_delta=1e-6)
+    engine = pdp.DPEngine(accountant, backend)
+    params = pdp.CalculatePrivateContributionBoundsParams(
+        aggregation_noise_kind=pdp.NoiseKind.LAPLACE,
+        aggregation_eps=1.0,
+        aggregation_delta=0.0,
+        calculation_eps=1.0,
+        max_partitions_contributed_upper_bound=8)
+    extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                    partition_extractor=lambda r: r[1],
+                                    value_extractor=lambda r: r[2])
+    partitions = pipeline | "bounds partitions" >> beam.Create(
+        [f"pk{i}" for i in range(4)])
+    result = engine.calculate_private_contribution_bounds(
+        pcol_of(pipeline, ROWS), params, extractors, partitions)
+    bounds = list(result)[0]
+    check("calculate_private_contribution_bounds on BeamBackend",
+          1 <= bounds.max_partitions_contributed <= 8)
+
+
 def test_utility_analysis_on_beam():
     from pipelinedp_tpu import analysis
     from pipelinedp_tpu.analysis import data_structures
@@ -262,5 +289,6 @@ if __name__ == "__main__":
     test_dp_engine_on_beam()
     test_private_beam_transforms()
     test_private_beam_combine_per_key()
+    test_private_contribution_bounds_on_beam()
     test_utility_analysis_on_beam()
     print("BEAM_CHECKS_PASSED")
