@@ -13,15 +13,31 @@ deliberately:
   * CoGroupByKey produces (key, {tag: [values]}), CombinePerKey takes a
     callable over the iterable of values, side inputs arrive as extra args.
 
-Execution is eager over Python lists — a DirectRunner without the runner.
+Execution is eager over Python lists — a DirectRunner without the runner —
+with one worker-boundary fidelity guarantee: every user closure is shipped
+through cloudpickle (what Beam's pickler does at job submission) before it
+runs, so a lambda/combiner that could not survive the driver->worker hop on
+a real cluster fails here too. Shipping happens at thunk-execution time,
+matching real timing: pipeline.run() (hence serialization) occurs after
+budget_accountant.compute_budgets(), so shipped MechanismSpec copies carry
+finalized eps/delta and late mutation of driver-side objects is NOT visible
+to workers.
 """
 
 import random as _random
+
+import cloudpickle as _cloudpickle
 
 from apache_beam import io
 from apache_beam import pvalue
 from apache_beam.pvalue import PCollection
 from apache_beam.transforms.ptransform import PTransform
+
+
+def _ship(obj):
+    """Simulate the driver->worker serialization boundary (closures AND
+    side-input values both cross it on a real runner)."""
+    return _cloudpickle.loads(_cloudpickle.dumps(obj))
 
 
 class _PipelineResult:
@@ -98,9 +114,13 @@ class Map(PTransform):
         self._fn, self._sides = fn, sides
 
     def expand(self, pcoll):
-        return _out(pcoll, lambda: [
-            self._fn(x, *_resolve_sides(self._sides)) for x in _data(pcoll)
-        ])
+
+        def thunk():
+            fn = _ship(self._fn)
+            sides = _ship(_resolve_sides(self._sides))
+            return [fn(x, *sides) for x in _data(pcoll)]
+
+        return _out(pcoll, thunk)
 
 
 class MapTuple(PTransform):
@@ -110,7 +130,12 @@ class MapTuple(PTransform):
         self._fn = fn
 
     def expand(self, pcoll):
-        return _out(pcoll, lambda: [self._fn(*x) for x in _data(pcoll)])
+
+        def thunk():
+            fn = _ship(self._fn)
+            return [fn(*x) for x in _data(pcoll)]
+
+        return _out(pcoll, thunk)
 
 
 class FlatMap(PTransform):
@@ -122,10 +147,11 @@ class FlatMap(PTransform):
     def expand(self, pcoll):
 
         def thunk():
-            sides = _resolve_sides(self._sides)
+            fn = _ship(self._fn)
+            sides = _ship(_resolve_sides(self._sides))
             out = []
             for x in _data(pcoll):
-                out.extend(self._fn(x, *sides))
+                out.extend(fn(x, *sides))
             return out
 
         return _out(pcoll, thunk)
@@ -138,8 +164,12 @@ class Filter(PTransform):
         self._fn = fn
 
     def expand(self, pcoll):
-        return _out(pcoll,
-                    lambda: [x for x in _data(pcoll) if self._fn(x)])
+
+        def thunk():
+            fn = _ship(self._fn)
+            return [x for x in _data(pcoll) if fn(x)]
+
+        return _out(pcoll, thunk)
 
 
 class GroupByKey(PTransform):
@@ -218,9 +248,10 @@ class ParDo(PTransform):
     def expand(self, pcoll):
 
         def thunk():
+            dofn = _ship(self._dofn)
             out = []
             for x in _data(pcoll):
-                result = self._dofn.process(x)
+                result = dofn.process(x)
                 if result is not None:
                     out.extend(result)
             return out
@@ -238,10 +269,11 @@ class CombinePerKey(PTransform):
     def expand(self, pcoll):
 
         def thunk():
+            fn = _ship(self._fn)
             grouped = {}
             for k, v in _data(pcoll):
                 grouped.setdefault(k, []).append(v)
-            return [(k, self._fn(vs)) for k, vs in grouped.items()]
+            return [(k, fn(vs)) for k, vs in grouped.items()]
 
         return _out(pcoll, thunk)
 

@@ -6,9 +6,22 @@ adapters use, executing eagerly over Python lists — local[1] without the
 JVM. groupByKey values are one-shot iterables (like Spark's ResultIterable
 consumers must list() them), join has inner-join semantics, and union
 concatenates.
+
+Worker-boundary fidelity: every closure handed to a transformation is
+shipped through cloudpickle (PySpark's own closure serializer) when the
+thunk runs — i.e. at action time, after compute_budgets() in correct DP
+usage — so closures that could not reach a real executor fail here too,
+and workers observe a COPY of captured driver objects, not live references.
 """
 
 import random as _random
+
+import cloudpickle as _cloudpickle
+
+
+def _ship(fn):
+    """Simulate the driver->executor serialization boundary."""
+    return _cloudpickle.loads(_cloudpickle.dumps(fn))
 
 
 class ResultIterable:
@@ -49,27 +62,39 @@ class RDD:
         return self.ctx
 
     def map(self, fn):
-        return RDD(lambda: [fn(x) for x in self._data], self.ctx)
+
+        def thunk():
+            f = _ship(fn)
+            return [f(x) for x in self._data]
+
+        return RDD(thunk, self.ctx)
 
     def flatMap(self, fn):
 
         def thunk():
+            f = _ship(fn)
             out = []
             for x in self._data:
-                out.extend(fn(x))
+                out.extend(f(x))
             return out
 
         return RDD(thunk, self.ctx)
 
     def mapValues(self, fn):
-        return RDD(lambda: [(k, fn(v)) for k, v in self._data], self.ctx)
+
+        def thunk():
+            f = _ship(fn)
+            return [(k, f(v)) for k, v in self._data]
+
+        return RDD(thunk, self.ctx)
 
     def flatMapValues(self, fn):
 
         def thunk():
+            f = _ship(fn)
             out = []
             for k, v in self._data:
-                out.extend((k, w) for w in fn(v))
+                out.extend((k, w) for w in f(v))
             return out
 
         return RDD(thunk, self.ctx)
@@ -86,7 +111,12 @@ class RDD:
         return RDD(thunk, self.ctx)
 
     def filter(self, fn):
-        return RDD(lambda: [x for x in self._data if fn(x)], self.ctx)
+
+        def thunk():
+            f = _ship(fn)
+            return [x for x in self._data if f(x)]
+
+        return RDD(thunk, self.ctx)
 
     def join(self, other):
 
@@ -111,9 +141,10 @@ class RDD:
     def reduceByKey(self, fn):
 
         def thunk():
+            f = _ship(fn)
             grouped = {}
             for k, v in self._data:
-                grouped[k] = fn(grouped[k], v) if k in grouped else v
+                grouped[k] = f(grouped[k], v) if k in grouped else v
             return list(grouped.items())
 
         return RDD(thunk, self.ctx)

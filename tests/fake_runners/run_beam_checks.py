@@ -283,6 +283,34 @@ def test_utility_analysis_on_beam():
           len(list(per_partition)) == 4)
 
 
+def test_worker_serialization_boundary():
+    """The fake runner ships every closure through cloudpickle; prove the
+    boundary is real: unserializable closures fail, and workers operate on
+    COPIES of captured driver objects (so driver-side mutation after the
+    ship is invisible — the reason compute_budgets() must precede run())."""
+    import threading
+    pipeline = beam.Pipeline()
+    lock = threading.Lock()  # not serializable, even by cloudpickle
+    pcol = pcol_of(pipeline, [1, 2, 3])
+    bad = pcol | "capture lock" >> beam.Map(lambda x: (lock, x)[1])
+    try:
+        list(bad._data)
+        check("unserializable closure rejected at the worker boundary",
+              False)
+    except TypeError:
+        check("unserializable closure rejected at the worker boundary",
+              True)
+
+    pipeline2 = beam.Pipeline()
+    driver_side = []  # captured by the closure; workers get a copy
+    pcol2 = pcol_of(pipeline2, [1, 2, 3])
+    out = pcol2 | "append" >> beam.Map(
+        lambda x: (driver_side.append(x), x)[1])
+    result = list(out._data)
+    check("workers mutate a shipped COPY, not the driver object",
+          result == [1, 2, 3] and driver_side == [])
+
+
 if __name__ == "__main__":
     test_backend_ops_match_local()
     test_duplicate_labels_raise()
@@ -291,4 +319,5 @@ if __name__ == "__main__":
     test_private_beam_combine_per_key()
     test_private_contribution_bounds_on_beam()
     test_utility_analysis_on_beam()
+    test_worker_serialization_boundary()
     print("BEAM_CHECKS_PASSED")

@@ -186,9 +186,31 @@ def test_utility_analysis_on_spark():
           len(per_partition.collect()) == 4)
 
 
+def test_executor_serialization_boundary():
+    """Closures ship through cloudpickle: unserializable ones fail, and
+    executors operate on copies of captured driver objects."""
+    import threading
+    lock = threading.Lock()
+    bad = SC.parallelize([1, 2, 3]).map(lambda x: (lock, x)[1])
+    try:
+        bad.collect()
+        check("unserializable closure rejected at the executor boundary",
+              False)
+    except TypeError:
+        check("unserializable closure rejected at the executor boundary",
+              True)
+
+    driver_side = []
+    out = SC.parallelize([1, 2, 3]).map(
+        lambda x: (driver_side.append(x), x)[1]).collect()
+    check("executors mutate a shipped COPY, not the driver object",
+          out == [1, 2, 3] and driver_side == [])
+
+
 if __name__ == "__main__":
     test_backend_ops_match_local()
     test_dp_engine_on_spark()
     test_private_rdd()
     test_utility_analysis_on_spark()
+    test_executor_serialization_boundary()
     print("SPARK_CHECKS_PASSED")

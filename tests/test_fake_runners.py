@@ -39,6 +39,8 @@ def test_beam_adapter_executes_on_fake_runner():
     assert "ok: private_beam Count/Sum" in out
     assert "ok: duplicate label raises" in out
     assert "ok: utility analysis on BeamBackend" in out
+    assert "ok: unserializable closure rejected at the worker boundary" in out
+    assert "ok: workers mutate a shipped COPY, not the driver object" in out
 
 
 def test_spark_adapter_executes_on_fake_runner():
@@ -46,3 +48,6 @@ def test_spark_adapter_executes_on_fake_runner():
     assert "ok: DPEngine.aggregate on SparkRDDBackend" in out
     assert "ok: PrivateRDD count/sum" in out
     assert "ok: utility analysis on SparkRDDBackend" in out
+    assert ("ok: unserializable closure rejected at the executor boundary"
+            in out)
+    assert "ok: executors mutate a shipped COPY, not the driver object" in out
