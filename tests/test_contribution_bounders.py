@@ -1,0 +1,262 @@
+"""Direct tests for contribution bounders, sampling utils, and reports.
+
+Mirrors the reference's dedicated per-module suites
+(tests/contribution_bounders_test.py, tests/sampling_utils_test.py,
+tests/report_generator_test.py): each bounding strategy is driven directly
+through LocalBackend with a transparent aggregate_fn, so the sampling
+semantics (what is kept, what is dropped, what reaches the aggregator) are
+asserted without engine noise on top.
+"""
+
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import contribution_bounders, report_generator
+from pipelinedp_tpu import sampling_utils
+
+
+def _params(l0=None, linf=None, max_contributions=None):
+    if l0 is not None and linf is None and max_contributions is None:
+        # Per-partition-SUM-clipping form: the engine routes these params to
+        # SamplingCrossPartitionContributionBounder, which reads only L0
+        # (Linf is enforced by the combiner via sum clipping).
+        return pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                   noise_kind=pdp.NoiseKind.GAUSSIAN,
+                                   max_partitions_contributed=l0,
+                                   max_contributions_per_partition=1,
+                                   min_sum_per_partition=0.0,
+                                   max_sum_per_partition=100.0)
+    return pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT],
+        noise_kind=pdp.NoiseKind.GAUSSIAN,
+        max_partitions_contributed=l0,
+        max_contributions_per_partition=linf,
+        max_contributions=max_contributions)
+
+
+def _bound(bounder, rows, params, aggregate_fn=list):
+    backend = pdp.LocalBackend(seed=7)
+    report = report_generator.ReportGenerator(params, "test")
+    out = bounder.bound_contributions(rows, params, backend, report,
+                                      aggregate_fn)
+    return list(out), report
+
+
+class TestSamplingCrossAndPerPartition:
+    BOUNDER = contribution_bounders.SamplingCrossAndPerPartitionContributionBounder
+
+    def test_empty_collection(self):
+        out, _ = _bound(self.BOUNDER(), [], _params(l0=2, linf=2))
+        assert out == []
+
+    def test_within_bounds_nothing_dropped(self):
+        rows = [("u1", "A", 1.0), ("u1", "B", 2.0), ("u2", "A", 3.0)]
+        out, _ = _bound(self.BOUNDER(), rows, _params(l0=2, linf=2),
+                        aggregate_fn=sum)
+        assert sorted(out) == [(("u1", "A"), 1.0), (("u1", "B"), 2.0),
+                               (("u2", "A"), 3.0)]
+
+    def test_per_partition_bound_applied(self):
+        # One user, 5 identical contributions to one partition, linf=2:
+        # exactly 2 survive regardless of which are sampled.
+        rows = [("u1", "A", 3.0)] * 5
+        out, _ = _bound(self.BOUNDER(), rows, _params(l0=1, linf=2),
+                        aggregate_fn=sum)
+        assert out == [(("u1", "A"), 6.0)]
+
+    def test_cross_partition_bound_applied(self):
+        # One user in 6 partitions, l0=2: exactly 2 (pid, pk) pairs remain,
+        # each with its full (single) contribution.
+        rows = [("u1", f"pk{i}", 1.0) for i in range(6)]
+        out, _ = _bound(self.BOUNDER(), rows, _params(l0=2, linf=4),
+                        aggregate_fn=sum)
+        assert len(out) == 2
+        assert all(pid == "u1" and acc == 1.0 for (pid, _), acc in out)
+        kept_pks = {pk for (_, pk), _ in out}
+        assert kept_pks <= {f"pk{i}" for i in range(6)}
+        assert len(kept_pks) == 2
+
+    def test_aggregate_fn_sees_value_lists(self):
+        rows = [("u1", "A", 1.0), ("u1", "A", 2.0)]
+        out, _ = _bound(self.BOUNDER(), rows, _params(l0=1, linf=5),
+                        aggregate_fn=lambda vals: sorted(vals))
+        assert out == [(("u1", "A"), [1.0, 2.0])]
+
+    def test_report_stages_narrate_both_bounds(self):
+        _, report = _bound(self.BOUNDER(), [("u1", "A", 1.0)],
+                           _params(l0=3, linf=4))
+        text = report.report()
+        assert "Per-partition contribution bounding" in text
+        assert "Cross-partition contribution bounding" in text
+
+
+class TestSamplingPerPrivacyId:
+    BOUNDER = contribution_bounders.SamplingPerPrivacyIdContributionBounder
+
+    def test_empty_collection(self):
+        out, _ = _bound(self.BOUNDER(), [], _params(max_contributions=3))
+        assert out == []
+
+    def test_within_bounds_nothing_dropped(self):
+        rows = [("u1", "A", 1.0), ("u1", "B", 2.0), ("u2", "A", 3.0)]
+        out, _ = _bound(self.BOUNDER(), rows, _params(max_contributions=3),
+                        aggregate_fn=sum)
+        assert sorted(out) == [(("u1", "A"), 1.0), (("u1", "B"), 2.0),
+                               (("u2", "A"), 3.0)]
+
+    def test_total_bound_applied_across_partitions(self):
+        # 8 identical-value contributions spread over 4 partitions with
+        # max_contributions=3: exactly 3 values total survive.
+        rows = [("u1", f"pk{i % 4}", 1.0) for i in range(8)]
+        out, _ = _bound(self.BOUNDER(), rows, _params(max_contributions=3),
+                        aggregate_fn=sum)
+        assert sum(acc for _, acc in out) == 3.0
+        assert all(pid == "u1" for (pid, _), _ in out)
+
+    def test_report_stage(self):
+        _, report = _bound(self.BOUNDER(), [("u1", "A", 1.0)],
+                           _params(max_contributions=5))
+        assert "not more than 5 contributions" in report.report()
+
+
+class TestSamplingCrossPartition:
+    BOUNDER = contribution_bounders.SamplingCrossPartitionContributionBounder
+
+    def test_empty_collection(self):
+        out, _ = _bound(self.BOUNDER(), [], _params(l0=2))
+        assert out == []
+
+    def test_l0_applied_values_within_partition_untouched(self):
+        # L0-only strategy: kept partitions retain ALL their values (the
+        # combiner is responsible for Linf via sum clipping).
+        rows = [("u1", "A", 1.0)] * 4 + [("u1", "B", 2.0)] * 4 + [
+            ("u1", "C", 3.0)
+        ] * 4
+        out, _ = _bound(self.BOUNDER(), rows, _params(l0=2), aggregate_fn=sum)
+        assert len(out) == 2
+        per_pk = {"A": 4.0, "B": 8.0, "C": 12.0}
+        for (pid, pk), acc in out:
+            assert pid == "u1"
+            assert acc == per_pk[pk]
+
+
+class TestChooseFromListWithoutReplacement:
+
+    @pytest.mark.parametrize("n,size", [(0, 3), (2, 3), (3, 3)])
+    def test_small_input_returned_unchanged(self, n, size):
+        a = list(range(n))
+        assert sampling_utils.choose_from_list_without_replacement(
+            a, size) is a
+
+    @pytest.mark.parametrize("n,size", [(10, 1), (10, 5), (100, 99)])
+    def test_samples_exactly_size_distinct_elements(self, n, size):
+        a = list(range(n))
+        out = sampling_utils.choose_from_list_without_replacement(a, size)
+        assert len(out) == size
+        assert len(set(out)) == size
+        assert set(out) <= set(a)
+
+    def test_preserves_python_element_types(self):
+        # The reference samples indices, not elements, so tuples survive as
+        # tuples (not converted to numpy arrays/scalars).
+        a = [("pk1", [1.0]), ("pk2", [2.0]), ("pk3", [3.0]),
+             ("pk4", [4.0])]
+        out = sampling_utils.choose_from_list_without_replacement(a, 2)
+        assert all(isinstance(x, tuple) and isinstance(x[0], str) for x in out)
+
+    def test_seeded_rng_is_deterministic(self):
+        import numpy as np
+        a = list(range(50))
+        out1 = sampling_utils.choose_from_list_without_replacement(
+            a, 10, rng=np.random.default_rng(3))
+        out2 = sampling_utils.choose_from_list_without_replacement(
+            a, 10, rng=np.random.default_rng(3))
+        assert out1 == out2
+
+
+class TestValueSampler:
+
+    def test_rate_one_keeps_everything(self):
+        sampler = sampling_utils.ValueSampler(1.0)
+        assert all(sampler.keep(v) for v in range(200))
+
+    def test_rate_zero_keeps_nothing(self):
+        sampler = sampling_utils.ValueSampler(0.0)
+        assert not any(sampler.keep(v) for v in range(200))
+
+    def test_deterministic_across_instances(self):
+        kept1 = [sampling_utils.ValueSampler(0.5).keep(v) for v in range(100)]
+        kept2 = [sampling_utils.ValueSampler(0.5).keep(v) for v in range(100)]
+        assert kept1 == kept2
+
+    def test_empirical_rate_close_to_nominal(self):
+        sampler = sampling_utils.ValueSampler(0.3)
+        kept = sum(sampler.keep(v) for v in range(20_000))
+        # SHA1-hash keep decisions behave like iid Bernoulli(0.3):
+        # 6 sigma = 6 * sqrt(.3 * .7 * 20000) ~ 389.
+        assert abs(kept - 6000) < 400
+
+
+class TestReportGenerator:
+
+    def test_no_params_renders_empty(self):
+        report = report_generator.ReportGenerator(None, "aggregate")
+        report.add_stage("never shown")
+        assert report.report() == ""
+
+    def test_stages_numbered_in_order(self):
+        params = _params(l0=2, linf=1)
+        report = report_generator.ReportGenerator(params, "aggregate", True)
+        report.add_stage("first stage")
+        report.add_stage("second stage")
+        text = report.report()
+        assert "DPEngine method: aggregate" in text
+        assert text.index(" 1. first stage") < text.index(" 2. second stage")
+
+    def test_lazy_stage_resolved_at_report_time(self):
+        params = _params(l0=1, linf=1)
+        report = report_generator.ReportGenerator(params, "aggregate", True)
+        box = {"eps": None}
+        report.add_stage(lambda: f"noise with eps={box['eps']}")
+        box["eps"] = 0.5  # simulates compute_budgets() filling the spec
+        assert "noise with eps=0.5" in report.report()
+
+
+class TestExplainComputationReport:
+
+    def test_text_before_aggregation_raises(self):
+        out = report_generator.ExplainComputationReport()
+        with pytest.raises(ValueError, match="not set"):
+            out.text()
+
+    def test_failing_lazy_stage_points_at_compute_budgets(self):
+        params = _params(l0=1, linf=1)
+        gen = report_generator.ReportGenerator(params, "aggregate", True)
+
+        def boom():
+            raise AssertionError("budget not computed")
+
+        gen.add_stage(boom)
+        out = report_generator.ExplainComputationReport()
+        out._set_report_generator(gen)
+        with pytest.raises(ValueError, match="compute_budgets"):
+            out.text()
+
+    def test_end_to_end_through_engine(self):
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                               total_delta=1e-6)
+        engine = pdp.DPEngine(accountant, pdp.LocalBackend(seed=0))
+        extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                        partition_extractor=lambda r: r[1],
+                                        value_extractor=lambda r: r[2])
+        out = report_generator.ExplainComputationReport()
+        result = engine.aggregate([("u1", "A", 1.0), ("u2", "A", 2.0)],
+                                  _params(l0=1, linf=1),
+                                  extractors,
+                                  public_partitions=["A"],
+                                  out_explain_computation_report=out)
+        accountant.compute_budgets()
+        list(result)
+        text = out.text()
+        assert "DPEngine method: aggregate" in text
+        assert "Computation graph" in text

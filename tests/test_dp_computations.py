@@ -236,3 +236,251 @@ class TestExponentialMechanism:
     def test_apply_returns_input_element(self):
         mech = dp.ExponentialMechanism(self._Scoring())
         assert mech.apply(10.0, [1, 2, 50]) in (1, 2, 50)
+
+
+class TestPerMetricSensitivitiesMaxContributions:
+    """max_contributions (total-bound) sensitivity derivations
+    (reference dp_computations.py:719-761 max_contributions branches)."""
+
+    def _params(self, metrics, **kw):
+        return pdp.AggregateParams(metrics=metrics,
+                                   noise_kind=pdp.NoiseKind.GAUSSIAN,
+                                   max_contributions=6,
+                                   **kw)
+
+    def test_count(self):
+        s = dp.compute_sensitivities_for_count(
+            self._params([pdp.Metrics.COUNT]))
+        assert (s.l1, s.l2) == (6, 6)
+        assert s.l0 is None and s.linf is None
+
+    def test_privacy_id_count(self):
+        s = dp.compute_sensitivities_for_privacy_id_count(
+            self._params([pdp.Metrics.PRIVACY_ID_COUNT]))
+        assert s.l1 == 6
+        assert s.l2 == pytest.approx(math.sqrt(6))
+
+    def test_sum(self):
+        s = dp.compute_sensitivities_for_sum(
+            self._params([pdp.Metrics.SUM], min_value=-2.0, max_value=1.0))
+        # max_abs_value = 2, times max_contributions = 6.
+        assert s.l1 == s.l2 == pytest.approx(12.0)
+
+    def test_normalized_sum(self):
+        s = dp.compute_sensitivities_for_normalized_sum(
+            self._params([pdp.Metrics.MEAN], min_value=0.0, max_value=10.0))
+        # (max-min)/2 = 5, times max_contributions = 6.
+        assert s.l1 == s.l2 == pytest.approx(30.0)
+
+
+class TestPerMetricSensitivitiesSumRegimes:
+
+    def test_sum_per_partition_bounds(self):
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                     noise_kind=pdp.NoiseKind.LAPLACE,
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=1,
+                                     min_sum_per_partition=-4.0,
+                                     max_sum_per_partition=2.0)
+        s = dp.compute_sensitivities_for_sum(params)
+        # Linf = max(|-4|, |2|) = 4, independent of contributions count.
+        assert (s.l0, s.linf) == (3, 4.0)
+        assert s.l1 == pytest.approx(12.0)
+        assert s.l2 == pytest.approx(math.sqrt(3) * 4.0)
+
+    def test_sum_value_bounds(self):
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                     noise_kind=pdp.NoiseKind.LAPLACE,
+                                     max_partitions_contributed=2,
+                                     max_contributions_per_partition=5,
+                                     min_value=-1.0,
+                                     max_value=3.0)
+        s = dp.compute_sensitivities_for_sum(params)
+        assert (s.l0, s.linf) == (2, 15.0)  # 3 * 5
+
+
+class TestMechanismFactories:
+    """create_additive_mechanism dispatch over spec state
+    (reference dp_computations.py:622-659)."""
+
+    def test_laplace_from_epsilon(self):
+        spec = MechanismSpec(MechanismType.LAPLACE)
+        spec.set_eps_delta(0.5, None)
+        mech = dp.create_additive_mechanism(spec, dp.Sensitivities(l0=2,
+                                                                   linf=3))
+        assert isinstance(mech, dp.LaplaceMechanism)
+        assert mech.noise_parameter == pytest.approx(6 / 0.5)  # l1/eps
+        assert mech.std == pytest.approx(math.sqrt(2) * 12.0)
+        assert mech.sensitivity == 6
+
+    def test_laplace_from_stddev(self):
+        spec = MechanismSpec(MechanismType.LAPLACE)
+        spec.set_noise_standard_deviation(3.0)  # normalized by l1
+        mech = dp.create_additive_mechanism(spec, dp.Sensitivities(l1=2.0))
+        assert isinstance(mech, dp.LaplaceMechanism)
+        # b = normalized_stddev/sqrt(2); eps = 1/b (per-unit-sensitivity).
+        assert mech.epsilon == pytest.approx(math.sqrt(2) / 3.0)
+
+    def test_laplace_requires_l1(self):
+        spec = MechanismSpec(MechanismType.LAPLACE)
+        spec.set_eps_delta(1.0, None)
+        with pytest.raises(ValueError, match="L1"):
+            dp.create_additive_mechanism(spec, dp.Sensitivities(l2=1.0))
+
+    def test_gaussian_from_epsilon_delta(self):
+        spec = MechanismSpec(MechanismType.GAUSSIAN)
+        spec.set_eps_delta(1.0, 1e-6)
+        mech = dp.create_additive_mechanism(spec, dp.Sensitivities(l0=4,
+                                                                   linf=1))
+        assert isinstance(mech, dp.GaussianMechanism)
+        assert mech.sensitivity == pytest.approx(2.0)  # sqrt(4)*1
+        # Analytic sigma satisfies the (eps, delta) constraint tightly.
+        assert dp.gaussian_delta(mech.std, 1.0, 2.0) <= 1e-6 * (1 + 1e-6)
+
+    def test_gaussian_from_stddev(self):
+        spec = MechanismSpec(MechanismType.GAUSSIAN)
+        spec.set_noise_standard_deviation(1.5)
+        mech = dp.create_additive_mechanism(spec, dp.Sensitivities(l2=2.0))
+        assert mech.std == pytest.approx(3.0)  # normalized 1.5 * l2 2.0
+
+    def test_gaussian_requires_l2(self):
+        spec = MechanismSpec(MechanismType.GAUSSIAN)
+        spec.set_eps_delta(1.0, 1e-6)
+        with pytest.raises(ValueError, match="L2"):
+            dp.create_additive_mechanism(spec, dp.Sensitivities(l1=1.0))
+
+    def test_describe_strings(self):
+        lap = dp.LaplaceMechanism.create_from_epsilon(2.0, 3.0)
+        assert "Laplace mechanism" in lap.describe()
+        assert "eps=2.0" in lap.describe()
+        gau = dp.GaussianMechanism.create_from_epsilon_delta(1.0, 1e-6, 1.0)
+        assert "Gaussian mechanism" in gau.describe()
+        assert "delta=1e-06" in gau.describe()
+
+
+class TestMeanMechanismEdgeCases:
+
+    def _mech(self, count_std=0.0, sum_std=0.0):
+
+        class _Fixed(dp.AdditiveMechanism):
+            """Deterministic mechanism: adds a constant 'noise' offset."""
+
+            def __init__(self, offset):
+                self._offset = offset
+
+            def add_noise(self, value):
+                return float(value) + self._offset
+
+            @property
+            def noise_kind(self):
+                return pdp.NoiseKind.LAPLACE
+
+            @property
+            def noise_parameter(self):
+                return 0.0
+
+            @property
+            def std(self):
+                return 0.0
+
+            @property
+            def sensitivity(self):
+                return 1.0
+
+            def describe(self):
+                return "fixed"
+
+        return dp.MeanMechanism(5.0, _Fixed(count_std), _Fixed(sum_std))
+
+    def test_negative_dp_count_clamped_in_denominator(self):
+        # DP count can come out negative; the denominator clamps at 1 so the
+        # mean stays finite (reference MeanMechanism semantics).
+        mech = self._mech(count_std=-10.0)  # count 2 -> dp_count -8
+        dp_count, dp_sum, dp_mean = mech.compute_mean(2, 4.0)
+        assert dp_count == -8.0
+        assert dp_mean == pytest.approx(5.0 + 4.0 / 1.0)
+        assert dp_sum == pytest.approx(dp_mean * dp_count)
+
+    def test_zero_noise_recovers_exact_mean(self):
+        mech = self._mech()
+        # values [4, 6, 8] around middle 5: normalized_sum = 3.
+        dp_count, dp_sum, dp_mean = mech.compute_mean(3, 3.0)
+        assert (dp_count, dp_mean) == (3.0, 6.0)
+        assert dp_sum == pytest.approx(18.0)
+
+    def test_describe_narrates_both_mechanisms(self):
+        text = self._mech().describe()
+        assert "normalized_sum" in text
+        assert "'count'" in text
+
+
+class TestComputeDpVarEdgeCases:
+
+    def test_equal_min_max_returns_min_value_mean(self):
+        params = dp.ScalarNoiseParams(eps=1e6,
+                                      delta=1e-8,
+                                      min_value=7.0,
+                                      max_value=7.0,
+                                      min_sum_per_partition=None,
+                                      max_sum_per_partition=None,
+                                      max_partitions_contributed=1,
+                                      max_contributions_per_partition=1,
+                                      noise_kind=pdp.NoiseKind.GAUSSIAN)
+        dp_count, dp_sum, dp_mean, dp_var = dp.compute_dp_var(
+            4, 0.0, 0.0, params)
+        # All values pinned at 7: mean = middle + 0 = 7, variance ~ 0.
+        assert dp_count == pytest.approx(4, abs=1e-2)
+        assert dp_mean == pytest.approx(7.0, abs=1e-2)
+        assert dp_var == pytest.approx(0.0, abs=1e-2)
+
+
+class TestExponentialMechanismSelection:
+
+    class _TableScore(dp.ExponentialMechanism.ScoringFunction):
+
+        def __init__(self, table, monotonic=True):
+            self._table = table
+            self._monotonic = monotonic
+
+        def score(self, k):
+            return self._table[k]
+
+        @property
+        def global_sensitivity(self):
+            return 1.0
+
+        @property
+        def is_monotonic(self):
+            return self._monotonic
+
+    def test_dominant_score_always_chosen(self):
+        table = {"a": 0.0, "b": 1000.0, "c": 1.0}
+        mech = dp.ExponentialMechanism(self._TableScore(table))
+        assert all(
+            mech.apply(10.0, list(table)) == "b" for _ in range(50))
+
+    def test_constant_scores_reach_all_elements(self):
+        table = {k: 1.0 for k in "abcd"}
+        mech = dp.ExponentialMechanism(self._TableScore(table))
+        seen = {mech.apply(1.0, list(table)) for _ in range(400)}
+        assert seen == set("abcd")
+
+    def test_non_monotonic_halves_the_exponent(self):
+        table = {"a": 0.0, "b": 1.0}
+        mono = dp.ExponentialMechanism(self._TableScore(table, True))
+        non_mono = dp.ExponentialMechanism(self._TableScore(table, False))
+        p_mono = mono._calculate_probabilities(2.0, ["a", "b"])
+        p_non = non_mono._calculate_probabilities(2.0, ["a", "b"])
+        # softmax(score * eps / sens) vs softmax(score * eps / (2 sens)).
+        assert p_mono[1] == pytest.approx(math.exp(2) / (1 + math.exp(2)))
+        assert p_non[1] == pytest.approx(math.e / (1 + math.e))
+
+    def test_precomputed_scores_used_when_given(self):
+        table = {"a": 0.0, "b": 0.0}
+        mech = dp.ExponentialMechanism(self._TableScore(table))
+        # Override with vectorized scores making "a" dominant.
+        chosen = {
+            mech.apply(10.0, ["a", "b"], scores=np.array([1000.0, 0.0]))
+            for _ in range(20)
+        }
+        assert chosen == {"a"}
