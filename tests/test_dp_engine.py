@@ -574,3 +574,121 @@ class TestPLDAccountingEndToEnd:
         result = engine.select_partitions(rows, params, extractors)
         accountant.compute_budgets()
         assert list(result) == ["big"]
+
+
+class TestPublicPartitionHandling:
+
+    @pytest.mark.parametrize("backend_name", BACKENDS)
+    def test_non_public_dropped_and_missing_public_added_empty(
+            self, backend_name):
+        # Data lives in A and B; public = [B, C]. A must be dropped
+        # (never released), C must appear as a pure-noise (≈0 at huge eps)
+        # partition even though no row touched it.
+        rows = [("u1", "A", 1.0), ("u2", "A", 2.0), ("u3", "B", 3.0)]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        result, _ = run_aggregate(backend_name,
+                                  rows,
+                                  params,
+                                  public_partitions=["B", "C"])
+        assert set(result) == {"B", "C"}
+        assert result["B"].count == pytest.approx(1, abs=1e-2)
+        assert result["C"].count == pytest.approx(0, abs=1e-2)
+
+    @pytest.mark.parametrize("backend_name", BACKENDS)
+    def test_empty_public_partition_carries_all_metrics(self, backend_name):
+        rows = [("u1", "A", 2.0)]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT,
+                                              pdp.Metrics.SUM],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     min_value=0.0,
+                                     max_value=5.0)
+        result, _ = run_aggregate(backend_name,
+                                  rows,
+                                  params,
+                                  public_partitions=["A", "Z"])
+        assert result["Z"].count == pytest.approx(0, abs=1e-2)
+        assert result["Z"].sum == pytest.approx(0.0, abs=1e-1)
+        assert result["A"].sum == pytest.approx(2.0, abs=1e-1)
+
+
+class TestAnnotatorHook:
+
+    def test_engine_annotates_with_params_and_budget(self):
+        from pipelinedp_tpu import pipeline_backend
+
+        calls = []
+
+        class Recorder(pipeline_backend.Annotator):
+
+            def annotate(self, col, backend, stage_name, **kwargs):
+                calls.append((stage_name, kwargs))
+                return col
+
+        pipeline_backend.register_annotator(Recorder())
+        try:
+            params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                         max_partitions_contributed=1,
+                                         max_contributions_per_partition=1)
+            # The per-aggregation Budget is only computable when the
+            # accountant knows the expected aggregation count upfront
+            # (same contract as the reference annotator).
+            accountant = pdp.NaiveBudgetAccountant(total_epsilon=2.0,
+                                                   total_delta=1e-6,
+                                                   num_aggregations=1)
+            engine = pdp.DPEngine(accountant, pdp.LocalBackend(seed=0))
+            extractors = pdp.DataExtractors(
+                privacy_id_extractor=lambda r: r[0],
+                partition_extractor=lambda r: r[1],
+                value_extractor=lambda r: r[2])
+            result = engine.aggregate(SIMPLE_ROWS, params, extractors,
+                                      ["A", "B"])
+            accountant.compute_budgets()
+            list(result)
+        finally:
+            pipeline_backend._annotators.clear()
+        assert len(calls) == 1
+        stage_name, kwargs = calls[0]
+        assert "params" in kwargs and "budget" in kwargs
+        assert kwargs["params"].metrics == [pdp.Metrics.COUNT]
+        assert kwargs["budget"].epsilon == pytest.approx(2.0)
+        assert kwargs["budget"].delta == pytest.approx(1e-6)
+
+
+class TestCustomCombinersThroughEngine:
+
+    class SumOfSquares(pdp.CustomCombiner):
+
+        def create_accumulator(self, values):
+            return float(sum(v**2 for v in values))
+
+        def merge_accumulators(self, a, b):
+            return a + b
+
+        def compute_metrics(self, acc):
+            return {"sum_squares": acc}
+
+        def explain_computation(self):
+            return lambda: "sum of squares"
+
+        def request_budget(self, budget_accountant):
+            self._budget = budget_accountant.request_budget(
+                pdp.MechanismType.LAPLACE)
+
+        def metrics_names(self):
+            return ["sum_squares"]
+
+    def test_custom_combiner_e2e_local(self):
+        rows = [("u1", "A", 2.0), ("u2", "A", 3.0), ("u3", "B", 4.0)]
+        params = pdp.AggregateParams(metrics=None,
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     custom_combiners=[self.SumOfSquares()])
+        result, _ = run_aggregate("local",
+                                  rows,
+                                  params,
+                                  public_partitions=["A", "B"])
+        assert result["A"] == ({"sum_squares": 13.0},)
+        assert result["B"] == ({"sum_squares": 16.0},)
