@@ -432,7 +432,8 @@ def reduce_column_names(cfg: KernelConfig) -> List[str]:
 
 
 def reduce_rows_to_partitions(spk, keep_row, pair_start, reduce_cols,
-                              n_partitions: int, vector_size: int):
+                              n_partitions: int, vector_size: int,
+                              presorted: bool = False):
     """Phase 1b: dense [0, n_partitions) partition columns from the bounded
     row stream.
 
@@ -441,15 +442,24 @@ def reduce_rows_to_partitions(spk, keep_row, pair_start, reduce_cols,
     exact integers, float sums use a chunked cumsum to bound f32 rounding
     bias. Together with the bounding sort, the reference's three shuffles
     (SURVEY.md §3.1) cost two sorts total.
+
+    `presorted`: the caller guarantees rows already arrive ordered by
+    (keep_row desc, spk asc) — i.e. kept rows first, ascending partition —
+    so the sort is skipped (the blocked large-P path compacts rows into
+    exactly this order once and reuses it for every block).
     """
     f = _ftype()
     i32 = jnp.int32
     P = n_partitions
     key2 = jnp.where(keep_row, spk, P).astype(i32)
     names = list(reduce_cols)
-    (spk2,), pay2 = _sort_rows([key2],
-                               [pair_start.astype(i32)] +
-                               [reduce_cols[m] for m in names])
+    if presorted:
+        spk2 = key2
+        pay2 = [pair_start.astype(i32)] + [reduce_cols[m] for m in names]
+    else:
+        (spk2,), pay2 = _sort_rows([key2],
+                                   [pair_start.astype(i32)] +
+                                   [reduce_cols[m] for m in names])
     starts = jnp.searchsorted(spk2, jnp.arange(P + 1, dtype=i32),
                               side='left').astype(i32)
 

@@ -5,26 +5,37 @@ but at P = 10^7..10^9 (the reference's unbounded-key shuffle regime,
 ``pipeline_dp/pipeline_backend.py:339-352``) a replicated dense partition
 axis no longer fits. This module shards the PARTITION axis instead:
 
-  1. **Bound once** (device, chunked over rows): contribution bounding is a
-     row-space computation (executor.bounded_row_columns) independent of P.
-     Row chunks split on privacy-id boundaries so every id's pairs stay in
-     one chunk — the same co-location invariant the pid-sharded multi-chip
-     path uses.
-  2. **Bin by partition block** (host, vectorized argsort): bounded rows are
-     ordered by partition id; block b owns partitions [b*C, (b+1)*C).
+  1. **Bound once** (device): contribution bounding is a row-space
+     computation (executor.bounded_row_columns) independent of P; the same
+     kernel then compacts (drops bounded-away rows) and orders the
+     survivors by partition id — all on device, one extra payload sort.
+  2. **Bin by partition block**: block b owns partitions [b*C, (b+1)*C);
+     block row ranges come from one searchsorted over the compacted stream.
   3. **Finalize per block** (device): each block segment-sums its own rows
-     into a dense [C] slice and runs DP selection + noise on just that slice
-     (selection and noise are pointwise over partitions, so blocks are
-     independent — no collective, no rescans: total work is O(n log n + P)).
-  4. **Compact**: only kept partitions are emitted, so output size is
-     O(kept), not O(P).
+     into a dense [C] slice and runs DP selection + noise on just that
+     slice (selection and noise are pointwise over partitions, so blocks
+     are independent — no collective, no rescans: total work is
+     O(n log n + P)).
+  4. **Compact**: kept partitions are sorted to the front ON DEVICE, so
+     only O(kept) values ever cross the device->host boundary — the
+     dominant cost under a remote-attached chip, where transferring dense
+     [C] outputs per block costs more than all device compute combined.
 
-Peak device memory is O(row_chunk + C) regardless of P.
+Two row-staging regimes, switched on whether the rows fit one device chunk:
+
+  * **Device-resident** (n <= row_chunk, the common case): rows never
+    return to the host between passes; per-block inputs are device-side
+    gathers at host-known offsets. Host traffic = block offsets + kept
+    results.
+  * **Host-staged** (n > row_chunk): row chunks split on privacy-id
+    boundaries are bounded+compacted on device, the compacted survivors
+    staged back to host, merged, and re-uploaded per block — preserving
+    the O(row_chunk + C) device-memory bound at any n.
 """
 
 import dataclasses
 import functools
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -46,20 +57,59 @@ def round_capacity(x: int, min_cap: int = 8) -> int:
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
-def _bounded_rows_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s,
-                         mid, key, cfg: executor.KernelConfig):
+def _bounded_compact_kernel(pid, pk, values, valid, min_v, max_v, min_s,
+                            max_s, mid, key, cfg: executor.KernelConfig):
+    """Bound contributions, drop bounded-away rows, order by partition.
+
+    Returns (spk, pair_start, reduce_cols, n_kept): the surviving bounded
+    rows sorted by partition id (dropped rows carry an int32-max sentinel
+    key and sort to the tail; n_kept counts the survivors).
+    """
     spk, keep_row, pair_start, reduce_cols, _ = executor.bounded_row_columns(
         pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, key, cfg)
-    return spk, keep_row, pair_start, reduce_cols
+    names = list(reduce_cols)
+    sort_key = jnp.where(keep_row, spk, jnp.iinfo(jnp.int32).max)
+    (spk_s,), pay = executor._sort_rows(
+        [sort_key],
+        [pair_start.astype(jnp.int32)] + [reduce_cols[m] for m in names])
+    cols_s = {m: pay[1 + j] for j, m in enumerate(names)}
+    return spk_s, pay[0].astype(bool), cols_s, keep_row.sum()
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def _block_kernel(spk_rel, keep_row, pair_start, reduce_cols, min_v, mid,
-                  stds, key, cfg: executor.KernelConfig, secure_tables=None):
-    cols = executor.reduce_rows_to_partitions(spk_rel, keep_row, pair_start,
-                                              reduce_cols, cfg.n_partitions,
-                                              cfg.vector_size)
-    return executor.finalize(cols, min_v, mid, stds, key, cfg, secure_tables)
+@functools.partial(jax.jit, static_argnames=("cfg", "cap"))
+def _block_kernel_dev(spk_s, pair_s, cols_s, lo, length, base, min_v, mid,
+                      stds, key, cfg: executor.KernelConfig, cap: int,
+                      secure_tables=None):
+    """Finalize one partition block from the device-resident row stream.
+
+    Gathers `cap` rows at host-known offset `lo` (rows beyond `length` are
+    masked), reduces them onto the block's dense [C] slice, runs selection
+    + noise, and sorts kept partitions to the front so the host can fetch
+    exactly n_kept results.
+    """
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    valid = idx < length
+    take = lambda a: jnp.take(a, lo + idx, mode="clip")
+    spk_rel = jnp.where(valid, take(spk_s) - base, cfg.n_partitions)
+    spk_rel = spk_rel.astype(jnp.int32)
+    pair = take(pair_s) & valid
+    cols = {
+        name: jnp.where(valid, take(col), jnp.zeros((), col.dtype))
+        for name, col in cols_s.items()
+    }
+    # Rows were compacted into (kept-first, spk-ascending) order by
+    # _bounded_compact_kernel; the block slice preserves it, and masked
+    # tail rows carry the cfg.n_partitions sentinel — still sorted.
+    dense = executor.reduce_rows_to_partitions(spk_rel, valid, pair, cols,
+                                               cfg.n_partitions,
+                                               cfg.vector_size,
+                                               presorted=True)
+    outputs, keep, _ = executor.finalize(dense, min_v, mid, stds, key, cfg,
+                                         secure_tables)
+    order = jnp.argsort(~keep, stable=True)  # kept partitions first
+    ids_sorted = order.astype(jnp.int32)
+    outputs_sorted = {name: col[order] for name, col in outputs.items()}
+    return keep.sum(), ids_sorted, outputs_sorted
 
 
 def _chunk_ends(pid_sorted: np.ndarray, row_chunk: int) -> np.ndarray:
@@ -89,6 +139,53 @@ def _chunk_ends(pid_sorted: np.ndarray, row_chunk: int) -> np.ndarray:
         ends.append(end)
         start = end
     return np.asarray(ends)
+
+
+def _pad_to(a: np.ndarray, cap: int, fill) -> np.ndarray:
+    widths = ((0, cap - len(a)),) + ((0, 0),) * (a.ndim - 1)
+    return np.pad(a, widths, constant_values=fill)
+
+
+def _bound_and_compact_host_staged(pid, pk, values, valid, min_v, max_v,
+                                   min_s, max_s, mid, rows_key, cfg,
+                                   row_chunk):
+    """n > row_chunk: bound+compact chunk-by-chunk, stage survivors on host.
+
+    Chunks split on privacy-id boundaries (L0 bounding is global per id);
+    each chunk's survivors arrive already spk-sorted, the host merges them
+    with one argsort over the concatenation.
+    """
+    order = np.argsort(pid, kind="stable")
+    pid_s, pk_s, values_s, valid_s = (pid[order], pk[order], values[order],
+                                      valid[order])
+    b_pk, b_pair = [], []
+    b_cols = {name: [] for name in executor.reduce_column_names(cfg)}
+    start = 0
+    for ci, end in enumerate(_chunk_ends(pid_s, row_chunk)):
+        sl = slice(start, end)
+        cap = round_capacity(end - start)
+        spk, pair, cols, n_kept = _bounded_compact_kernel(
+            _pad_to(pid_s[sl], cap, 0), _pad_to(pk_s[sl], cap, 0),
+            _pad_to(values_s[sl], cap, 0), _pad_to(valid_s[sl], cap, False),
+            min_v, max_v, min_s, max_s, mid, jax.random.fold_in(rows_key, ci),
+            cfg)
+        k = int(n_kept)  # the only per-chunk sync; bounds the d2h volume
+        b_pk.append(np.asarray(spk[:k]))
+        b_pair.append(np.asarray(pair[:k]))
+        for name, col in cols.items():
+            b_cols[name].append(np.asarray(col[:k]))
+        start = end
+
+    spk_all = np.concatenate(b_pk) if b_pk else np.zeros(0, np.int32)
+    pair_all = np.concatenate(b_pair) if b_pair else np.zeros(0, bool)
+    cols_all = {
+        name: (np.concatenate(chunks) if chunks else np.zeros(0))
+        for name, chunks in b_cols.items()
+    }
+    order2 = np.argsort(spk_all, kind="stable")
+    return spk_all[order2], pair_all[order2], {
+        name: col[order2] for name, col in cols_all.items()
+    }
 
 
 def aggregate_blocked(pid,
@@ -124,59 +221,70 @@ def aggregate_blocked(pid,
     P = cfg.n_partitions
     pid = np.asarray(pid)
     pk = np.asarray(pk)
-    values = np.asarray(values)
+    # Pre-cast to the kernel float dtype: the kernel casts on device anyway,
+    # and float64 host arrays would double the upload volume.
+    values = np.asarray(values, dtype=np.dtype(executor._ftype()))
     valid = np.asarray(valid)
+    n = len(pid)
 
     rows_key, final_key = jax.random.split(rng_key, 2)
+    stds = jnp.asarray(stds)
 
-    # --- Pass 1: bound rows, chunked on privacy-id boundaries. ------------
-    order = np.argsort(pid, kind="stable")
-    pid_s, pk_s, values_s, valid_s = (pid[order], pk[order], values[order],
-                                      valid[order])
-    b_pk, b_pair = [], []
-    b_cols = {name: [] for name in executor.reduce_column_names(cfg)}
-    start = 0
-    for ci, end in enumerate(_chunk_ends(pid_s, row_chunk)):
-        sl = slice(start, end)
-        cap = round_capacity(end - start)
-        pad = cap - (end - start)
-
-        def padded(a, fill=0):
-            widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
-            return np.pad(a[sl], widths, constant_values=fill)
-
-        spk, keep, pair, cols = _bounded_rows_kernel(
-            padded(pid_s), padded(pk_s), padded(values_s),
-            padded(valid_s, False), min_v, max_v, min_s, max_s, mid,
-            jax.random.fold_in(rows_key, ci), cfg)
-        keep = np.asarray(keep)
-        b_pk.append(np.asarray(spk)[keep])
-        b_pair.append(np.asarray(pair)[keep])
-        for name, col in cols.items():
-            b_cols[name].append(np.asarray(col)[keep])
-        start = end
-
-    spk_all = np.concatenate(b_pk) if b_pk else np.zeros(0, np.int32)
-    pair_all = np.concatenate(b_pair) if b_pair else np.zeros(0, bool)
-    cols_all = {
-        name: (np.concatenate(chunks) if chunks else np.zeros(0))
-        for name, chunks in b_cols.items()
-    }
+    # --- Pass 1: bound rows, compact + spk-sort the survivors. ------------
+    if n <= row_chunk:
+        # Device-resident: one kernel call, rows stay in HBM for pass 2.
+        cap = round_capacity(n)
+        spk_all, pair_all, cols_all, _ = _bounded_compact_kernel(
+            _pad_to(pid, cap, 0), _pad_to(pk, cap, 0),
+            _pad_to(values, cap, 0), _pad_to(valid, cap, False), min_v,
+            max_v, min_s, max_s, mid, jax.random.fold_in(rows_key, 0), cfg)
+    else:
+        spk_all, pair_all, cols_all = _bound_and_compact_host_staged(
+            pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+            rows_key, cfg, row_chunk)
+        # Blocks gather from device-resident arrays either way; per-block
+        # inputs are O(block rows), so upload the merged stream once.
+        spk_all = jnp.asarray(spk_all)
+        pair_all = jnp.asarray(pair_all)
+        cols_all = {name: jnp.asarray(col) for name, col in cols_all.items()}
 
     # --- Pass 2: bin by partition block, finalize each block. -------------
-    order2 = np.argsort(spk_all, kind="stable")
-    spk_all = spk_all[order2]
-    pair_all = pair_all[order2]
-    cols_all = {name: col[order2] for name, col in cols_all.items()}
-
     C = min(block_partitions, P)
     n_blocks = -(-P // C)
-    block_starts = np.searchsorted(spk_all,
-                                   np.arange(n_blocks + 1) * C,
-                                   side="left")
+    # Dropped rows carry an int32-max sentinel > P, so searchsorted over
+    # the compacted stream yields both block offsets AND the survivor count.
+    # Boundaries in int64 on host, clamped into int32 range for the device
+    # searchsorted: partition ids are < P <= int32 max and dropped rows
+    # carry the int32-max sentinel, so a clamped boundary still lands left
+    # of every sentinel. (Unclamped int32 arithmetic would overflow when P
+    # is within one block of 2^31 and silently drop the final blocks.)
+    boundaries = np.minimum(
+        np.arange(n_blocks + 1, dtype=np.int64) * C,
+        np.iinfo(np.int32).max).astype(np.int32)
+    block_starts = np.asarray(
+        jnp.searchsorted(spk_all, jnp.asarray(boundaries), side="left"))
     output_names = [name for e in cfg.plan for name in e.outputs]
     kept_ids = []
     kept_outputs = {name: [] for name in output_names}
+
+    def consume(b, result):
+        n_kept, ids_sorted, outputs_sorted = result
+        k = int(n_kept)  # sync; gates O(kept) transfers
+        if k == 0:
+            return
+        kept_ids.append(np.asarray(ids_sorted[:k]).astype(np.int64) + b * C)
+        for name, col in outputs_sorted.items():
+            kept_outputs.setdefault(name, []).append(np.asarray(col[:k]))
+
+    # Dispatch ahead of the sync point: jax execution is async, so the
+    # device pipelines upcoming block kernels while the host drains earlier
+    # results — one latency-bound sync per block would otherwise dominate
+    # under a remote-attached chip. The window is bounded: each in-flight
+    # block pins O(C) output buffers in HBM, and an unbounded pipeline over
+    # P/C blocks would hold O(P) results — the exact footprint this module
+    # exists to avoid.
+    max_in_flight = 8
+    pending = []
     for b in range(n_blocks):
         lo, hi = int(block_starts[b]), int(block_starts[b + 1])
         if lo == hi and cfg.private_selection:
@@ -187,27 +295,21 @@ def aggregate_blocked(pid,
             continue
         c_actual = min(C, P - b * C)
         cfg_block = dataclasses.replace(cfg, n_partitions=c_actual)
-        cap = round_capacity(hi - lo)
-        pad = cap - (hi - lo)
+        pending.append((b, _block_kernel_dev(spk_all, pair_all, cols_all, lo,
+                                             hi - lo, b * C, min_v, mid,
+                                             stds,
+                                             jax.random.fold_in(final_key, b),
+                                             cfg_block,
+                                             round_capacity(hi - lo),
+                                             secure_tables)))
+        if len(pending) >= max_in_flight:
+            consume(*pending.pop(0))
+    for entry in pending:
+        consume(*entry)
 
-        def padded(a, fill):
-            widths = ((0, pad),) + ((0, 0),) * (a.ndim - 1)
-            return np.pad(a, widths, constant_values=fill)
-
-        spk_rel = (spk_all[lo:hi].astype(np.int64) - b * C).astype(np.int32)
-        outputs, keep, _ = _block_kernel(
-            padded(spk_rel, c_actual),
-            padded(np.ones(hi - lo, bool), False),
-            padded(pair_all[lo:hi], False),
-            {name: padded(col[lo:hi], 0) for name, col in cols_all.items()},
-            min_v, mid, jnp.asarray(stds), jax.random.fold_in(final_key, b),
-            cfg_block, secure_tables)
-        keep = np.asarray(keep)
-        idx = np.nonzero(keep)[0]
-        kept_ids.append(idx.astype(np.int64) + b * C)
-        for name, col in outputs.items():
-            kept_outputs.setdefault(name, []).append(np.asarray(col)[idx])
-
+    # Each block emits kept partitions in ascending relative id (the compact
+    # sort is stable) and blocks are consumed in ascending order, so the
+    # concatenation is already globally ascending.
     kept = (np.concatenate(kept_ids) if kept_ids else np.zeros(0, np.int64))
     return kept, {
         name: (np.concatenate(chunks) if chunks else np.zeros(0))
