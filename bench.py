@@ -280,6 +280,16 @@ def main():
     import jax
     import jax.numpy as jnp
 
+    # Persistent compilation cache: over a remote-tunneled chip, first
+    # compiles cost 30s-minutes per distinct shape; caching them makes
+    # retries (and the CPU-failover rerun) start warm.
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/pipelinedp_tpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # noqa: BLE001 - cache is an optimization only
+        pass
+
     import pipelinedp_tpu as pdp
     from pipelinedp_tpu import combiners, executor
     from pipelinedp_tpu.aggregate_params import MechanismType
