@@ -873,3 +873,86 @@ class TestDatasetSummary:
         assert summary.num_dataset_public_partitions == 2
         assert summary.num_dataset_non_public_partitions == 1  # pk2
         assert summary.num_empty_public_partitions == 1  # pk_unused
+
+
+class TestErrorModelMonteCarlo:
+    """Validates the closed-form error model against brute-force simulation
+    of the actual bounding process — a check the reference never had (its
+    combiner tests only assert the formulas against themselves).
+
+    Ground truth: each privacy id keeps a given partition with probability
+    q = min(1, l0 / n_partitions_contributed) (uniform l0-subset sampling),
+    its contribution is clipped to the metric bounds, and the partition is
+    released iff the DP selector keeps the surviving id count.
+    """
+
+    # One partition: per-user (count in this partition, partitions touched).
+    USERS = [(1, 1), (3, 2), (5, 4), (2, 8), (7, 3), (4, 16), (1, 2)]
+    L0 = 2
+    LINF = 4
+    N_TRIALS = 40_000
+
+    def _model_stats(self):
+        counts = np.array([float(c) for c, _ in self.USERS])
+        n_parts = np.array([float(n) for _, n in self.USERS])
+        q = em.keep_fraction(n_parts, float(self.L0))
+        stats = em.metric_stat_terms(counts, 0.0, float(self.LINF),
+                                     q).sum(axis=-2)
+        return counts, q, stats
+
+    def _simulate_errors(self, rng):
+        counts = np.array([float(c) for c, _ in self.USERS])
+        clipped = np.clip(counts, 0.0, float(self.LINF))
+        n_parts = np.array([float(n) for _, n in self.USERS])
+        q = np.minimum(1.0, self.L0 / n_parts)
+        keep = rng.random((self.N_TRIALS, len(counts))) < q
+        released = (keep * clipped).sum(axis=1)
+        return released - counts.sum(), keep.sum(axis=1)
+
+    def test_bounding_error_mean_and_variance_match_simulation(self):
+        counts, q, stats = self._model_stats()
+        model_mean = (stats[em.L0_MEAN] + stats[em.CLIP_MIN] +
+                      stats[em.CLIP_MAX])
+        model_var = stats[em.L0_VAR]
+        errors, _ = self._simulate_errors(np.random.default_rng(7))
+        # 5-sigma confidence bands on the empirical moments.
+        mean_tol = 5 * np.sqrt(model_var / self.N_TRIALS)
+        assert errors.mean() == pytest.approx(model_mean, abs=mean_tol)
+        assert errors.var() == pytest.approx(model_var, rel=0.05)
+
+    def test_rmse_report_term_matches_simulation(self):
+        counts, q, stats = self._model_stats()
+        noise_std = 3.0
+        row = em.metric_report_terms(stats, keep_prob=1.0, weight=1.0,
+                                     noise_std=noise_std)
+        rng = np.random.default_rng(8)
+        errors, _ = self._simulate_errors(rng)
+        noisy = errors + rng.normal(0.0, noise_std, len(errors))
+        emp_rmse = np.sqrt((noisy**2).mean())
+        assert float(row[em.ABS_RMSE]) == pytest.approx(emp_rmse, rel=0.03)
+
+    def test_keep_probability_matches_simulation(self):
+        _, q, _ = self._model_stats()
+        selector = partition_selection.create_partition_selection_strategy(
+            pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC,
+            epsilon=1.0, delta=1e-3,
+            max_partitions_contributed=self.L0)
+        model_p = em.host_keep_probability(np.asarray(q), selector)
+        _, kept_counts = self._simulate_errors(np.random.default_rng(9))
+        emp_p = selector.probability_of_keep_vec(kept_counts).mean()
+        assert model_p == pytest.approx(float(emp_p), abs=0.01)
+
+    def test_moment_path_matches_exact_path(self):
+        _, q, _ = self._model_stats()
+        selector = partition_selection.create_partition_selection_strategy(
+            pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC,
+            epsilon=1.0, delta=1e-3,
+            max_partitions_contributed=self.L0)
+        exact = em.host_keep_probability(np.asarray(q), selector)
+        moments = em.selection_moment_terms(np.asarray(q)).sum(axis=-2)
+        approx = em.host_keep_probability_from_moments(
+            float(moments[em.SEL_MU]), float(moments[em.SEL_VAR]),
+            float(moments[em.SEL_SKEW3]), len(q), selector)
+        # The refined-normal approximation on 7 Bernoullis is coarse but
+        # must land near the exact Poisson-binomial integration.
+        assert approx == pytest.approx(exact, abs=0.05)
