@@ -1,0 +1,129 @@
+"""Property-based (hypothesis) parity tests for the fused TPU path.
+
+Random small datasets at huge epsilon must satisfy, on the fused columnar
+path: exact agreement with a brute-force numpy aggregation (and hence with
+LocalBackend) when the data respects the contribution bounds, and the
+bounding caps when it does not. Complements the example-based engine tests
+with generated edge cases (empty partitions, negative values, single-user
+partitions, value == clipping bound, etc.).
+"""
+
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+import pipelinedp_tpu as pdp
+
+HUGE_EPS = 1e7
+VOCAB = [f"pk{i}" for i in range(6)]
+
+# Keep compile diversity bounded: the kernel pads rows to the next power of
+# two and max_partitions pins the partition axis, so every example reuses a
+# handful of compiled shapes.
+MAX_PARTITIONS = 8
+
+
+def run_tpu(rows, params, public):
+    backend = pdp.TPUBackend(noise_seed=7, max_partitions=MAX_PARTITIONS)
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                           total_delta=1e-5)
+    engine = pdp.DPEngine(accountant, backend)
+    extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                    partition_extractor=lambda r: r[1],
+                                    value_extractor=lambda r: r[2])
+    result = engine.aggregate(rows, params, extractors, public)
+    accountant.compute_budgets()
+    return dict(result)
+
+
+# A bounded dataset: each user touches <= l0 partitions, <= linf values
+# each, so contribution bounding drops nothing and results are exact.
+@st.composite
+def bounded_dataset(draw):
+    l0 = draw(st.integers(1, 3))
+    linf = draw(st.integers(1, 3))
+    n_users = draw(st.integers(1, 5))
+    rows = []
+    for u in range(n_users):
+        pks = draw(
+            st.lists(st.sampled_from(VOCAB),
+                     min_size=1,
+                     max_size=l0,
+                     unique=True))
+        for pk in pks:
+            n_vals = draw(st.integers(1, linf))
+            for _ in range(n_vals):
+                v = draw(
+                    st.floats(-5.0, 5.0, allow_nan=False,
+                              allow_infinity=False))
+                rows.append((f"u{u}", pk, round(v, 2)))
+    return l0, linf, rows
+
+
+@st.composite
+def unbounded_dataset(draw):
+    l0 = draw(st.integers(1, 2))
+    linf = draw(st.integers(1, 2))
+    n_users = draw(st.integers(1, 4))
+    rows = draw(
+        st.lists(st.tuples(st.integers(0, n_users - 1),
+                           st.sampled_from(VOCAB),
+                           st.floats(-9.0, 9.0, allow_nan=False,
+                                     allow_infinity=False)),
+                 min_size=1,
+                 max_size=40))
+    rows = [(f"u{u}", pk, round(v, 2)) for u, pk, v in rows]
+    return l0, linf, rows
+
+
+@settings(max_examples=25, deadline=None)
+@given(bounded_dataset())
+def test_bounded_data_matches_brute_force(data):
+    l0, linf, rows = data
+    min_v, max_v = -5.0, 5.0
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM,
+                 pdp.Metrics.PRIVACY_ID_COUNT],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=l0,
+        max_contributions_per_partition=linf,
+        min_value=min_v,
+        max_value=max_v)
+    result = run_tpu(rows, params, public=VOCAB)
+
+    assert set(result) == set(VOCAB)
+    for pk in VOCAB:
+        in_pk = [(u, v) for u, p, v in rows if p == pk]
+        count = len(in_pk)
+        total = sum(np.clip(v, min_v, max_v) for _, v in in_pk)
+        users = len({u for u, _ in in_pk})
+        assert result[pk].count == pytest.approx(count, abs=0.01)
+        assert result[pk].sum == pytest.approx(total, abs=0.02)
+        assert result[pk].privacy_id_count == pytest.approx(users, abs=0.01)
+
+
+@settings(max_examples=25, deadline=None)
+@given(unbounded_dataset())
+def test_unbounded_data_respects_caps(data):
+    l0, linf, rows = data
+    params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+                                 noise_kind=pdp.NoiseKind.LAPLACE,
+                                 max_partitions_contributed=l0,
+                                 max_contributions_per_partition=linf,
+                                 min_value=0.0,
+                                 max_value=9.0)
+    result = run_tpu(rows, params, public=VOCAB)
+
+    n_users = len({u for u, _, _ in rows})
+    total_count = sum(result[pk].count for pk in VOCAB)
+    # Each user contributes at most l0 * linf rows globally...
+    assert total_count <= n_users * l0 * linf + 0.01
+    for pk in VOCAB:
+        users_pk = {u for u, p, _ in rows if p == pk}
+        raw_count = sum(1 for _, p, _ in rows if p == pk)
+        # ...at most linf rows within a partition, never more than raw...
+        assert result[pk].count <= min(
+            len(users_pk) * linf, raw_count) + 0.01
+        # ...and sums are bounded by clip_max per surviving row.
+        assert result[pk].sum <= result[pk].count * 9.0 + 0.02
+        assert result[pk].sum >= -0.02
