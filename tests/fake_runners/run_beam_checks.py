@@ -190,6 +190,64 @@ def test_private_beam_transforms():
               set(selected) == set(raw_counts()))
 
 
+def test_private_beam_mean_variance_pid_count():
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                           total_delta=1e-6)
+    raw_vals = {}
+    for _, pk, v in ROWS:
+        raw_vals.setdefault(pk, []).append(v)
+    with beam.Pipeline() as pipeline:
+        pcol = pipeline | "read mv" >> beam.Create(ROWS)
+        private = pcol | "mp mv" >> private_beam.MakePrivate(
+            budget_accountant=accountant,
+            privacy_id_extractor=lambda r: r[0])
+        flat = private | private_beam.FlatMap(lambda r: [(r[1], r[2])] * 2)
+        mean = flat | private_beam.Mean(
+            pdp.MeanParams(noise_kind=pdp.NoiseKind.GAUSSIAN,
+                           max_partitions_contributed=4,
+                           max_contributions_per_partition=40,
+                           min_value=0.0,
+                           max_value=5.0,
+                           partition_extractor=lambda r: r[0],
+                           value_extractor=lambda r: r[1]),
+            public_partitions=[f"pk{i}" for i in range(4)])
+        var = flat | private_beam.Variance(
+            pdp.VarianceParams(noise_kind=pdp.NoiseKind.GAUSSIAN,
+                               max_partitions_contributed=4,
+                               max_contributions_per_partition=40,
+                               min_value=0.0,
+                               max_value=5.0,
+                               partition_extractor=lambda r: r[0],
+                               value_extractor=lambda r: r[1]),
+            public_partitions=[f"pk{i}" for i in range(4)])
+        pid_count = private | private_beam.PrivacyIdCount(
+            pdp.PrivacyIdCountParams(noise_kind=pdp.NoiseKind.GAUSSIAN,
+                                     max_partitions_contributed=4,
+                                     partition_extractor=lambda r: r[1]),
+            public_partitions=[f"pk{i}" for i in range(4)])
+        accountant.compute_budgets()
+        import numpy as _np
+        got_mean = dict(mean)
+        got_var = dict(var)
+        ok_mean = all(
+            abs(got_mean[pk] - _np.mean(vs)) < 0.05
+            for pk, vs in raw_vals.items())
+        # FlatMap duplicated every value, which leaves mean/variance of the
+        # duplicated stream identical to the raw one.
+        ok_var = all(
+            abs(got_var[pk] - _np.var(vs)) < 0.1
+            for pk, vs in raw_vals.items())
+        check("private_beam FlatMap + Mean", ok_mean)
+        check("private_beam Variance", ok_var)
+        got_pid = dict(pid_count)
+        raw_pids = {}
+        for pid, pk, _ in ROWS:
+            raw_pids.setdefault(pk, set()).add(pid)
+        check("private_beam PrivacyIdCount",
+              all(abs(got_pid[pk] - len(pids)) < 0.5
+                  for pk, pids in raw_pids.items()))
+
+
 def test_private_beam_combine_per_key():
 
     class _SumCombineFn(private_collection.PrivateCombineFn):
@@ -316,6 +374,7 @@ if __name__ == "__main__":
     test_duplicate_labels_raise()
     test_dp_engine_on_beam()
     test_private_beam_transforms()
+    test_private_beam_mean_variance_pid_count()
     test_private_beam_combine_per_key()
     test_private_contribution_bounds_on_beam()
     test_utility_analysis_on_beam()

@@ -161,6 +161,45 @@ def test_private_rdd():
           abs(got_pid["all"] - 30) < 0.5)
 
 
+def test_private_rdd_mean_variance():
+    import numpy as _np
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                           total_delta=1e-6)
+    private = private_spark.make_private(SC.parallelize(ROWS), accountant,
+                                         lambda r: r[0])
+    mapped = private.map(lambda r: (r[1], r[2]))
+    mean = mapped.mean(
+        pdp.MeanParams(noise_kind=pdp.NoiseKind.GAUSSIAN,
+                       max_partitions_contributed=4,
+                       max_contributions_per_partition=20,
+                       min_value=0.0,
+                       max_value=5.0,
+                       partition_extractor=lambda r: r[0],
+                       value_extractor=lambda r: r[1]),
+        public_partitions=[f"pk{i}" for i in range(4)])
+    var = mapped.variance(
+        pdp.VarianceParams(noise_kind=pdp.NoiseKind.GAUSSIAN,
+                           max_partitions_contributed=4,
+                           max_contributions_per_partition=20,
+                           min_value=0.0,
+                           max_value=5.0,
+                           partition_extractor=lambda r: r[0],
+                           value_extractor=lambda r: r[1]),
+        public_partitions=[f"pk{i}" for i in range(4)])
+    accountant.compute_budgets()
+    raw_vals = {}
+    for _, pk, v in ROWS:
+        raw_vals.setdefault(pk, []).append(v)
+    got_mean = dict(mean.collect())
+    got_var = dict(var.collect())
+    check("PrivateRDD mean",
+          all(abs(got_mean[pk] - _np.mean(vs)) < 0.05
+              for pk, vs in raw_vals.items()))
+    check("PrivateRDD variance",
+          all(abs(got_var[pk] - _np.var(vs)) < 0.1
+              for pk, vs in raw_vals.items()))
+
+
 def test_utility_analysis_on_spark():
     from pipelinedp_tpu import analysis
     from pipelinedp_tpu.analysis import data_structures
@@ -211,6 +250,7 @@ if __name__ == "__main__":
     test_backend_ops_match_local()
     test_dp_engine_on_spark()
     test_private_rdd()
+    test_private_rdd_mean_variance()
     test_utility_analysis_on_spark()
     test_executor_serialization_boundary()
     print("SPARK_CHECKS_PASSED")

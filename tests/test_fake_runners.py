@@ -37,6 +37,9 @@ def test_beam_adapter_executes_on_fake_runner():
     out = _run("run_beam_checks.py", "BEAM_CHECKS_PASSED")
     assert "ok: DPEngine.aggregate on BeamBackend" in out
     assert "ok: private_beam Count/Sum" in out
+    assert "ok: private_beam FlatMap + Mean" in out
+    assert "ok: private_beam Variance" in out
+    assert "ok: private_beam PrivacyIdCount" in out
     assert "ok: duplicate label raises" in out
     assert "ok: utility analysis on BeamBackend" in out
     assert "ok: unserializable closure rejected at the worker boundary" in out
@@ -47,6 +50,8 @@ def test_spark_adapter_executes_on_fake_runner():
     out = _run("run_spark_checks.py", "SPARK_CHECKS_PASSED")
     assert "ok: DPEngine.aggregate on SparkRDDBackend" in out
     assert "ok: PrivateRDD count/sum" in out
+    assert "ok: PrivateRDD mean" in out
+    assert "ok: PrivateRDD variance" in out
     assert "ok: utility analysis on SparkRDDBackend" in out
     assert ("ok: unserializable closure rejected at the executor boundary"
             in out)
