@@ -151,6 +151,46 @@ class TestShardedEngineParity:
             assert actual[pk].mean == pytest.approx(expected[pk].mean,
                                                     abs=0.01)
 
+    def test_variance_sharded(self):
+        mesh = make_mesh(n_devices=4)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.VARIANCE,
+                                              pdp.Metrics.MEAN],
+                                     max_partitions_contributed=7,
+                                     max_contributions_per_partition=30,
+                                     min_value=0.0,
+                                     max_value=5.0)
+        public = ["pk%d" % i for i in range(7)]
+        expected = _aggregate(pdp.LocalBackend(seed=0), ROWS, params, public)
+        actual = _aggregate(pdp.TPUBackend(mesh=mesh, noise_seed=3), ROWS,
+                            params, public)
+        for pk in expected:
+            assert actual[pk].variance == pytest.approx(
+                expected[pk].variance, abs=0.05)
+            assert actual[pk].mean == pytest.approx(expected[pk].mean,
+                                                    abs=0.01)
+
+    def test_secure_release_sharded(self):
+        # Secure (snapped discrete) release must survive the psum'd
+        # multi-chip path with the same huge-eps values as LocalBackend.
+        mesh = make_mesh(n_devices=4)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT,
+                                              pdp.Metrics.SUM],
+                                     noise_kind=pdp.NoiseKind.LAPLACE,
+                                     max_partitions_contributed=7,
+                                     max_contributions_per_partition=30,
+                                     min_value=0.0,
+                                     max_value=5.0)
+        public = ["pk%d" % i for i in range(7)]
+        expected = _aggregate(pdp.LocalBackend(seed=0), ROWS, params, public)
+        actual = _aggregate(
+            pdp.TPUBackend(mesh=mesh, noise_seed=5, secure_noise=True), ROWS,
+            params, public)
+        for pk in expected:
+            assert actual[pk].count == pytest.approx(expected[pk].count,
+                                                     abs=0.05)
+            assert actual[pk].sum == pytest.approx(expected[pk].sum,
+                                                   abs=0.05)
+
     def test_percentile_sharded(self):
         # Values spread across shards must merge into one global tree.
         mesh = make_mesh(n_devices=8)
