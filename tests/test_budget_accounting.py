@@ -111,6 +111,54 @@ class TestNaiveBudgetAccountant:
                                       num_aggregations=2,
                                       aggregation_weights=[1, 2])
 
+    def test_aggregation_weights_split_and_enforcement(self):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=0,
+                                        aggregation_weights=[1, 3])
+        with acc.scope(weight=1):
+            s1 = acc.request_budget(MechanismType.LAPLACE)
+        acc._compute_budget_for_aggregation(1)
+        with acc.scope(weight=3):
+            s2 = acc.request_budget(MechanismType.LAPLACE)
+        acc._compute_budget_for_aggregation(3)
+        acc.compute_budgets()
+        # eps split proportionally to declared aggregation weights.
+        assert s1.eps == pytest.approx(0.25)
+        assert s2.eps == pytest.approx(0.75)
+
+    def test_aggregation_weights_count_mismatch_raises(self):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=0,
+                                        aggregation_weights=[1, 3])
+        with acc.scope(weight=1):
+            acc.request_budget(MechanismType.LAPLACE)
+        acc._compute_budget_for_aggregation(1)
+        with pytest.raises(ValueError, match="aggregation_weights"):
+            acc.compute_budgets()
+
+    def test_aggregation_weights_value_mismatch_raises(self):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=0,
+                                        aggregation_weights=[1, 3])
+        with acc.scope(weight=1):
+            acc.request_budget(MechanismType.LAPLACE)
+        acc._compute_budget_for_aggregation(1)
+        with acc.scope(weight=2):  # declared 3, actual 2
+            acc.request_budget(MechanismType.LAPLACE)
+        acc._compute_budget_for_aggregation(2)
+        with pytest.raises(ValueError):
+            acc.compute_budgets()
+
+    def test_num_aggregations_requires_unit_weights(self):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                        total_delta=0,
+                                        num_aggregations=1)
+        with acc.scope(weight=2):
+            acc.request_budget(MechanismType.LAPLACE)
+        acc._compute_budget_for_aggregation(2)
+        with pytest.raises(ValueError, match="weights have to be 1"):
+            acc.compute_budgets()
+
 
 class TestPld:
 
