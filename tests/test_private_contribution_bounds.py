@@ -65,6 +65,21 @@ class TestL0ScoringFunction:
         assert f.score(k) == pytest.approx(-0.5 * expected_noise -
                                            0.5 * expected_dropped)
 
+    def test_score_components_gaussian(self):
+        from pipelinedp_tpu import dp_computations as dp
+        params = _params(noise=pdp.NoiseKind.GAUSSIAN, aggregation_delta=1e-5,
+                         upper_bound=10)
+        histogram = _l0_histogram([(1, 5), (4, 2)])
+        f = pcb.L0ScoringFunction(params, number_of_partitions=100,
+                                  l0_histogram=histogram)
+        k = 2
+        # Gaussian count noise std at l0=k, linf=1: analytic sigma for
+        # (eps, delta) with l2 sensitivity sqrt(k).
+        expected_noise = 100 * dp.compute_sigma(1.0, 1e-5, np.sqrt(k))
+        expected_dropped = 4  # 2 users at l0=4 lose (4 - 2) partitions each
+        assert f.score(k) == pytest.approx(
+            -0.5 * expected_noise - 0.5 * expected_dropped, rel=1e-6)
+
     def test_global_sensitivity_capped_by_partitions(self):
         params = _params(upper_bound=1000)
         f = pcb.L0ScoringFunction(params, number_of_partitions=7,
