@@ -356,3 +356,29 @@ class TestStagingRegimesAgree:
                                    atol=1e-2)
         np.testing.assert_allclose(outs_fast["sum"], outs_host["sum"],
                                    atol=1e-1)
+
+
+class TestPresortedReduceContract:
+
+    def test_presorted_matches_sorted_reduce(self):
+        """reduce_rows_to_partitions(presorted=True) must equal the sorting
+        variant whenever rows arrive (kept-first, spk-ascending) — the
+        exact order _bounded_compact_kernel emits."""
+        import jax.numpy as jnp
+        rng = np.random.default_rng(4)
+        n, P = 4096, 64
+        spk = np.sort(rng.integers(0, P, n)).astype(np.int32)
+        keep = np.ones(n, bool)
+        # Tail of dropped rows, as the compact kernel produces.
+        keep[-128:] = False
+        spk[-128:] = np.iinfo(np.int32).max
+        pair = rng.random(n) < 0.3
+        cols = {"sum": rng.random(n).astype(np.float32)}
+        args = (jnp.asarray(spk), jnp.asarray(keep), jnp.asarray(pair),
+                {k: jnp.asarray(v) for k, v in cols.items()})
+        ref = executor.reduce_rows_to_partitions(*args, P, 0)
+        fast = executor.reduce_rows_to_partitions(*args, P, 0,
+                                                  presorted=True)
+        for name in ref:
+            np.testing.assert_allclose(np.asarray(fast[name]),
+                                       np.asarray(ref[name]), atol=1e-5)
