@@ -4,9 +4,12 @@ Capability parity with the reference package
 ``pipeline_dp/dataset_histograms/`` (histograms.py, computing_histograms.py,
 histogram_error_estimator.py), re-designed for columnar/vectorized
 computation: binning is a numpy ufunc over whole columns instead of a
-per-element lambda chain.
+per-element lambda chain, and ``device_histograms`` computes all six
+histograms on device (sort + segment scans, bins reduced and compacted on
+device) for encoded columnar datasets.
 """
 
 from pipelinedp_tpu.dataset_histograms import histograms
 from pipelinedp_tpu.dataset_histograms import computing_histograms
+from pipelinedp_tpu.dataset_histograms import device_histograms
 from pipelinedp_tpu.dataset_histograms import histogram_error_estimator

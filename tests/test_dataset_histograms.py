@@ -425,3 +425,85 @@ class TestErrorEstimator:
         assert estimator.get_ratio_dropped_l0(100) == 0
         # l0 per pid: [2, 2, 1]; threshold 1 drops 2 of 5 pair-contributions
         assert estimator.get_ratio_dropped_l0(1) == pytest.approx(2 / 5)
+
+
+class TestDeviceHistogramsParity:
+    """Device histograms must match the host columnar path bin-for-bin."""
+
+    def _random_columns(self, seed, n=3000, users=80, parts=40):
+        rng = np.random.default_rng(seed)
+        pids = rng.integers(0, users, n).astype(np.int32)
+        pks = (np.power(rng.random(n), 2.5) * parts).astype(np.int32)
+        values = (rng.random(n) * 7.0 - 2.0)
+        return pids, pks, values
+
+    @staticmethod
+    def _assert_same_int_hist(dev, host):
+        assert dev.name == host.name
+        got = [(b.lower, b.upper, b.count, b.sum, b.max) for b in dev.bins]
+        want = [(b.lower, b.upper, b.count, b.sum, b.max)
+                for b in host.bins]
+        assert got == want, (dev.name, got[:5], want[:5])
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_int_histograms_match_host(self, seed):
+        from pipelinedp_tpu.dataset_histograms import device_histograms as dh
+        pids, pks, values = self._random_columns(seed)
+        host = ch.compute_dataset_histograms_columnar(pids, pks, values)
+        dev = dh.compute_dataset_histograms_device(pids, pks, values)
+        self._assert_same_int_hist(dev.l0_contributions_histogram,
+                                   host.l0_contributions_histogram)
+        self._assert_same_int_hist(dev.l1_contributions_histogram,
+                                   host.l1_contributions_histogram)
+        self._assert_same_int_hist(dev.linf_contributions_histogram,
+                                   host.linf_contributions_histogram)
+        self._assert_same_int_hist(dev.count_per_partition_histogram,
+                                   host.count_per_partition_histogram)
+        self._assert_same_int_hist(
+            dev.count_privacy_id_per_partition,
+            host.count_privacy_id_per_partition)
+
+    def test_float_histogram_matches_host(self):
+        from pipelinedp_tpu.dataset_histograms import device_histograms as dh
+        pids, pks, values = self._random_columns(5)
+        values = values.astype(np.float32)  # both paths bin identical f32s
+        host = ch.compute_dataset_histograms_columnar(pids, pks, values)
+        dev = dh.compute_dataset_histograms_device(pids, pks, values)
+        hb = host.linf_sum_contributions_histogram.bins
+        db = dev.linf_sum_contributions_histogram.bins
+        assert sum(b.count for b in db) == sum(b.count for b in hb)
+        # Align bins by index over the shared [min, max] range; f32 vs f64
+        # edge arithmetic may shift a sum that lands within float eps of an
+        # edge by one bin, so demand >99% exact-index agreement.
+        lo = min(b.lower for b in hb)
+        hi = max(b.upper for b in hb)
+        buckets = ch.NUMBER_OF_BUCKETS_IN_LINF_SUM_CONTRIBUTIONS_HISTOGRAM
+        width = (hi - lo) / buckets
+
+        def index_map(bins):
+            return {int(round((b.lower - lo) / width)): b.count
+                    for b in bins}
+
+        hmap, dmap = index_map(hb), index_map(db)
+        agree = sum(1 for i, c in dmap.items() if hmap.get(i) == c)
+        assert agree >= 0.99 * len(hmap), (agree, len(hmap))
+
+    def test_large_value_binning_decade_edges(self):
+        from pipelinedp_tpu.dataset_histograms import device_histograms as dh
+        # One user with k rows in one partition exercises the L1/Linf bin
+        # of exactly k — probe the decade-edge values the integer binning
+        # must place exactly (10^3, 10^3+1, 10^4 - 1, 10^6, ...).
+        for k in (999, 1000, 1001, 9999, 10000, 123456, 10**6):
+            pids = np.zeros(k, np.int32)
+            pks = np.zeros(k, np.int32)
+            host = ch.compute_dataset_histograms_columnar(pids, pks)
+            dev = dh.compute_dataset_histograms_device(pids, pks)
+            self._assert_same_int_hist(dev.l1_contributions_histogram,
+                                       host.l1_contributions_histogram)
+
+    def test_no_values_skips_float_histogram(self):
+        from pipelinedp_tpu.dataset_histograms import device_histograms as dh
+        pids, pks, _ = self._random_columns(7, n=500)
+        dev = dh.compute_dataset_histograms_device(pids, pks)
+        assert dev.linf_sum_contributions_histogram is None
+        assert dev.l0_contributions_histogram.bins
