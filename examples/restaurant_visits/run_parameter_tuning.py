@@ -18,9 +18,10 @@ sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
 
 import pipelinedp_tpu as pdp
-from pipelinedp_tpu import analysis
+from pipelinedp_tpu import analysis, columnar
 from pipelinedp_tpu.analysis import parameter_tuning
-from pipelinedp_tpu.dataset_histograms import computing_histograms
+from pipelinedp_tpu.dataset_histograms import (computing_histograms,
+                                               device_histograms)
 from examples import synthetic_data
 
 
@@ -29,6 +30,9 @@ def main():
     parser.add_argument("--rows", type=int, default=5_000)
     parser.add_argument("--epsilon", type=float, default=1.0)
     parser.add_argument("--delta", type=float, default=1e-6)
+    parser.add_argument("--device_histograms", action="store_true",
+                        help="compute the contribution histograms on the "
+                        "accelerator (encoded columns -> one device program)")
     args = parser.parse_args()
 
     visits = synthetic_data.generate_restaurant_visits(args.rows)
@@ -39,9 +43,14 @@ def main():
         value_extractor=lambda v: 1)
 
     # 1. Contribution histograms of the dataset.
-    histograms = list(
-        computing_histograms.compute_dataset_histograms(
-            visits, extractors, backend))[0]
+    if args.device_histograms:
+        encoded = columnar.encode(visits, extractors)
+        histograms = device_histograms.compute_dataset_histograms_device(
+            encoded.pid, encoded.pk, encoded.values)
+    else:
+        histograms = list(
+            computing_histograms.compute_dataset_histograms(
+                visits, extractors, backend))[0]
     print("dataset: l0 contributions q(0.9) =",
           histograms.l0_contributions_histogram.quantiles([0.9]))
 
