@@ -692,3 +692,27 @@ class TestCustomCombinersThroughEngine:
                                   public_partitions=["A", "B"])
         assert result["A"] == ({"sum_squares": 13.0},)
         assert result["B"] == ({"sum_squares": 16.0},)
+
+
+class TestPreThresholdEndToEnd:
+
+    @pytest.mark.parametrize("backend_name", BACKENDS)
+    def test_pre_threshold_gates_small_partitions(self, backend_name):
+        # Partitions with 2 / 4 / 8 distinct users; pre_threshold=4 shifts
+        # the effective id count down by pre_threshold - 1, so "small"
+        # (below the threshold) is impossible, "mid" behaves like a 1-user
+        # partition (delta-bounded keep probability ~ 0 even at huge eps),
+        # and only "big" (effective count 5) survives.
+        rows = ([(f"a{i}", "small", 1.0) for i in range(2)] +
+                [(f"b{i}", "mid", 1.0) for i in range(4)] +
+                [(f"c{i}", "big", 1.0) for i in range(8)])
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            partition_selection_strategy=(
+                pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC),
+            pre_threshold=4)
+        result, _ = run_aggregate(backend_name, rows, params)
+        assert set(result) == {"big"}
+        assert result["big"].count == pytest.approx(8, abs=0.05)
