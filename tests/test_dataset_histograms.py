@@ -507,3 +507,11 @@ class TestDeviceHistogramsParity:
         dev = dh.compute_dataset_histograms_device(pids, pks)
         assert dev.linf_sum_contributions_histogram is None
         assert dev.l0_contributions_histogram.bins
+
+    def test_empty_input(self):
+        from pipelinedp_tpu.dataset_histograms import device_histograms as dh
+        dev = dh.compute_dataset_histograms_device(np.zeros(0, np.int32),
+                                                   np.zeros(0, np.int32),
+                                                   np.zeros(0))
+        assert dev.l0_contributions_histogram.bins == []
+        assert dev.linf_sum_contributions_histogram.bins == []
