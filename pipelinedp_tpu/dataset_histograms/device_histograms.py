@@ -17,7 +17,10 @@ float rounding can move a value across a decade boundary.
 Scope: single device invocation — rows must fit one HBM-sized chunk
 (~10^8). Larger datasets should fall back to the host columnar path or
 pre-aggregate per shard; per-partition statistics are not mergeable across
-arbitrary row chunks.
+arbitrary row chunks. Bin `sum` fields accumulate in f32 on device (the
+host path uses int64/f64): exact below 2^24 per bin, ~1e-7 relative beyond
+— histogram sums feed tuning heuristics, not releases, so the drift is
+immaterial there.
 """
 
 import functools
