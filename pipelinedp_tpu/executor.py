@@ -1070,6 +1070,24 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
                              jnp.asarray(gran, dtype=_ftype()))
         key = noise_ops.make_noise_key(getattr(backend, "noise_seed", None))
         min_v, max_v, min_s, max_s, mid = kernel_scalars(params)
+        threshold = getattr(backend, "large_partition_threshold", None)
+        if (threshold is not None and n_partitions > threshold and
+                backend.mesh is None and not cfg.quantiles):
+            # Very large partition spaces: never materialize dense [0, P)
+            # columns; process the partition axis in blocks
+            # (parallel/large_p.py) and emit only kept partitions. Raw
+            # encoded columns go in directly — large_p pads to its own
+            # capacities, so the dense path's pow2 pad_rows copy would
+            # only inflate the row count here.
+            from pipelinedp_tpu.parallel import large_p
+            kept_ids, blocked_outputs = large_p.aggregate_blocked(
+                encoded.pid, encoded.pk, encoded.values, encoded.valid,
+                min_v, max_v, min_s, max_s, mid, np.asarray(stds), key, cfg,
+                secure_tables=secure_tables)
+            yield from decode_blocked_results(kept_ids, blocked_outputs,
+                                              encoded.partition_vocab,
+                                              compound)
+            return
         pid, pk, values, valid = pad_rows(encoded)
         if backend.mesh is not None:
             from pipelinedp_tpu.parallel import sharded
@@ -1087,28 +1105,43 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
     return generator()
 
 
-def decode_results(outputs, keep, partition_vocab: Sequence[Any],
-                   compound: dp_combiners.CompoundCombiner):
-    """Device arrays -> [(partition_key, MetricsTuple)], matching the generic
-    path's namedtuple field order (per-child compute_metrics dict order)."""
-    keep_np = np.asarray(keep)
+def _decode_rows(outputs, row_idx_pairs, partition_vocab: Sequence[Any],
+                 compound: dp_combiners.CompoundCombiner):
+    """Shared emit loop: (output row, partition id) pairs -> results.
+
+    Field order = concatenated plan-entry outputs, which build_plan stores
+    in each child's true compute_metrics insertion order — identical to
+    CompoundCombiner.compute_metrics on the generic path.
+    """
     outputs_np = {name: np.asarray(col) for name, col in outputs.items()}
-    # Field order = concatenated plan-entry outputs, which build_plan stores
-    # in each child's true compute_metrics insertion order — identical to
-    # CompoundCombiner.compute_metrics on the generic path.
     field_order: List[str] = [
         name for entry in build_plan(compound) for name in entry.outputs
     ]
     n_real = len(partition_vocab)
-    for idx in np.nonzero(keep_np)[0]:
+    for row, idx in row_idx_pairs:
         if idx >= n_real:
             continue  # padding partitions beyond the vocabulary
         values = tuple(
             # Vector-valued columns (e.g. vector_sum) decode to ndarrays,
             # scalars to floats — matching the generic combiner outputs.
-            (np.asarray(outputs_np[name][idx], dtype=np.float64)
-             if outputs_np[name].ndim > 1 else float(outputs_np[name][idx]))
+            (np.asarray(outputs_np[name][row], dtype=np.float64)
+             if outputs_np[name].ndim > 1 else float(outputs_np[name][row]))
             for name in field_order)
         yield (partition_vocab[idx],
                dp_combiners._create_named_tuple_instance(
                    "MetricsTuple", tuple(field_order), values))
+
+
+def decode_blocked_results(kept_ids, outputs, partition_vocab: Sequence[Any],
+                           compound: dp_combiners.CompoundCombiner):
+    """Blocked large-P output (kept ids + compacted columns) -> results."""
+    return _decode_rows(outputs, enumerate(np.asarray(kept_ids)),
+                        partition_vocab, compound)
+
+
+def decode_results(outputs, keep, partition_vocab: Sequence[Any],
+                   compound: dp_combiners.CompoundCombiner):
+    """Device arrays -> [(partition_key, MetricsTuple)], matching the generic
+    path's namedtuple field order (per-child compute_metrics dict order)."""
+    kept = np.nonzero(np.asarray(keep))[0]
+    return _decode_rows(outputs, zip(kept, kept), partition_vocab, compound)

@@ -323,18 +323,25 @@ class TPUBackend(LocalBackend):
             device counterpart of the reference's PyDP snapped secure
             mechanisms (dp_computations.py:131-152). Costs one O(log K)
             table search per released value.
+        large_partition_threshold: partition counts above this route the
+            (single-device, non-percentile) aggregation through the blocked
+            partition-axis path (parallel/large_p.py), which never
+            materializes dense [0, P) columns — the reference's
+            unbounded-key regime. None disables the routing.
     """
 
     def __init__(self,
                  mesh=None,
                  max_partitions: Optional[int] = None,
                  noise_seed: Optional[int] = None,
-                 secure_noise: bool = False):
+                 secure_noise: bool = False,
+                 large_partition_threshold: Optional[int] = 1 << 21):
         super().__init__(seed=noise_seed)
         self.mesh = mesh
         self.max_partitions = max_partitions
         self.noise_seed = noise_seed
         self.secure_noise = secure_noise
+        self.large_partition_threshold = large_partition_threshold
 
     @property
     def is_tpu(self) -> bool:

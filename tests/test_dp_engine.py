@@ -716,3 +716,67 @@ class TestPreThresholdEndToEnd:
         result, _ = run_aggregate(backend_name, rows, params)
         assert set(result) == {"big"}
         assert result["big"].count == pytest.approx(8, abs=0.05)
+
+
+class TestLargePartitionRouting:
+    """TPUBackend routes past the dense kernel above the threshold."""
+
+    def _rows(self):
+        # 40 partitions, each with 2-3 users contributing once.
+        rows = []
+        for p in range(40):
+            for u in range(2 + p % 2):
+                rows.append((f"u{p}_{u}", f"pk{p:03d}", float(1 + p % 4)))
+        return rows
+
+    def test_public_partitions_match_local(self):
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT,
+                                              pdp.Metrics.SUM],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1,
+                                     min_value=0.0,
+                                     max_value=5.0)
+        rows = self._rows()
+        public = sorted({r[1] for r in rows}) + ["pk_empty"]
+        expected, _ = run_aggregate("local", rows, params,
+                                    public_partitions=public)
+        backend = pdp.TPUBackend(noise_seed=3, large_partition_threshold=8)
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-5)
+        engine = pdp.DPEngine(accountant, backend)
+        extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                        partition_extractor=lambda r: r[1],
+                                        value_extractor=lambda r: r[2])
+        result = engine.aggregate(rows, params, extractors, public)
+        accountant.compute_budgets()
+        result = dict(result)
+        assert set(result) == set(expected)
+        for pk in expected:
+            assert result[pk].count == pytest.approx(expected[pk].count,
+                                                     abs=0.05)
+            assert result[pk].sum == pytest.approx(expected[pk].sum,
+                                                   abs=0.05)
+
+    def test_private_selection_match_local(self):
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=1,
+                                     max_contributions_per_partition=1)
+        rows = self._rows() + [("lone", "pk_single", 1.0)]
+        expected, _ = run_aggregate("local", rows, params)
+        backend = pdp.TPUBackend(noise_seed=3, large_partition_threshold=8)
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-5)
+        engine = pdp.DPEngine(accountant, backend)
+        extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                        partition_extractor=lambda r: r[1],
+                                        value_extractor=lambda r: r[2])
+        result = engine.aggregate(rows, params, extractors)
+        accountant.compute_budgets()
+        result = dict(result)
+        # Data is within bounds, so the kept set is deterministic at huge
+        # eps: multi-user partitions survive, the 1-user partition drops.
+        assert set(result) == set(expected)
+        assert "pk_single" not in result
+        for pk in expected:
+            assert result[pk].count == pytest.approx(expected[pk].count,
+                                                     abs=0.05)
