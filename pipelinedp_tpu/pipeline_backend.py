@@ -298,10 +298,12 @@ class LocalBackend(PipelineBackend):
 class TPUBackend(LocalBackend):
     """Columnar JAX/XLA backend.
 
-    DPEngine detects this backend and lowers aggregate()/select_partitions()
-    to the fused columnar executor (executor.py / parallel/sharded.py): one
-    jit-compiled program doing contribution bounding + per-partition combine +
-    partition selection + noise on device.
+    DPEngine detects this backend and lowers aggregate() to the fused
+    columnar executor (executor.py / parallel/sharded.py): one jit-compiled
+    program doing contribution bounding + per-partition combine + partition
+    selection + noise on device. select_partitions() runs on the inherited
+    generic op vocabulary (its device counterpart — pid-count columns +
+    vectorized selection — is exercised inside aggregate()).
 
     The generic op vocabulary is inherited from LocalBackend so that
     non-fused framework utilities (dataset histograms, analysis glue,
