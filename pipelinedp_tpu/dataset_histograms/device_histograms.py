@@ -163,10 +163,11 @@ def _group_stats_kernel(pid, pk, values, valid, has_values: bool):
     pid_nxt = segment_ops.next_segment_start(new_pid | ~svalid)
     l1_per_pid = (pid_nxt - pid_starts).astype(i32)
     # L0 = #pairs per pid: count pair starts within the pid segment.
+    # int32 accumulation: exact to 2^31 pairs (a f32 cumsum loses +1
+    # increments past 2^24, silently corrupting l0 at ~16.7M pairs).
     cp = jnp.concatenate(
-        [jnp.zeros(1, jnp.float32),
-         jnp.cumsum(new_pair.astype(jnp.float32))])
-    l0_per_pid = (cp[pid_nxt] - cp[pid_starts]).astype(i32)
+        [jnp.zeros(1, i32), jnp.cumsum(new_pair.astype(i32))])
+    l0_per_pid = cp[pid_nxt] - cp[pid_starts]
 
     # Per-partition stats: rows re-sorted by pk.
     (spk2,), pay2 = executor._sort_rows([pk_s], [valid])
@@ -202,11 +203,21 @@ def _group_stats_kernel(pid, pk, values, valid, has_values: bool):
 def _int_bins_to_histogram(binned, name: hist.HistogramType) -> hist.Histogram:
     lowers, uppers, counts, sums, maxes, n_bins = binned
     k = int(n_bins)
+    # Bin bounds are computed in int32 on device; a stat value within one
+    # round_base of 2^31 would wrap its upper bound negative. All binned
+    # stats are row counts (<= the documented ~1e8-row scope) so this is
+    # unreachable today — fail loudly rather than emit a corrupt bound if a
+    # future caller bins larger stats.
+    uppers_np = np.asarray(uppers[:k])
+    if k and int(uppers_np.min()) <= 0:
+        raise OverflowError(
+            f"{name}: log-bin upper bound overflowed int32; stat values "
+            "must stay below 2^31 - round_base on the device path")
     bins = [
         hist.FrequencyBin(lower=int(l), upper=int(u), count=int(c),
                           sum=int(s), max=int(m))
         for l, u, c, s, m in zip(
-            np.asarray(lowers[:k]), np.asarray(uppers[:k]),
+            np.asarray(lowers[:k]), uppers_np,
             np.asarray(counts[:k]), np.asarray(sums[:k]).round().astype(
                 np.int64), np.asarray(maxes[:k]))
     ]
