@@ -190,10 +190,31 @@ class DPEngine:
         with self._budget_accountant.scope(weight=params.budget_weight):
             self._report_generators.append(
                 report_generator.ReportGenerator(params, "select_partitions"))
-            col = self._select_partitions(col, params, data_extractors)
+            if isinstance(self._backend, pipeline_backend.TPUBackend):
+                col = self._select_partitions_columnar(col, params,
+                                                       data_extractors)
+            else:
+                col = self._select_partitions(col, params, data_extractors)
             budget = self._budget_accountant._compute_budget_for_aggregation(
                 params.budget_weight)
             return self._annotate(col, params=params, budget=budget)
+
+    def _select_partitions_columnar(self, col,
+                                    params: agg_params.SelectPartitionsParams,
+                                    data_extractors: DataExtractors):
+        """Lowers standalone partition selection to one device program
+        (executor.select_partitions_kernel): sort-based pair dedupe + L0
+        sampling, per-partition privacy-id counts via segment ops, and the
+        vectorized selection strategies — the TPU counterpart of the
+        reference's shuffle pipeline (dp_engine.py:224-278)."""
+        from pipelinedp_tpu import executor as tpu_executor
+        return tpu_executor.lazy_select_partitions(
+            backend=self._backend,
+            col=col,
+            params=params,
+            data_extractors=data_extractors,
+            budget_accountant=self._budget_accountant,
+            report_generator=self._current_report_generator)
 
     def _select_partitions(self, col,
                            params: agg_params.SelectPartitionsParams,

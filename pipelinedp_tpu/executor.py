@@ -890,6 +890,120 @@ def aggregate_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
     return outputs, keep, row_count
 
 
+def select_partition_counts(pid, pk, valid, key: jax.Array, l0: int,
+                            n_partitions: int) -> jnp.ndarray:
+    """Per-partition privacy-id counts after pair dedupe + L0 sampling.
+
+    The counting stage of standalone partition selection (the reference's
+    group-by-pid / dedupe / sample / count shuffle chain,
+    dp_engine.py:224-278): ONE payload-carrying sort by
+    (pid, pair_hash64, pk) lands duplicates of a (pid, pk) pair adjacent
+    and orders each pid's distinct pairs by a salted uniform hash — so
+    "sample l0 partitions without replacement" is just "pair rank < l0",
+    exactly the aggregation kernel's L0 machinery (bounded_row_columns —
+    same sentinel convention and _pair_hash ranking; the sorts stay
+    separate because that path must also carry value payloads and a
+    per-row Linf rand key) — then one scatter-add of the surviving
+    pair-start rows builds the dense count vector.
+
+    Memory is O(rows) + the int32[P] counts, and P (the partition
+    vocabulary size) never exceeds the row count.
+
+    Returns counts: int32[n_partitions].
+    """
+    i32 = jnp.int32
+    P = n_partitions
+    pid_sent = jnp.where(valid, pid, jnp.iinfo(i32).max).astype(i32)
+    pk_sent = jnp.where(valid, pk, P).astype(i32)
+    hp0, hp1 = _pair_hash(pid_sent, pk_sent, key)
+    (spid, _, _, spk), pay = _sort_rows([pid_sent, hp0, hp1, pk_sent],
+                                        [valid])
+    svalid = pay[0]
+    new_pair = segment_ops.boundary_mask(spid, spk)
+    new_pid = segment_ops.boundary_mask(spid)
+    pair_rank = segment_ops.segment_rank_of_segments(new_pair, new_pid)
+    kept_pair = new_pair & svalid & (pair_rank < l0)
+    idx = jnp.where(kept_pair, spk, P)
+    counts = jnp.zeros((P + 1,), i32).at[idx].add(kept_pair.astype(i32))
+    return counts[:P]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("l0", "n_partitions", "selection"))
+def select_partitions_kernel(pid, pk, valid, rng_key, l0: int,
+                             n_partitions: int,
+                             selection: selection_ops.SelectionParams):
+    """Standalone DP partition selection as ONE device program:
+    select_partition_counts + the vectorized selection closed forms
+    (ops/selection_ops.py). Returns keep: bool[n_partitions]."""
+    key_l0, key_sel = jax.random.split(rng_key)
+    counts = select_partition_counts(pid, pk, valid, key_l0, l0,
+                                     n_partitions)
+    return selection_ops.sample_keep_decisions(key_sel, counts, selection)
+
+
+def resolve_n_partitions(backend, n_partitions: int) -> int:
+    """Honors TPUBackend(max_partitions=...): a fixed static result width
+    lets one compiled program be reused across datasets."""
+    if backend.max_partitions is not None:
+        if backend.max_partitions < n_partitions:
+            raise ValueError(
+                f"TPUBackend(max_partitions={backend.max_partitions}) is "
+                f"smaller than the {n_partitions} partitions in the data.")
+        return backend.max_partitions
+    return n_partitions
+
+
+def lazy_select_partitions(backend, col, params, data_extractors,
+                           budget_accountant, report_generator):
+    """Graph-time setup + lazily executed device partition selection.
+
+    Budget is requested NOW (graph time); the device program runs when the
+    returned generator is first iterated — after compute_budgets(). Mirrors
+    lazy_aggregate's laziness contract. With a meshed backend the counting
+    stage runs shard-local (rows sharded by privacy id) and the counts are
+    psum'd over the mesh (parallel/sharded.sharded_select_partitions).
+    """
+    budget = budget_accountant.request_budget(
+        mechanism_type=MechanismType.GENERIC)
+    strategy = params.partition_selection_strategy
+    pre_threshold_str = (f", pre_threshold={params.pre_threshold}"
+                         if params.pre_threshold else "")
+    report_generator.add_stage(
+        lambda: f"Private Partition selection: using {strategy.value} "
+        f"method with (eps={budget.eps}, delta={budget.delta}"
+        f"{pre_threshold_str})")
+    rows = col
+
+    def generator():
+        encoded = columnar.encode(rows, data_extractors)
+        selection = selection_ops.selection_params_from_host(
+            strategy, budget.eps, budget.delta,
+            params.max_partitions_contributed, params.pre_threshold)
+        n_partitions = resolve_n_partitions(backend, encoded.n_partitions)
+        key = noise_ops.make_noise_key(getattr(backend, "noise_seed", None))
+        if backend.mesh is not None:
+            from pipelinedp_tpu.parallel import sharded
+            keep = sharded.sharded_select_partitions(
+                backend.mesh, encoded.pid, encoded.pk, encoded.valid, key,
+                params.max_partitions_contributed, n_partitions, selection)
+        else:
+            # Selection never reads values; a zero-width column keeps
+            # pad_rows from copying the real one.
+            encoded.values = np.zeros((encoded.n_rows, 0), np.float64)
+            pid, pk, _, valid = pad_rows(encoded)
+            keep = select_partitions_kernel(
+                jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(valid), key,
+                params.max_partitions_contributed, n_partitions, selection)
+        vocab = encoded.partition_vocab
+        n_real = len(vocab)
+        for idx in np.nonzero(np.asarray(keep))[0]:
+            if idx < n_real:
+                yield vocab[idx]
+
+    return generator()
+
+
 def make_kernel_config(
         params: AggregateParams,
         compound: dp_combiners.CompoundCombiner,
@@ -1050,13 +1164,7 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
                 params.partition_selection_strategy, selection_budget.eps,
                 selection_budget.delta, params.max_partitions_contributed,
                 params.pre_threshold)
-        n_partitions = encoded.n_partitions
-        if backend.max_partitions is not None:
-            if backend.max_partitions < n_partitions:
-                raise ValueError(
-                    f"TPUBackend(max_partitions={backend.max_partitions}) is "
-                    f"smaller than the {n_partitions} partitions in the data.")
-            n_partitions = backend.max_partitions
+        n_partitions = resolve_n_partitions(backend, encoded.n_partitions)
         secure = bool(getattr(backend, "secure_noise", False))
         cfg = make_kernel_config(params, compound, n_partitions, private,
                                  selection_params, secure=secure)

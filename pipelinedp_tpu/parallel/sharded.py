@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from pipelinedp_tpu import executor
+from pipelinedp_tpu.ops import selection_ops
 from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
 
 
@@ -123,6 +124,59 @@ def _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
                        out_specs=P(),
                        check_vma=False)
     return fn(pid, pk, values, valid, stds, rng_key, secure_tables)
+
+
+@partial(jax.jit,
+         static_argnames=("l0", "n_partitions", "selection", "mesh"))
+def _sharded_select_kernel(pid, pk, valid, rng_key, l0: int,
+                           n_partitions: int,
+                           selection: selection_ops.SelectionParams,
+                           mesh: Mesh):
+
+    def per_shard(pid_s, pk_s, valid_s, key_r):
+        shard_idx = jax.lax.axis_index(SHARD_AXIS)
+        key_l0, key_sel = jax.random.split(key_r)
+        # Distinct pair-sampling randomness per shard (rows of one privacy
+        # id all live on one shard, so L0 sampling stays shard-local);
+        # identical selection key, so every shard holds the same keep mask.
+        counts = executor.select_partition_counts(
+            pid_s, pk_s, valid_s, jax.random.fold_in(key_l0, shard_idx), l0,
+            n_partitions)
+        counts = jax.lax.psum(counts, SHARD_AXIS)
+        return selection_ops.sample_keep_decisions(key_sel, counts,
+                                                   selection)
+
+    fn = jax.shard_map(per_shard,
+                       mesh=mesh,
+                       in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                                 P()),
+                       out_specs=P(),
+                       check_vma=False)
+    return fn(pid, pk, valid, rng_key)
+
+
+def sharded_select_partitions(mesh: Mesh, pid, pk, valid, rng_key, l0: int,
+                              n_partitions: int,
+                              selection: selection_ops.SelectionParams):
+    """Standalone partition selection over the mesh: shard rows by privacy
+    id, count shard-locally (executor.select_partition_counts), psum the
+    int32[P] count vector over ICI, select replicated.
+
+    Returns keep: bool[n_partitions], replicated across the mesh.
+    """
+    n_shards = mesh.devices.size
+    # Zero-width values column: selection never reads values, and a real
+    # column would cost an O(rows) gather/scatter in shard_rows_by_pid.
+    dummy_values = np.zeros((len(pid), 0), np.float32)
+    pid, pk, _, valid = shard_rows_by_pid(np.asarray(pid), np.asarray(pk),
+                                          dummy_values, np.asarray(valid),
+                                          n_shards)
+    sharding = NamedSharding(mesh, P(SHARD_AXIS))
+    pid = jax.device_put(jnp.asarray(pid), sharding)
+    pk = jax.device_put(jnp.asarray(pk), sharding)
+    valid = jax.device_put(jnp.asarray(valid), sharding)
+    return _sharded_select_kernel(pid, pk, valid, rng_key, l0, n_partitions,
+                                  selection, mesh)
 
 
 def sharded_aggregate_arrays(mesh: Mesh, pid, pk, values, valid, min_v, max_v,

@@ -301,9 +301,11 @@ class TPUBackend(LocalBackend):
     DPEngine detects this backend and lowers aggregate() to the fused
     columnar executor (executor.py / parallel/sharded.py): one jit-compiled
     program doing contribution bounding + per-partition combine + partition
-    selection + noise on device. select_partitions() runs on the inherited
-    generic op vocabulary (its device counterpart — pid-count columns +
-    vectorized selection — is exercised inside aggregate()).
+    selection + noise on device. Standalone select_partitions() lowers to
+    its own single-program device kernel
+    (executor.select_partitions_kernel): pair dedupe + L0 sampling via one
+    payload-carrying sort, privacy-id counts via segment ops, vectorized
+    selection — O(rows) memory, no dense per-partition columns.
 
     The generic op vocabulary is inherited from LocalBackend so that
     non-fused framework utilities (dataset histograms, analysis glue,

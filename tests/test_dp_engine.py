@@ -407,6 +407,156 @@ class TestSelectPartitions:
         assert "big" in result
         assert "small" not in result
 
+    @pytest.mark.parametrize(
+        "strategy", [
+            pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC,
+            pdp.PartitionSelectionStrategy.LAPLACE_THRESHOLDING,
+            pdp.PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING,
+        ])
+    def test_select_partitions_tpu_strategies(self, strategy):
+        rows = [(f"u{i}", "big", 0) for i in range(1000)]
+        rows += [("solo", "small", 0)]
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-5)
+        engine = pdp.DPEngine(accountant, pdp.TPUBackend(noise_seed=7))
+        extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                        partition_extractor=lambda r: r[1],
+                                        value_extractor=lambda r: r[2])
+        params = pdp.SelectPartitionsParams(max_partitions_contributed=2,
+                                            partition_selection_strategy=
+                                            strategy)
+        result = engine.select_partitions(rows, params, extractors)
+        accountant.compute_budgets()
+        result = list(result)
+        assert "big" in result
+        assert "small" not in result
+
+    def test_select_partitions_tpu_pre_threshold(self):
+        rows = [(f"u{i}", "big", 0) for i in range(1000)]
+        rows += [(f"m{i}", "mid", 0) for i in range(15)]
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-5)
+        engine = pdp.DPEngine(accountant, pdp.TPUBackend(noise_seed=7))
+        extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                        partition_extractor=lambda r: r[1],
+                                        value_extractor=lambda r: r[2])
+        params = pdp.SelectPartitionsParams(max_partitions_contributed=1,
+                                            pre_threshold=20)
+        result = engine.select_partitions(rows, params, extractors)
+        accountant.compute_budgets()
+        result = list(result)
+        assert "big" in result
+        assert "mid" not in result  # 15 users < pre_threshold
+
+    def test_select_partitions_local_tpu_parity(self):
+        rng = np.random.default_rng(3)
+        rows = [(f"u{i % 90}", f"pk{k}", 0)
+                for i, k in enumerate(rng.integers(0, 25, size=3000))]
+
+        def run(backend):
+            accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                                   total_delta=1e-5)
+            engine = pdp.DPEngine(accountant, backend)
+            extractors = pdp.DataExtractors(
+                privacy_id_extractor=lambda r: r[0],
+                partition_extractor=lambda r: r[1],
+                value_extractor=lambda r: r[2])
+            params = pdp.SelectPartitionsParams(max_partitions_contributed=30)
+            result = engine.select_partitions(rows, params, extractors)
+            accountant.compute_budgets()
+            return set(result)
+
+        # Every partition has many distinct users and l0 does not bind, so
+        # huge-eps selection is deterministic on both paths.
+        assert run(pdp.LocalBackend(seed=0)) == run(
+            pdp.TPUBackend(noise_seed=0))
+
+    def test_select_partitions_tpu_static_width_reuse(self):
+        rows = [(f"u{i}", f"pk{i % 3}", 0) for i in range(300)]
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-5)
+        backend = pdp.TPUBackend(noise_seed=7, max_partitions=64)
+        engine = pdp.DPEngine(accountant, backend)
+        extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                        partition_extractor=lambda r: r[1],
+                                        value_extractor=lambda r: r[2])
+        params = pdp.SelectPartitionsParams(max_partitions_contributed=3)
+        result = engine.select_partitions(rows, params, extractors)
+        accountant.compute_budgets()
+        assert sorted(result) == ["pk0", "pk1", "pk2"]
+
+    def test_select_partitions_tpu_max_partitions_too_small(self):
+        rows = [(f"u{i}", f"pk{i}", 0) for i in range(10)]
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-5)
+        backend = pdp.TPUBackend(noise_seed=7, max_partitions=4)
+        engine = pdp.DPEngine(accountant, backend)
+        extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                        partition_extractor=lambda r: r[1],
+                                        value_extractor=lambda r: r[2])
+        params = pdp.SelectPartitionsParams(max_partitions_contributed=1)
+        result = engine.select_partitions(rows, params, extractors)
+        accountant.compute_budgets()
+        with pytest.raises(ValueError, match="max_partitions"):
+            list(result)
+
+
+class TestSelectPartitionsKernel:
+    """Deterministic unit tests of the device kernel: a Laplace-thresholding
+    SelectionParams with a near-zero scale makes keep == (count >= t)."""
+
+    @staticmethod
+    def _run(pid, pk, n_partitions, l0, threshold):
+        import jax
+        from pipelinedp_tpu import executor
+        from pipelinedp_tpu.ops import selection_ops
+        selection = selection_ops.SelectionParams(kind=1,
+                                                  pre_shift=0,
+                                                  threshold=threshold,
+                                                  scale=1e-12)
+        pid = np.asarray(pid, np.int32)
+        pk = np.asarray(pk, np.int32)
+        keep = executor.select_partitions_kernel(pid, pk,
+                                                 np.ones(len(pid), bool),
+                                                 jax.random.PRNGKey(0), l0,
+                                                 n_partitions, selection)
+        return np.asarray(keep)
+
+    def test_duplicate_rows_count_once(self):
+        # Partition 0: 10 distinct single-row users + one user with 50
+        # duplicate rows -> privacy-id count must be 11, not 60.
+        pid = list(range(10)) + [100] * 50
+        pk = [0] * 60
+        assert self._run(pid, pk, 1, 4, threshold=10.5).tolist() == [True]
+        assert self._run(pid, pk, 1, 4, threshold=11.5).tolist() == [False]
+        assert self._run(pid, pk, 1, 4, threshold=59.5).tolist() == [False]
+
+    def test_l0_sampling_bounds_cross_partition_count(self):
+        # User 100 contributes to all 3 partitions but l0=2: exactly two
+        # partitions see 11 users (kept at t=10.5), one sees 10 (dropped).
+        pid, pk = [], []
+        for p in range(3):
+            pid += list(range(p * 10, p * 10 + 10)) + [100]
+            pk += [p] * 11
+        keep = self._run(pid, pk, 3, 2, threshold=10.5)
+        assert keep.sum() == 2
+
+    def test_invalid_rows_ignored(self):
+        import jax
+        from pipelinedp_tpu import executor
+        from pipelinedp_tpu.ops import selection_ops
+        selection = selection_ops.SelectionParams(kind=1,
+                                                  pre_shift=0,
+                                                  threshold=1.5,
+                                                  scale=1e-12)
+        pid = np.asarray([1, 2, 3, 4], np.int32)
+        pk = np.asarray([0, 0, 1, 1], np.int32)
+        valid = np.asarray([True, True, False, False])
+        keep = executor.select_partitions_kernel(pid, pk, valid,
+                                                 jax.random.PRNGKey(0), 2, 2,
+                                                 selection)
+        assert np.asarray(keep).tolist() == [True, False]
+
 
 class TestExplainComputation:
 

@@ -293,3 +293,69 @@ class TestMaxPartitionsKnob:
         rows = [("u1", "A", 1.0), ("u2", "B", 1.0)]
         with pytest.raises(ValueError, match="max_partitions"):
             _aggregate(backend, rows, params, ["A", "B"])
+
+
+class TestShardedSelectPartitions:
+
+    @staticmethod
+    def _select(backend, rows, l0=30):
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-5)
+        engine = pdp.DPEngine(accountant, backend)
+        params = pdp.SelectPartitionsParams(max_partitions_contributed=l0)
+        result = engine.select_partitions(rows, params, EXTRACTORS)
+        accountant.compute_budgets()
+        return set(result)
+
+    def test_select_partitions_mesh_matches_local(self):
+        # Every partition has many distinct users and l0 does not bind, so
+        # huge-eps selection is deterministic on every path.
+        rng = np.random.default_rng(11)
+        rows = [(f"u{i % 120}", f"pk{k}", 0.0)
+                for i, k in enumerate(rng.integers(0, 20, size=4000))]
+        mesh = make_mesh(n_devices=8)
+        expected = self._select(pdp.LocalBackend(seed=0), rows)
+        assert self._select(pdp.TPUBackend(mesh=mesh, noise_seed=3),
+                            rows) == expected
+        assert len(expected) == 20
+
+    def test_select_partitions_mesh_drops_small(self):
+        mesh = make_mesh(n_devices=4)
+        rows = [(f"u{i}", "big", 0.0) for i in range(2000)]
+        rows += [("solo", "tiny", 0.0)]
+        got = self._select(pdp.TPUBackend(mesh=mesh, noise_seed=5), rows,
+                           l0=2)
+        assert got == {"big"}
+
+    def test_sharded_counts_match_single_device(self):
+        # Count-stage parity: psum of shard-local counts == single-device
+        # counts when l0 does not bind (no sampling randomness involved).
+        import jax
+        from pipelinedp_tpu import executor
+        from pipelinedp_tpu.parallel import sharded
+        from pipelinedp_tpu.ops import selection_ops
+
+        rng = np.random.default_rng(7)
+        n, P = 5000, 40
+        pid = rng.integers(0, 200, n).astype(np.int32)
+        pk = rng.integers(0, P, n).astype(np.int32)
+        valid = np.ones(n, bool)
+        selection = selection_ops.SelectionParams(kind=1, pre_shift=0,
+                                                  threshold=10.5,
+                                                  scale=1e-12)
+        mesh = make_mesh(n_devices=8)
+        keep_mesh = np.asarray(
+            sharded.sharded_select_partitions(mesh, pid, pk, valid,
+                                              jax.random.PRNGKey(0), P, P,
+                                              selection))
+        keep_single = np.asarray(
+            executor.select_partitions_kernel(pid, pk, valid,
+                                              jax.random.PRNGKey(0), P, P,
+                                              selection))
+        # Deterministic threshold selection: both reduce to count >= 10.5.
+        expected = np.array([
+            len({p for p, k in zip(pid, pk) if k == j}) >= 11
+            for j in range(P)
+        ])
+        assert (keep_mesh == expected).all()
+        assert (keep_single == expected).all()
