@@ -1180,7 +1180,7 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
         min_v, max_v, min_s, max_s, mid = kernel_scalars(params)
         threshold = getattr(backend, "large_partition_threshold", None)
         if (threshold is not None and n_partitions > threshold and
-                backend.mesh is None and not cfg.quantiles):
+                backend.mesh is None):
             # Very large partition spaces: never materialize dense [0, P)
             # columns; process the partition axis in blocks
             # (parallel/large_p.py) and emit only kept partitions. Raw

@@ -61,31 +61,38 @@ def _bounded_compact_kernel(pid, pk, values, valid, min_v, max_v, min_s,
                             max_s, mid, key, cfg: executor.KernelConfig):
     """Bound contributions, drop bounded-away rows, order by partition.
 
-    Returns (spk, pair_start, reduce_cols, n_kept): the surviving bounded
-    rows sorted by partition id (dropped rows carry an int32-max sentinel
-    key and sort to the tail; n_kept counts the survivors).
+    Returns (spk, pair_start, reduce_cols, leaf, n_kept): the surviving
+    bounded rows sorted by partition id (dropped rows carry an int32-max
+    sentinel key and sort to the tail; n_kept counts the survivors). With
+    percentiles, `leaf` carries each row's quantile-tree leaf index through
+    the same compaction sort (None otherwise).
     """
-    spk, keep_row, pair_start, reduce_cols, _ = executor.bounded_row_columns(
-        pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, key, cfg)
+    spk, keep_row, pair_start, reduce_cols, qrows = \
+        executor.bounded_row_columns(pid, pk, values, valid, min_v, max_v,
+                                     min_s, max_s, mid, key, cfg)
     names = list(reduce_cols)
     sort_key = jnp.where(keep_row, spk, jnp.iinfo(jnp.int32).max)
-    (spk_s,), pay = executor._sort_rows(
-        [sort_key],
-        [pair_start.astype(jnp.int32)] + [reduce_cols[m] for m in names])
+    payloads = ([pair_start.astype(jnp.int32)] +
+                [reduce_cols[m] for m in names])
+    if cfg.quantiles:
+        payloads.append(qrows[1])  # per-row leaf index
+    (spk_s,), pay = executor._sort_rows([sort_key], payloads)
     cols_s = {m: pay[1 + j] for j, m in enumerate(names)}
-    return spk_s, pay[0].astype(bool), cols_s, keep_row.sum()
+    leaf_s = pay[-1] if cfg.quantiles else None
+    return spk_s, pay[0].astype(bool), cols_s, leaf_s, keep_row.sum()
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "cap"))
-def _block_kernel_dev(spk_s, pair_s, cols_s, lo, length, base, min_v, mid,
-                      stds, key, cfg: executor.KernelConfig, cap: int,
-                      secure_tables=None):
+def _block_kernel_dev(spk_s, pair_s, cols_s, leaf_s, lo, length, base, min_v,
+                      max_v, mid, stds, key, cfg: executor.KernelConfig,
+                      cap: int, secure_tables=None):
     """Finalize one partition block from the device-resident row stream.
 
     Gathers `cap` rows at host-known offset `lo` (rows beyond `length` are
     masked), reduces them onto the block's dense [C] slice, runs selection
-    + noise, and sorts kept partitions to the front so the host can fetch
-    exactly n_kept results.
+    + noise (and, with percentiles, the block's quantile descent), and
+    sorts kept partitions to the front so the host can fetch exactly
+    n_kept results.
     """
     idx = jnp.arange(cap, dtype=jnp.int32)
     valid = idx < length
@@ -106,6 +113,16 @@ def _block_kernel_dev(spk_s, pair_s, cols_s, lo, length, base, min_v, mid,
                                                presorted=True)
     outputs, keep, _ = executor.finalize(dense, min_v, mid, stds, key, cfg,
                                          secure_tables)
+    if cfg.quantiles:
+        # Per-block quantile trees over just the block's rows: relative
+        # partition ids index trees [0, C); quantile_outputs picks the lazy
+        # descent whenever the block exceeds one dense histogram chunk, so
+        # peak memory stays O(C * branching), never O(C * leaves).
+        qkey = jax.random.fold_in(key, 7919)
+        outputs.update(
+            executor.quantile_outputs((spk_rel, take(leaf_s), valid), min_v,
+                                      max_v, stds, qkey, cfg,
+                                      secure_tables=secure_tables))
     order = jnp.argsort(~keep, stable=True)  # kept partitions first
     ids_sorted = order.astype(jnp.int32)
     outputs_sorted = {name: col[order] for name, col in outputs.items()}
@@ -158,13 +175,13 @@ def _bound_and_compact_host_staged(pid, pk, values, valid, min_v, max_v,
     order = np.argsort(pid, kind="stable")
     pid_s, pk_s, values_s, valid_s = (pid[order], pk[order], values[order],
                                       valid[order])
-    b_pk, b_pair = [], []
+    b_pk, b_pair, b_leaf = [], [], []
     b_cols = {name: [] for name in executor.reduce_column_names(cfg)}
     start = 0
     for ci, end in enumerate(_chunk_ends(pid_s, row_chunk)):
         sl = slice(start, end)
         cap = round_capacity(end - start)
-        spk, pair, cols, n_kept = _bounded_compact_kernel(
+        spk, pair, cols, leaf, n_kept = _bounded_compact_kernel(
             _pad_to(pid_s[sl], cap, 0), _pad_to(pk_s[sl], cap, 0),
             _pad_to(values_s[sl], cap, 0), _pad_to(valid_s[sl], cap, False),
             min_v, max_v, min_s, max_s, mid, jax.random.fold_in(rows_key, ci),
@@ -172,6 +189,8 @@ def _bound_and_compact_host_staged(pid, pk, values, valid, min_v, max_v,
         k = int(n_kept)  # the only per-chunk sync; bounds the d2h volume
         b_pk.append(np.asarray(spk[:k]))
         b_pair.append(np.asarray(pair[:k]))
+        if cfg.quantiles:
+            b_leaf.append(np.asarray(leaf[:k]))
         for name, col in cols.items():
             b_cols[name].append(np.asarray(col[:k]))
         start = end
@@ -183,9 +202,13 @@ def _bound_and_compact_host_staged(pid, pk, values, valid, min_v, max_v,
         for name, chunks in b_cols.items()
     }
     order2 = np.argsort(spk_all, kind="stable")
+    leaf_all = None
+    if cfg.quantiles:
+        leaf_all = (np.concatenate(b_leaf)
+                    if b_leaf else np.zeros(0, np.int32))[order2]
     return spk_all[order2], pair_all[order2], {
         name: col[order2] for name, col in cols_all.items()
-    }
+    }, leaf_all
 
 
 def aggregate_blocked(pid,
@@ -207,17 +230,13 @@ def aggregate_blocked(pid,
                       ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
     """DP aggregation over an arbitrarily large partition space.
 
-    Same semantics as executor.aggregate_kernel (minus percentiles), but the
-    partition axis is processed in blocks of `block_partitions` and only
-    kept partitions are returned.
+    Same semantics as executor.aggregate_kernel — including percentiles,
+    whose per-block quantile trees descend lazily (O(C * branching) peak
+    memory) over the block's own rows — but the partition axis is processed
+    in blocks of `block_partitions` and only kept partitions are returned.
 
     Returns (kept_partition_ids int64[M], {metric: f[M]}).
     """
-    if cfg.quantiles:
-        raise NotImplementedError(
-            "PERCENTILE is not supported on the blocked large-partition "
-            "path; use the dense kernel (quantile trees already chunk the "
-            "partition axis internally).")
     P = cfg.n_partitions
     pid = np.asarray(pid)
     pk = np.asarray(pk)
@@ -234,19 +253,22 @@ def aggregate_blocked(pid,
     if n <= row_chunk:
         # Device-resident: one kernel call, rows stay in HBM for pass 2.
         cap = round_capacity(n)
-        spk_all, pair_all, cols_all, _ = _bounded_compact_kernel(
+        spk_all, pair_all, cols_all, leaf_all, _ = _bounded_compact_kernel(
             _pad_to(pid, cap, 0), _pad_to(pk, cap, 0),
             _pad_to(values, cap, 0), _pad_to(valid, cap, False), min_v,
             max_v, min_s, max_s, mid, jax.random.fold_in(rows_key, 0), cfg)
     else:
-        spk_all, pair_all, cols_all = _bound_and_compact_host_staged(
-            pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
-            rows_key, cfg, row_chunk)
+        spk_all, pair_all, cols_all, leaf_all = \
+            _bound_and_compact_host_staged(
+                pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+                rows_key, cfg, row_chunk)
         # Blocks gather from device-resident arrays either way; per-block
         # inputs are O(block rows), so upload the merged stream once.
         spk_all = jnp.asarray(spk_all)
         pair_all = jnp.asarray(pair_all)
         cols_all = {name: jnp.asarray(col) for name, col in cols_all.items()}
+        if leaf_all is not None:
+            leaf_all = jnp.asarray(leaf_all)
 
     # --- Pass 2: bin by partition block, finalize each block. -------------
     C = min(block_partitions, P)
@@ -295,9 +317,9 @@ def aggregate_blocked(pid,
             continue
         c_actual = min(C, P - b * C)
         cfg_block = dataclasses.replace(cfg, n_partitions=c_actual)
-        pending.append((b, _block_kernel_dev(spk_all, pair_all, cols_all, lo,
-                                             hi - lo, b * C, min_v, mid,
-                                             stds,
+        pending.append((b, _block_kernel_dev(spk_all, pair_all, cols_all,
+                                             leaf_all, lo, hi - lo, b * C,
+                                             min_v, max_v, mid, stds,
                                              jax.random.fold_in(final_key, b),
                                              cfg_block,
                                              round_capacity(hi - lo),

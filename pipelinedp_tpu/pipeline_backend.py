@@ -328,7 +328,7 @@ class TPUBackend(LocalBackend):
             mechanisms (dp_computations.py:131-152). Costs one O(log K)
             table search per released value.
         large_partition_threshold: partition counts above this route the
-            (single-device, non-percentile) aggregation through the blocked
+            (single-device) aggregation through the blocked
             partition-axis path (parallel/large_p.py), which never
             materializes dense [0, P) columns — the reference's
             unbounded-key regime. None disables the routing.

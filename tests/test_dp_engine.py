@@ -907,6 +907,35 @@ class TestLargePartitionRouting:
             assert result[pk].sum == pytest.approx(expected[pk].sum,
                                                    abs=0.05)
 
+    def test_percentile_routes_through_blocked_path(self):
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT,
+                     pdp.Metrics.PERCENTILE(50)],
+            max_partitions_contributed=20,
+            max_contributions_per_partition=8,
+            min_value=0.0,
+            max_value=5.0)
+        rows = self._rows()
+        public = sorted({r[1] for r in rows})
+        expected, _ = run_aggregate("local", rows, params,
+                                    public_partitions=public)
+        backend = pdp.TPUBackend(noise_seed=3, large_partition_threshold=8)
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-5)
+        engine = pdp.DPEngine(accountant, backend)
+        extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                        partition_extractor=lambda r: r[1],
+                                        value_extractor=lambda r: r[2])
+        result = engine.aggregate(rows, params, extractors, public)
+        accountant.compute_budgets()
+        result = dict(result)
+        assert set(result) == set(expected)
+        for pk in expected:
+            # Tree quantiles are leaf-quantized: compare within a few
+            # leaf widths of the local (exact-algorithm) result.
+            assert result[pk].percentile_50 == pytest.approx(
+                expected[pk].percentile_50, abs=0.05)
+
     def test_private_selection_match_local(self):
         params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
                                      max_partitions_contributed=1,

@@ -296,16 +296,77 @@ class TestBlockedAggregation:
         assert set(kept.tolist()) == {7, P - 3}
         assert outputs["count"].sum() == pytest.approx(1000, abs=1e-6)
 
-    def test_percentile_rejected(self):
-        P = 100
+    def test_percentile_blocked_matches_dense(self):
+        # Noise-free percentiles: the blocked path (multiple blocks, lazy
+        # per-block descent) must agree with the dense kernel's quantiles.
+        P = 3000
+        metrics = [
+            pdp.Metrics.COUNT,
+            pdp.Metrics.PERCENTILE(25),
+            pdp.Metrics.PERCENTILE(90),
+        ]
         cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(
-            P, private=False, metrics_list=[pdp.Metrics.PERCENTILE(50)])
-        with pytest.raises(NotImplementedError, match="PERCENTILE"):
-            large_p.aggregate_blocked(np.zeros(4, np.int32),
-                                      np.zeros(4, np.int32), np.ones(4),
-                                      np.ones(4, bool), min_v, max_v, min_s,
-                                      max_s, mid, np.asarray(stds),
-                                      jax.random.PRNGKey(0), cfg)
+            P, private=False, metrics_list=metrics, l0=P, linf=64)
+        stds = np.zeros_like(np.asarray(stds))
+        pid, pk, values, valid = self._data(30_000, 400, P, seed=5)
+        kept, outputs = large_p.aggregate_blocked(pid,
+                                                  pk,
+                                                  values,
+                                                  valid,
+                                                  min_v,
+                                                  max_v,
+                                                  min_s,
+                                                  max_s,
+                                                  mid,
+                                                  stds,
+                                                  jax.random.PRNGKey(2),
+                                                  cfg,
+                                                  block_partitions=256)
+        dense_out, dense_keep, _ = executor.aggregate_kernel(
+            pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, stds,
+            jax.random.PRNGKey(7), cfg)
+        assert list(kept) == list(range(P))
+        for name in ("percentile_25", "percentile_90"):
+            np.testing.assert_allclose(outputs[name],
+                                       np.asarray(dense_out[name]),
+                                       atol=(max_v - min_v) / 1e4)
+
+    def test_percentile_blocked_huge_p_bounded_memory(self):
+        # P = 10^7 with rows concentrated in a few partitions: only
+        # row-bearing blocks run; percentile values stay close to the true
+        # per-partition quantiles at zero noise.
+        P = 10_000_000
+        metrics = [pdp.Metrics.PERCENTILE(50)]
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(
+            P, private=True, metrics_list=metrics, l0=4, linf=64, eps=30)
+        stds = np.zeros_like(np.asarray(stds))
+        rng = np.random.default_rng(9)
+        n = 4000
+        pid = np.arange(n, dtype=np.int32) % 997
+        # Two populated partitions far apart in the space.
+        pk = np.where(np.arange(n) % 2 == 0, 12345, P - 77).astype(np.int32)
+        values = rng.uniform(0, 5, n)
+        kept, outputs = large_p.aggregate_blocked(pid,
+                                                  pk,
+                                                  values,
+                                                  valid := np.ones(n, bool),
+                                                  min_v,
+                                                  max_v,
+                                                  min_s,
+                                                  max_s,
+                                                  mid,
+                                                  stds,
+                                                  jax.random.PRNGKey(4),
+                                                  cfg,
+                                                  block_partitions=1 << 20)
+        assert set(kept.tolist()) == {12345, P - 77}
+        for j, pk_id in enumerate(kept.tolist()):
+            true_median = np.median(values[pk == pk_id])
+            # Tree quantiles quantize to leaf width; tolerance is a couple
+            # of leaves.
+            leaf = (max_v - min_v) / (cfg.branching**cfg.tree_height)
+            assert abs(outputs["percentile_50"][j] -
+                       true_median) < 3 * leaf + 0.05
 
 class TestStagingRegimesAgree:
 
@@ -337,7 +398,13 @@ class TestStagingRegimesAgree:
         values = np.asarray(values)
         valid = np.ones(len(pid), bool)
 
-        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P, eps=1e7)
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(
+            P,
+            eps=1e7,
+            metrics_list=[
+                pdp.Metrics.COUNT, pdp.Metrics.SUM,
+                pdp.Metrics.PERCENTILE(50)
+            ])
 
         def run(row_chunk):
             return large_p.aggregate_blocked(pid, pk, values, valid, min_v,
@@ -356,6 +423,11 @@ class TestStagingRegimesAgree:
                                    atol=1e-2)
         np.testing.assert_allclose(outs_fast["sum"], outs_host["sum"],
                                    atol=1e-1)
+        # Percentiles: leaf staging must survive the host-staged merge;
+        # values are leaf-quantized and noise is negligible at huge eps.
+        np.testing.assert_allclose(outs_fast["percentile_50"],
+                                   outs_host["percentile_50"],
+                                   atol=1e-2)
 
 
 class TestPresortedReduceContract:
