@@ -176,42 +176,16 @@ def _bench_eps_sweep(jax, jnp, on_tpu):
 
 def _bench_large_p(jax, on_tpu):
     """10^7-partition aggregation in bounded memory via the blocked
-    partition-axis path (parallel/large_p.py)."""
-    import pipelinedp_tpu as pdp
-    from pipelinedp_tpu import combiners, executor
-    from pipelinedp_tpu.aggregate_params import MechanismType
-    from pipelinedp_tpu.ops import selection_ops
+    partition-axis path (parallel/large_p.py). Spec + data shared with the
+    standalone benchmarks (benchmarks/_common.py) so the numbers stay
+    comparable."""
+    from benchmarks import _common
     from pipelinedp_tpu.parallel import large_p
 
     P = 10_000_000
     n = 2**22 if on_tpu else 2**18
-    params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
-                                 noise_kind=pdp.NoiseKind.LAPLACE,
-                                 max_partitions_contributed=4,
-                                 max_contributions_per_partition=8,
-                                 min_value=0.0,
-                                 max_value=5.0)
-    accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
-                                           total_delta=1e-6)
-    compound = combiners.create_compound_combiner(params, accountant)
-    budget = accountant.request_budget(MechanismType.GENERIC)
-    accountant.compute_budgets()
-    selection = selection_ops.selection_params_from_host(
-        params.partition_selection_strategy, budget.eps, budget.delta,
-        params.max_partitions_contributed, None)
-    cfg = executor.make_kernel_config(params, compound, P,
-                                      private_selection=True,
-                                      selection_params=selection)
-    stds = executor.compute_noise_stds(compound, params)
-    min_v, max_v, min_s, max_s, mid = executor.kernel_scalars(params)
-
-    rng = np.random.default_rng(5)
-    pid = rng.integers(0, 1_000_000, n).astype(np.int32)
-    # Partition popularity: heavy head + tail across the full 10^7 space.
-    u = rng.random(n)
-    pk = (np.power(u, 6.0) * P).astype(np.int32)
-    values = rng.uniform(0, 5, n)
-    valid = np.ones(n, dtype=bool)
+    _, cfg, stds, (min_v, max_v, min_s, max_s, mid) = _common.build_spec(P)
+    pid, pk, values, valid = _common.zipfish_data(n, P)
 
     def run(key_seed):
         return large_p.aggregate_blocked(pid,
@@ -223,7 +197,7 @@ def _bench_large_p(jax, on_tpu):
                                          min_s,
                                          max_s,
                                          mid,
-                                         np.asarray(stds),
+                                         stds,
                                          jax.random.PRNGKey(key_seed),
                                          cfg,
                                          block_partitions=1 << 20)

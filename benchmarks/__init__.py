@@ -1,0 +1,5 @@
+"""Benchmark and profiling scripts (see README.md in this directory).
+
+Importable as a package so bench.py at the repo root can share the spec
+and data construction in benchmarks._common with the standalone scripts.
+"""

@@ -1,0 +1,65 @@
+"""Shared harness for the benchmark scripts: spec construction + data.
+
+Import order matters: call path_setup() (which also honors an explicit
+JAX_PLATFORMS=cpu request — the sitecustomize plugin would otherwise
+override the env var) before importing pipelinedp_tpu.
+"""
+import os
+import sys
+
+import numpy as np
+
+
+def path_setup():
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import jax
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+
+def build_spec(n_partitions, metrics=None, l0=4, linf=8, eps=1.0):
+    """The standard bench aggregation spec: COUNT+SUM, Laplace, eps=1,
+    private truncated-geometric selection (BASELINE configs 1/3 shape).
+
+    Returns (params, cfg, stds ndarray, (min_v, max_v, min_s, max_s, mid)).
+    """
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import combiners, executor
+    from pipelinedp_tpu.aggregate_params import MechanismType
+    from pipelinedp_tpu.ops import selection_ops
+
+    params = pdp.AggregateParams(
+        metrics=metrics or [pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=l0,
+        max_contributions_per_partition=linf,
+        min_value=0.0,
+        max_value=5.0)
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=eps,
+                                           total_delta=1e-6)
+    compound = combiners.create_compound_combiner(params, accountant)
+    budget = accountant.request_budget(MechanismType.GENERIC)
+    accountant.compute_budgets()
+    selection = selection_ops.selection_params_from_host(
+        params.partition_selection_strategy, budget.eps, budget.delta,
+        params.max_partitions_contributed, None)
+    cfg = executor.make_kernel_config(params, compound, n_partitions,
+                                      private_selection=True,
+                                      selection_params=selection)
+    stds = np.asarray(executor.compute_noise_stds(compound, params))
+    return params, cfg, stds, executor.kernel_scalars(params)
+
+
+def zipfish_data(n, n_partitions, n_users=1_000_000, power=6.0, seed=5):
+    """Host columnar data with exponentially-tilted partition popularity.
+
+    power=6.0 concentrates rows in a heavy head with a long sparse tail
+    across the full partition space (the large-P regime); the dense-kernel
+    profile uses power=3.0 over its small P.
+    """
+    rng = np.random.default_rng(seed)
+    pid = rng.integers(0, n_users, n).astype(np.int32)
+    pk = (np.power(rng.random(n), power) * n_partitions).astype(np.int32)
+    values = rng.uniform(0, 5, n)
+    return pid, pk, values, np.ones(n, dtype=bool)

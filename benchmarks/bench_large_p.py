@@ -1,0 +1,39 @@
+"""End-to-end timing of large_p.aggregate_blocked at P = 10^7.
+
+The blocked partition-axis path is the TPU counterpart of the reference's
+unbounded-key shuffle regime (pipeline_dp/pipeline_backend.py:339-352);
+this script times the full pass (bound+compact, block dispatch, O(kept)
+result drains) on zipf-ish data over a 10^7-partition space.
+"""
+import os
+import time
+
+import _common
+
+_common.path_setup()
+
+import jax  # noqa: E402
+
+from pipelinedp_tpu.parallel import large_p  # noqa: E402
+
+P = int(os.environ.get("BENCH_P", 10_000_000))
+n = int(os.environ.get("BENCH_ROWS", 2**22))
+
+_, cfg, stds, (min_v, max_v, min_s, max_s, mid) = _common.build_spec(P)
+pid, pk, values, valid = _common.zipfish_data(n, P)
+
+
+def run(seed):
+    return large_p.aggregate_blocked(pid, pk, values, valid, min_v, max_v,
+                                     min_s, max_s, mid, stds,
+                                     jax.random.PRNGKey(seed), cfg,
+                                     block_partitions=1 << 20)
+
+
+kept, _ = run(8)
+print("warmup kept:", len(kept), flush=True)
+t0 = time.perf_counter()
+kept, outs = run(9)
+t1 = time.perf_counter()
+print(f"timed kept: {len(kept)}  {t1-t0:.3f}s  "
+      f"{n/(t1-t0)/1e3:.0f}K rows/s", flush=True)

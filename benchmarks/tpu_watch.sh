@@ -1,0 +1,24 @@
+#!/bin/bash
+# Waits for the TPU tunnel to recover, then runs the pending measurements
+# and writes results to /tmp/tpu_results.txt. Probe-first pattern: the
+# tunnel can make jax.devices() hang forever in C++, so every attempt runs
+# under `timeout` in a throwaway subprocess.
+cd "$(dirname "$0")/.."
+for i in $(seq 1 60); do
+  if timeout 90 python -c "import jax; d=jax.devices()[0]; assert d.platform != 'cpu'" 2>/dev/null; then
+    echo "TPU back at attempt $i: $(date)" > /tmp/tpu_results.txt
+    echo "=== large_p bench ===" >> /tmp/tpu_results.txt
+    timeout 2400 python benchmarks/bench_large_p.py >> /tmp/tpu_results.txt 2>&1
+    echo "=== large_p profile ===" >> /tmp/tpu_results.txt
+    timeout 2400 python benchmarks/profile_large_p.py >> /tmp/tpu_results.txt 2>&1
+    echo "=== kernel profile ===" >> /tmp/tpu_results.txt
+    timeout 2400 python benchmarks/profile_kernel.py >> /tmp/tpu_results.txt 2>&1
+    echo "=== bench.py ===" >> /tmp/tpu_results.txt
+    timeout 3600 python bench.py >> /tmp/tpu_results.txt 2>&1
+    echo "DONE" >> /tmp/tpu_results.txt
+    exit 0
+  fi
+  sleep 240
+done
+echo "TPU never recovered: $(date)" > /tmp/tpu_results.txt
+exit 1

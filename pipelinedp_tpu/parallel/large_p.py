@@ -35,7 +35,8 @@ Two row-staging regimes, switched on whether the rows fit one device chunk:
 
 import dataclasses
 import functools
-from typing import Dict, Tuple
+import time
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -226,7 +227,8 @@ def aggregate_blocked(pid,
                       *,
                       block_partitions: int = 1 << 20,
                       row_chunk: int = 1 << 24,
-                      secure_tables=None
+                      secure_tables=None,
+                      phase_times: Optional[dict] = None
                       ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
     """DP aggregation over an arbitrarily large partition space.
 
@@ -235,8 +237,16 @@ def aggregate_blocked(pid,
     memory) over the block's own rows — but the partition axis is processed
     in blocks of `block_partitions` and only kept partitions are returned.
 
+    phase_times: optional dict populated with per-phase wall-clock seconds
+    (p1_bound_compact, block_offsets, p2_blocks_total, p2_drain,
+    blocks_dispatched, total) — the profiling hook used by
+    benchmarks/profile_large_p.py so the profiler times THIS code, not a
+    replica. Adds one device sync after pass 1; leave None in production.
+
     Returns (kept_partition_ids int64[M], {metric: f[M]}).
     """
+    profiling = phase_times is not None
+    t0 = time.perf_counter()
     P = cfg.n_partitions
     pid = np.asarray(pid)
     pk = np.asarray(pk)
@@ -269,8 +279,12 @@ def aggregate_blocked(pid,
         cols_all = {name: jnp.asarray(col) for name, col in cols_all.items()}
         if leaf_all is not None:
             leaf_all = jnp.asarray(leaf_all)
+    if profiling:
+        jax.block_until_ready(spk_all)
+        phase_times["p1_bound_compact"] = time.perf_counter() - t0
 
     # --- Pass 2: bin by partition block, finalize each block. -------------
+    t1 = time.perf_counter()
     C = min(block_partitions, P)
     n_blocks = -(-P // C)
     # Dropped rows carry an int32-max sentinel > P, so searchsorted over
@@ -285,18 +299,32 @@ def aggregate_blocked(pid,
         np.iinfo(np.int32).max).astype(np.int32)
     block_starts = np.asarray(
         jnp.searchsorted(spk_all, jnp.asarray(boundaries), side="left"))
+    if profiling:
+        phase_times["block_offsets"] = time.perf_counter() - t1
     output_names = [name for e in cfg.plan for name in e.outputs]
     kept_ids = []
     kept_outputs = {name: [] for name in output_names}
 
     def consume(b, result):
         n_kept, ids_sorted, outputs_sorted = result
+        ts = time.perf_counter()
         k = int(n_kept)  # sync; gates O(kept) transfers
-        if k == 0:
-            return
-        kept_ids.append(np.asarray(ids_sorted[:k]).astype(np.int64) + b * C)
-        for name, col in outputs_sorted.items():
-            kept_outputs.setdefault(name, []).append(np.asarray(col[:k]))
+        ta = time.perf_counter()
+        if k:
+            kept_ids.append(
+                np.asarray(ids_sorted[:k]).astype(np.int64) + b * C)
+            for name, col in outputs_sorted.items():
+                kept_outputs.setdefault(name, []).append(
+                    np.asarray(col[:k]))
+        if profiling:
+            # Sync wait (device still computing) and drain (the O(kept)
+            # transfers) are attributed separately — conflating them would
+            # re-create the transfer-bound misdiagnosis this hook exists
+            # to prevent.
+            phase_times["p2_sync_wait"] = (
+                phase_times.get("p2_sync_wait", 0.0) + ta - ts)
+            phase_times["p2_drain"] = (phase_times.get("p2_drain", 0.0) +
+                                       time.perf_counter() - ta)
 
     # Dispatch ahead of the sync point: jax execution is async, so the
     # device pipelines upcoming block kernels while the host drains earlier
@@ -307,6 +335,8 @@ def aggregate_blocked(pid,
     # exists to avoid.
     max_in_flight = 8
     pending = []
+    n_dispatched = 0
+    t2 = time.perf_counter()
     for b in range(n_blocks):
         lo, hi = int(block_starts[b]), int(block_starts[b + 1])
         if lo == hi and cfg.private_selection:
@@ -315,6 +345,7 @@ def aggregate_blocked(pid,
             # blocks provably emit nothing — skip their device work. In the
             # sparse 10^9-partition regime this skips nearly every block.
             continue
+        n_dispatched += 1
         c_actual = min(C, P - b * C)
         cfg_block = dataclasses.replace(cfg, n_partitions=c_actual)
         pending.append((b, _block_kernel_dev(spk_all, pair_all, cols_all,
@@ -328,6 +359,11 @@ def aggregate_blocked(pid,
             consume(*pending.pop(0))
     for entry in pending:
         consume(*entry)
+    if profiling:
+        now = time.perf_counter()
+        phase_times["p2_blocks_total"] = now - t2
+        phase_times["blocks_dispatched"] = n_dispatched
+        phase_times["total"] = now - t0
 
     # Each block emits kept partitions in ascending relative id (the compact
     # sort is stable) and blocks are consumed in ascending order, so the
