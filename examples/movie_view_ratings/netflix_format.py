@@ -8,16 +8,20 @@ File format (reference examples/movie_view_ratings/common_utils.py:33-60):
     <next_movie_id>:
     ...
 
-Parsing is vectorized: the whole file is split into a string array, header
-lines are detected in one pass, and each data line picks up its movie id by
-a cumulative-header index — no per-line Python loop, feeding straight into
+Parsing is vectorized: lines are split into a string array, header lines
+are detected in one pass, and each data line picks up its movie id by a
+cumulative-header index — no per-line Python loop, feeding straight into
 the columnar ingest path (pipelinedp_tpu.columnar.encode_columns).
+parse_file_chunks streams the same parse in bounded-memory chunks for the
+overlapped ingest pipeline (pipelinedp_tpu.ingest).
 """
 
 from dataclasses import dataclass
-from typing import Tuple
+from typing import Iterator, Optional, Tuple
 
 import numpy as np
+
+Columns = Tuple[np.ndarray, np.ndarray, np.ndarray]
 
 
 @dataclass
@@ -27,28 +31,83 @@ class MovieView:
     rating: int
 
 
-def parse_file_columns(
-        filename: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+def _parse_lines(lines: np.ndarray, last_movie: Optional[int],
+                 context: str) -> Tuple[Optional[Columns], Optional[int]]:
+    """Vectorized parse of a line array; `last_movie` is the header carried
+    in from the previous chunk (None at file start)."""
+    lines = lines[np.char.str_len(lines) > 0]
+    if len(lines) == 0:
+        return None, last_movie
+    is_header = np.char.endswith(lines, ":")
+    headers = np.char.rstrip(lines[is_header], ":").astype(np.int64)
+    # Each data line belongs to the most recent header above it (index -1 =
+    # the carried-in header from the previous chunk).
+    movie_of_line = np.cumsum(is_header) - 1
+    data_mask = ~is_header
+    if last_movie is None and bool((movie_of_line[data_mask] < 0).any()):
+        raise ValueError(
+            f"{context}: data lines before the first 'movie_id:' header")
+    table = np.concatenate(
+        [[last_movie if last_movie is not None else -1], headers])
+    movie_col = table[movie_of_line[data_mask] + 1]
+    data_lines = lines[data_mask]
+    if len(data_lines) == 0:
+        cols = None
+    else:
+        # "user_id,rating,date" -> first two comma-separated fields.
+        first = np.char.partition(data_lines, ",")
+        users = first[:, 0].astype(np.int64)
+        ratings = np.char.partition(first[:, 2], ",")[:, 0].astype(np.int64)
+        cols = (users, movie_col, ratings)
+    new_last = int(headers[-1]) if len(headers) else last_movie
+    return cols, new_last
+
+
+def parse_file_columns(filename: str) -> Columns:
     """Parses a Netflix-format file into (user_ids, movie_ids, ratings)."""
     with open(filename) as f:
         lines = np.array(f.read().split("\n"))
-    lines = lines[np.char.str_len(lines) > 0]
-    is_header = np.char.endswith(lines, ":")
-    movie_ids = np.char.rstrip(lines[is_header], ":").astype(np.int64)
-    if len(movie_ids) == 0:
+    cols, last = _parse_lines(lines, None, filename)
+    if last is None:
         raise ValueError(f"{filename}: no 'movie_id:' header lines found")
-    # Each data line belongs to the most recent header above it.
-    movie_of_line = np.cumsum(is_header) - 1
-    if not is_header[0]:
-        raise ValueError(
-            f"{filename}: data lines before the first 'movie_id:' header")
-    data_lines = lines[~is_header]
-    movie_col = movie_ids[movie_of_line[~is_header]]
-    # "user_id,rating,date" -> first two comma-separated fields.
-    first = np.char.partition(data_lines, ",")
-    users = first[:, 0].astype(np.int64)
-    ratings = np.char.partition(first[:, 2], ",")[:, 0].astype(np.int64)
-    return users, movie_col, ratings
+    if cols is None:
+        empty = np.zeros(0, np.int64)
+        return empty, empty.copy(), empty.copy()
+    return cols
+
+
+def parse_file_chunks(filename: str,
+                      chunk_bytes: int = 1 << 24) -> Iterator[Columns]:
+    """Streams (user_ids, movie_ids, ratings) column chunks from a
+    Netflix-format file in bounded memory.
+
+    Chunks split at line boundaries; the current movie header carries
+    across chunks, so concatenating all chunks equals parse_file_columns.
+    """
+    last_movie: Optional[int] = None
+    carry = ""
+    with open(filename) as f:
+        while True:
+            buf = f.read(chunk_bytes)
+            if not buf:
+                break
+            buf = carry + buf
+            cut = buf.rfind("\n")
+            if cut == -1:
+                carry = buf
+                continue
+            carry = buf[cut + 1:]
+            cols, last_movie = _parse_lines(np.array(buf[:cut].split("\n")),
+                                            last_movie, filename)
+            if cols is not None:
+                yield cols
+    if carry:
+        cols, last_movie = _parse_lines(np.array([carry]), last_movie,
+                                        filename)
+        if cols is not None:
+            yield cols
+    if last_movie is None:
+        raise ValueError(f"{filename}: no 'movie_id:' header lines found")
 
 
 def parse_file(filename: str):
@@ -65,21 +124,34 @@ def generate_file(filename: str,
                   n_users: int = 1000,
                   n_movies: int = 99,
                   seed: int = 0) -> None:
-    """Writes a synthetic dataset in the Netflix file format."""
+    """Writes a synthetic dataset in the Netflix file format (vectorized —
+    no per-row Python loop, so multi-million-row bench inputs write in
+    seconds)."""
     rng = np.random.default_rng(seed)
+    if n_rows == 0:
+        open(filename, "w").close()
+        return
     # Zipf-ish movie popularity, uniform users, ratings skewed high.
     movies = (np.power(rng.random(n_rows), 2.5) * n_movies).astype(int) + 1
     users = rng.integers(0, n_users, n_rows)
     ratings = rng.choice([1, 2, 3, 4, 5], n_rows,
                          p=[0.05, 0.1, 0.2, 0.35, 0.3])
     order = np.argsort(movies, kind="stable")
+    m_s, u_s, r_s = movies[order], users[order], ratings[order]
+    data = np.char.add(
+        np.char.add(u_s.astype(str), ","),
+        np.char.add(np.char.add(r_s.astype(str), ","), "2023-01-01"))
+    is_new = np.concatenate([[True], m_s[1:] != m_s[:-1]])
+    # Interleave header lines before each movie's first row: row i lands at
+    # slot i + (#headers at or before it); its header, when new, goes one
+    # slot earlier.
+    slot = np.arange(n_rows) + np.cumsum(is_new)
+    out = np.empty(n_rows + int(is_new.sum()), dtype=object)
+    out[slot] = data
+    out[slot[is_new] - 1] = np.char.add(m_s[is_new].astype(str), ":")
     with open(filename, "w") as f:
-        last_movie = None
-        for i in order:
-            if movies[i] != last_movie:
-                f.write(f"{movies[i]}:\n")
-                last_movie = movies[i]
-            f.write(f"{users[i]},{ratings[i]},2023-01-01\n")
+        f.write("\n".join(out))
+        f.write("\n")
 
 
 def write_to_file(col, filename: str) -> None:

@@ -47,6 +47,10 @@ def main():
     parser.add_argument("--local", action="store_true",
                         help="pure-Python local backend instead of the fused "
                         "device backend")
+    parser.add_argument("--streaming", action="store_true",
+                        help="chunked overlapped ingest (parse/factorize "
+                        "each file chunk while the previous chunk uploads; "
+                        "pipelinedp_tpu.ingest) — device backend only")
     parser.add_argument("--epsilon", type=float, default=1.0)
     parser.add_argument("--delta", type=float, default=1e-6)
     args = parser.parse_args()
@@ -59,8 +63,19 @@ def main():
     if not input_file:
         parser.error("provide --input_file or --generate_rows")
 
-    movie_views = netflix_format.parse_file(input_file)
-    print(f"parsed {len(movie_views)} movie views")
+    public_partitions = list(range(1, 100))
+    if args.streaming:
+        if args.local:
+            parser.error("--streaming requires the device backend")
+        from pipelinedp_tpu import ingest
+        movie_views = ingest.stream_encode_columns(
+            ((u, m, r.astype("float32"))
+             for u, m, r in netflix_format.parse_file_chunks(input_file)),
+            public_partitions=public_partitions)
+        print(f"streamed {movie_views.n_rows} movie views to device")
+    else:
+        movie_views = netflix_format.parse_file(input_file)
+        print(f"parsed {len(movie_views)} movie views")
 
     backend = pdp.LocalBackend() if args.local else pdp.TPUBackend()
     if args.pld_accounting:
@@ -94,7 +109,7 @@ def main():
         movie_views,
         params,
         data_extractors,
-        public_partitions=list(range(1, 100)),
+        public_partitions=public_partitions,
         out_explain_computation_report=explain_computation_report)
     budget_accountant.compute_budgets()
 

@@ -41,6 +41,10 @@ class EncodedData:
     # partition id -> original partition key (list or ndarray)
     partition_vocab: Sequence[Any]
     n_privacy_ids: int
+    # True when pk was encoded against a FIXED public-partition vocabulary
+    # (rows elsewhere already dropped): such data must be aggregated WITH
+    # those public partitions, never under private selection.
+    public_encoded: bool = False
 
     @property
     def n_rows(self) -> int:
@@ -130,7 +134,8 @@ def encode_columns(
                        pk=pk,
                        values=np.asarray(values, dtype=np.float64),
                        partition_vocab=partition_vocab,
-                       n_privacy_ids=len(pid_vocab))
+                       n_privacy_ids=len(pid_vocab),
+                       public_encoded=public_partitions is not None)
 
 
 def encode(col,
@@ -143,6 +148,25 @@ def encode(col,
     analogue of DPEngine._drop_partitions + _add_empty_public_partitions
     (empty public partitions exist as all-zero columns).
     """
+    if isinstance(col, EncodedData):
+        # Pre-encoded input (e.g. ingest.stream_encode_columns): extractors
+        # are not consulted; with public partitions the caller must have
+        # encoded against that same vocabulary.
+        if (public_partitions is not None and
+                list(dict.fromkeys(public_partitions)) != list(
+                    col.partition_vocab)):
+            raise ValueError(
+                "Pre-encoded input must be encoded against the same public "
+                "partitions passed to aggregate() (ingest."
+                "stream_encode_columns(..., public_partitions=...)).")
+        if public_partitions is None and col.public_encoded:
+            raise ValueError(
+                "This input was encoded against a fixed public-partition "
+                "vocabulary (rows elsewhere were already dropped); "
+                "aggregating it under private partition selection would "
+                "silently lose them. Pass the same public_partitions, or "
+                "re-encode without them.")
+        return col
     pid_extractor = data_extractors.privacy_id_extractor or (lambda row: 0)
     pk_extractor = data_extractors.partition_extractor
     value_extractor = data_extractors.value_extractor or (lambda row: 0.0)

@@ -32,6 +32,7 @@ scalars, so BudgetAccountant.compute_budgets() may run after compilation;
 the engine wraps execution in a lazy generator that runs on first iteration.
 """
 
+import dataclasses
 import functools
 import math
 from dataclasses import dataclass
@@ -989,9 +990,11 @@ def lazy_select_partitions(backend, col, params, data_extractors,
                 params.max_partitions_contributed, n_partitions, selection)
         else:
             # Selection never reads values; a zero-width column keeps
-            # pad_rows from copying the real one.
-            encoded.values = np.zeros((encoded.n_rows, 0), np.float64)
-            pid, pk, _, valid = pad_rows(encoded)
+            # pad_rows from copying the real one. A COPY of the container —
+            # pre-encoded callers may reuse their EncodedData afterwards.
+            slim = dataclasses.replace(
+                encoded, values=np.zeros((encoded.n_rows, 0), np.float64))
+            pid, pk, _, valid = pad_rows(slim)
             keep = select_partitions_kernel(
                 jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(valid), key,
                 params.max_partitions_contributed, n_partitions, selection)
@@ -1084,13 +1087,26 @@ def _round_up_pow2(n: int) -> int:
 
 def pad_rows(encoded: columnar.EncodedData):
     """Pads row arrays to the next power of two (invalid-marked), so jit
-    compilation is reused across datasets of similar size."""
+    compilation is reused across datasets of similar size.
+
+    Device-resident encodings (ingest.stream_encode_columns) pad with jnp
+    on device — a host round-trip here would undo the streamed upload."""
     n = encoded.n_rows
     n_pad = max(8, _round_up_pow2(n))
     if n_pad == n:
         return (encoded.pid, encoded.pk, encoded.values,
                 encoded.valid)
     pad = n_pad - n
+    if isinstance(encoded.pid, jax.Array):
+        pid = jnp.concatenate([encoded.pid, jnp.zeros(pad, jnp.int32)])
+        pk = jnp.concatenate([encoded.pk, jnp.full(pad, -1, jnp.int32)])
+        values = jnp.concatenate([
+            encoded.values,
+            jnp.zeros((pad,) + encoded.values.shape[1:],
+                      encoded.values.dtype)
+        ])
+        valid = jnp.concatenate([encoded.valid, jnp.zeros(pad, bool)])
+        return pid, pk, values, valid
     pid = np.concatenate([encoded.pid, np.zeros(pad, np.int32)])
     pk = np.concatenate([encoded.pk, np.full(pad, -1, np.int32)])
     values = np.concatenate([

@@ -1,0 +1,131 @@
+"""Chunked, overlapped host->device ingest.
+
+The reference delegates unbounded input to Beam/Spark IO
+(pipeline_dp/pipeline_backend.py:223-374); the TPU build's equivalent is a
+streaming host pipeline: parse -> factorize -> upload proceeds chunk by
+chunk, and because device copies dispatch asynchronously, the upload of
+chunk i overlaps the host parse/factorization of chunk i+1. On the 1-core
+bench host that overlap — not host parallelism — is what moves end-to-end
+time toward max(host encode, device transfer) instead of their sum.
+
+The result is a device-resident EncodedData whose columns are jax arrays;
+the executor pads it on device (executor.pad_rows) and the engine accepts
+it directly in place of a row collection (columnar.encode passthrough), so
+
+    encoded = ingest.stream_encode_columns(chunk_iter)
+    result = engine.aggregate(encoded, params, extractors)
+
+is the bulk-file counterpart of handing the engine Python rows.
+
+Contribution bounding is global per privacy id, so the fused kernel still
+runs over the full device-resident dataset — streaming here bounds HOST
+memory and overlaps transfer, not device memory (the blocked large-P path
+owns that axis).
+"""
+
+from typing import Any, Iterable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pipelinedp_tpu import columnar
+
+try:
+    import pandas as _pd
+except ImportError:  # pragma: no cover - pandas is in the standard image
+    _pd = None
+
+
+class ChunkedVocabEncoder:
+    """Incremental first-occurrence vocabulary encoding across chunks.
+
+    Feeding chunks in order yields exactly the codes columnar.factorize
+    would assign to the concatenation: per-chunk factorization (C speed)
+    followed by a remap of the chunk's uniques against the growing global
+    vocabulary — O(chunk + new uniques) per chunk, never O(total).
+    """
+
+    def __init__(self):
+        self._index = None  # pandas Index (fast path)
+        self._dict: Optional[dict] = None  # fallback vocab
+
+    def encode(self, raw) -> np.ndarray:
+        raw = columnar._as_key_array(np.asarray(raw))
+        if _pd is not None:
+            codes, uniques = _pd.factorize(raw, use_na_sentinel=False)
+            uniques = _pd.Index(uniques)
+            if self._index is None:
+                self._index = uniques
+                return codes.astype(np.int32)
+            mapped = self._index.get_indexer(uniques)
+            is_new = mapped == -1
+            if is_new.any():
+                mapped[is_new] = len(self._index) + np.arange(
+                    int(is_new.sum()))
+                self._index = self._index.append(uniques[is_new])
+            return mapped.astype(np.int32)[codes]
+        # No pandas: chunk-local factorize + dict remap of uniques.
+        codes, uniques = columnar.factorize(raw)
+        if self._dict is None:
+            self._dict = {}
+        remap = np.empty(len(uniques), np.int32)
+        for j, key in enumerate(uniques):
+            remap[j] = self._dict.setdefault(key, len(self._dict))
+        return remap[codes]
+
+    @property
+    def vocabulary(self) -> Sequence[Any]:
+        if self._index is not None:
+            return np.asarray(self._index)
+        return np.fromiter(self._dict or (), dtype=object,
+                           count=len(self._dict or ()))
+
+    def __len__(self) -> int:
+        if self._index is not None:
+            return len(self._index)
+        return len(self._dict or ())
+
+
+def stream_encode_columns(
+        chunks: Iterable[Tuple[Sequence[Any], Sequence[Any],
+                               Sequence[float]]],
+        public_partitions: Optional[Sequence[Any]] = None
+) -> columnar.EncodedData:
+    """Encodes and uploads (pid_raw, pk_raw, values) column chunks,
+    overlapping each chunk's device copy with the next chunk's parsing.
+
+    Returns a device-resident EncodedData (jax-array columns, float32
+    values — the kernel compute dtype, at half the f64 upload volume).
+    """
+    import jax.numpy as jnp
+
+    pid_enc = ChunkedVocabEncoder()
+    pk_enc = ChunkedVocabEncoder()
+    partition_vocab = None
+    if public_partitions is not None:
+        partition_vocab = list(dict.fromkeys(public_partitions))
+    dev_pid, dev_pk, dev_vals = [], [], []
+    for pid_raw, pk_raw, values in chunks:
+        pid = pid_enc.encode(pid_raw)
+        if partition_vocab is not None:
+            pk = columnar.encode_with_vocab(
+                columnar._as_key_array(np.asarray(pk_raw)), partition_vocab)
+        else:
+            pk = pk_enc.encode(pk_raw)
+        # jnp.asarray dispatches the host->device copy asynchronously; the
+        # loop continues into the next chunk's parse while it lands.
+        dev_pid.append(jnp.asarray(pid))
+        dev_pk.append(jnp.asarray(pk))
+        dev_vals.append(
+            jnp.asarray(np.asarray(values, dtype=np.float32)))
+    if not dev_pid:
+        empty = jnp.zeros(0, jnp.int32)
+        dev_pid, dev_pk = [empty], [empty]
+        dev_vals = [jnp.zeros(0, jnp.float32)]
+    return columnar.EncodedData(
+        pid=jnp.concatenate(dev_pid),
+        pk=jnp.concatenate(dev_pk),
+        values=jnp.concatenate(dev_vals),
+        partition_vocab=(partition_vocab if partition_vocab is not None else
+                         pk_enc.vocabulary),
+        n_privacy_ids=len(pid_enc),
+        public_encoded=public_partitions is not None)

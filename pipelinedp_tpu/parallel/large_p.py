@@ -159,8 +159,12 @@ def _chunk_ends(pid_sorted: np.ndarray, row_chunk: int) -> np.ndarray:
     return np.asarray(ends)
 
 
-def _pad_to(a: np.ndarray, cap: int, fill) -> np.ndarray:
+def _pad_to(a, cap: int, fill):
     widths = ((0, cap - len(a)),) + ((0, 0),) * (a.ndim - 1)
+    if isinstance(a, jax.Array):
+        # Device-resident columns (streamed ingest) pad on device; np.pad
+        # would silently download them.
+        return jnp.pad(a, widths, constant_values=fill)
     return np.pad(a, widths, constant_values=fill)
 
 
@@ -248,12 +252,18 @@ def aggregate_blocked(pid,
     profiling = phase_times is not None
     t0 = time.perf_counter()
     P = cfg.n_partitions
-    pid = np.asarray(pid)
-    pk = np.asarray(pk)
-    # Pre-cast to the kernel float dtype: the kernel casts on device anyway,
-    # and float64 host arrays would double the upload volume.
-    values = np.asarray(values, dtype=np.dtype(executor._ftype()))
-    valid = np.asarray(valid)
+    device_resident = isinstance(pid, jax.Array)
+    if device_resident:
+        # Streamed-ingest columns stay on device (no download/re-upload);
+        # only the chunked host-staging regime below needs host copies.
+        values = values.astype(executor._ftype())
+    else:
+        pid = np.asarray(pid)
+        pk = np.asarray(pk)
+        # Pre-cast to the kernel float dtype: the kernel casts on device
+        # anyway, and float64 host arrays would double the upload volume.
+        values = np.asarray(values, dtype=np.dtype(executor._ftype()))
+        valid = np.asarray(valid)
     n = len(pid)
 
     rows_key, final_key = jax.random.split(rng_key, 2)
@@ -268,6 +278,11 @@ def aggregate_blocked(pid,
             _pad_to(values, cap, 0), _pad_to(valid, cap, False), min_v,
             max_v, min_s, max_s, mid, jax.random.fold_in(rows_key, 0), cfg)
     else:
+        if device_resident:
+            # Host staging re-chunks on privacy-id boundaries with host
+            # argsorts; one download is unavoidable in this regime.
+            pid, pk, values, valid = (np.asarray(pid), np.asarray(pk),
+                                      np.asarray(values), np.asarray(valid))
         spk_all, pair_all, cols_all, leaf_all = \
             _bound_and_compact_host_staged(
                 pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,

@@ -1,0 +1,249 @@
+"""Tests for the chunked overlapped ingest pipeline (pipelinedp_tpu.ingest)
+and the Netflix-format chunked parser."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import columnar, ingest
+
+sys.path.insert(0,
+                os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from examples.movie_view_ratings import netflix_format  # noqa: E402
+
+HUGE_EPS = 1e7
+
+
+class TestChunkedVocabEncoder:
+
+    def test_matches_global_factorize(self):
+        rng = np.random.default_rng(0)
+        raw = np.char.add("k", rng.integers(0, 500, 10_000).astype(str))
+        expected_codes, expected_vocab = columnar.factorize(raw)
+        enc = ingest.ChunkedVocabEncoder()
+        got = np.concatenate([
+            enc.encode(raw[i:i + 1234]) for i in range(0, len(raw), 1234)
+        ])
+        np.testing.assert_array_equal(got, expected_codes)
+        assert list(enc.vocabulary) == list(expected_vocab)
+        assert len(enc) == len(expected_vocab)
+
+    def test_int_keys_and_single_chunk(self):
+        raw = np.array([5, 5, 7, 5, 9, 7])
+        enc = ingest.ChunkedVocabEncoder()
+        codes = enc.encode(raw)
+        np.testing.assert_array_equal(codes, [0, 0, 1, 0, 2, 1])
+        assert list(enc.vocabulary) == [5, 7, 9]
+
+
+class TestNetflixChunkedParse:
+
+    @pytest.mark.parametrize("chunk_bytes", [64, 1000, 1 << 20])
+    def test_chunks_concat_equals_whole_parse(self, tmp_path, chunk_bytes):
+        path = str(tmp_path / "views.txt")
+        netflix_format.generate_file(path, 3000, n_users=50, n_movies=40,
+                                     seed=3)
+        users, movies, ratings = netflix_format.parse_file_columns(path)
+        chunks = list(netflix_format.parse_file_chunks(path, chunk_bytes))
+        assert len(chunks) >= (2 if chunk_bytes < 1000 else 1)
+        np.testing.assert_array_equal(
+            np.concatenate([c[0] for c in chunks]), users)
+        np.testing.assert_array_equal(
+            np.concatenate([c[1] for c in chunks]), movies)
+        np.testing.assert_array_equal(
+            np.concatenate([c[2] for c in chunks]), ratings)
+
+    def test_generated_file_roundtrip(self, tmp_path):
+        path = str(tmp_path / "views.txt")
+        netflix_format.generate_file(path, 500, n_users=20, n_movies=10,
+                                     seed=1)
+        users, movies, ratings = netflix_format.parse_file_columns(path)
+        assert len(users) == 500
+        assert movies.min() >= 1 and movies.max() <= 10
+        assert set(np.unique(ratings)) <= {1, 2, 3, 4, 5}
+
+    def test_headerless_file_rejected(self, tmp_path):
+        path = str(tmp_path / "bad.txt")
+        with open(path, "w") as f:
+            f.write("1,5,2023-01-01\n")
+        with pytest.raises(ValueError, match="header"):
+            list(netflix_format.parse_file_chunks(path))
+
+
+class TestStreamEncodeEngine:
+
+    @staticmethod
+    def _chunks(pid, pk, values, size):
+        for i in range(0, len(pid), size):
+            yield pid[i:i + size], pk[i:i + size], values[i:i + size]
+
+    def _data(self):
+        rng = np.random.default_rng(7)
+        pid = np.char.add("u", rng.integers(0, 80, 4000).astype(str))
+        pk = np.char.add("m", rng.integers(0, 25, 4000).astype(str))
+        values = rng.uniform(0, 5, 4000)
+        return pid, pk, values
+
+    def _aggregate(self, col, public=None, extractors=None):
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT,
+                                              pdp.Metrics.SUM],
+                                     max_partitions_contributed=25,
+                                     max_contributions_per_partition=16,
+                                     min_value=0.0,
+                                     max_value=5.0)
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                        total_delta=1e-5)
+        engine = pdp.DPEngine(acc, pdp.TPUBackend(noise_seed=11))
+        if extractors is None:
+            extractors = pdp.DataExtractors(
+                privacy_id_extractor=lambda r: r[0],
+                partition_extractor=lambda r: r[1],
+                value_extractor=lambda r: r[2])
+        result = engine.aggregate(col, params, extractors, public)
+        acc.compute_budgets()
+        return dict(result)
+
+    def test_streamed_equals_row_input(self):
+        pid, pk, values = self._data()
+        encoded = ingest.stream_encode_columns(
+            self._chunks(pid, pk, values, 700))
+        streamed = self._aggregate(encoded)
+        rows = list(zip(pid, pk, values))
+        direct = self._aggregate(rows)
+        assert set(streamed) == set(direct)
+        for key in direct:
+            assert streamed[key].count == pytest.approx(direct[key].count,
+                                                        abs=0.05)
+            assert streamed[key].sum == pytest.approx(direct[key].sum,
+                                                      abs=0.1)
+
+    def test_streamed_public_partitions(self):
+        pid, pk, values = self._data()
+        public = ["m0", "m1", "m_empty"]
+        encoded = ingest.stream_encode_columns(
+            self._chunks(pid, pk, values, 900), public_partitions=public)
+        result = self._aggregate(encoded, public=public)
+        assert set(result) == set(public)
+        direct = self._aggregate(list(zip(pid, pk, values)), public=public)
+        for key in public:
+            assert result[key].count == pytest.approx(direct[key].count,
+                                                      abs=0.05)
+
+    def test_public_partition_mismatch_raises(self):
+        pid, pk, values = self._data()
+        encoded = ingest.stream_encode_columns(
+            self._chunks(pid, pk, values, 900), public_partitions=["m0"])
+        with pytest.raises(ValueError, match="same public partitions"):
+            self._aggregate(encoded, public=["m0", "m1"])
+
+    def test_empty_chunk_iter(self):
+        encoded = ingest.stream_encode_columns(iter(()))
+        assert encoded.n_rows == 0
+        assert encoded.n_partitions == 0
+
+    def test_file_to_result_end_to_end(self, tmp_path):
+        path = str(tmp_path / "views.txt")
+        netflix_format.generate_file(path, 4000, n_users=60, n_movies=30,
+                                     seed=5)
+        chunk_iter = ((u, m, r.astype(np.float32)) for u, m, r in
+                      netflix_format.parse_file_chunks(path, 2048))
+        encoded = ingest.stream_encode_columns(chunk_iter)
+        result = self._aggregate(encoded)
+        users, movies, ratings = netflix_format.parse_file_columns(path)
+        direct = self._aggregate(list(zip(users, movies, ratings)))
+        assert set(result) == set(direct)
+        for key in direct:
+            assert result[key].count == pytest.approx(direct[key].count,
+                                                      abs=0.05)
+            assert result[key].sum == pytest.approx(direct[key].sum,
+                                                    abs=0.1)
+
+
+class TestPreEncodedGuards:
+
+    def _encoded(self, public=None):
+        rng = np.random.default_rng(3)
+        pid = np.char.add("u", rng.integers(0, 50, 2000).astype(str))
+        pk = np.char.add("m", rng.integers(0, 12, 2000).astype(str))
+        values = rng.uniform(0, 5, 2000)
+        return ingest.stream_encode_columns(
+            iter([(pid, pk, values)]), public_partitions=public)
+
+    def test_public_encoded_without_public_raises(self):
+        encoded = self._encoded(public=["m0", "m1"])
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_partitions_contributed=2,
+                                     max_contributions_per_partition=2)
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                        total_delta=1e-5)
+        engine = pdp.DPEngine(acc, pdp.TPUBackend(noise_seed=1))
+        ext = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                 partition_extractor=lambda r: r[1],
+                                 value_extractor=lambda r: r[2])
+        result = engine.aggregate(encoded, params, ext)
+        acc.compute_budgets()
+        with pytest.raises(ValueError, match="public-partition vocabulary"):
+            list(result)
+
+    def test_select_partitions_does_not_destroy_values(self):
+        encoded = self._encoded()
+        ext = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                 partition_extractor=lambda r: r[1],
+                                 value_extractor=lambda r: r[2])
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                        total_delta=1e-5)
+        engine = pdp.DPEngine(acc, pdp.TPUBackend(noise_seed=1))
+        sel = engine.select_partitions(
+            encoded, pdp.SelectPartitionsParams(max_partitions_contributed=12),
+            ext)
+        agg = engine.aggregate(
+            encoded,
+            pdp.AggregateParams(metrics=[pdp.Metrics.SUM],
+                                max_partitions_contributed=12,
+                                max_contributions_per_partition=64,
+                                min_value=0.0,
+                                max_value=5.0), ext)
+        acc.compute_budgets()
+        assert len(list(sel)) == 12
+        agg = dict(agg)
+        # values column must have survived select_partitions: sums nonzero.
+        assert encoded.values.shape == (2000,)
+        assert sum(v.sum for v in agg.values()) > 100
+
+    def test_device_resident_blocked_route(self):
+        encoded = self._encoded()
+        ext = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                 partition_extractor=lambda r: r[1],
+                                 value_extractor=lambda r: r[2])
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT,
+                                              pdp.Metrics.SUM],
+                                     max_partitions_contributed=12,
+                                     max_contributions_per_partition=64,
+                                     min_value=0.0,
+                                     max_value=5.0)
+
+        def run(backend):
+            acc = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                            total_delta=1e-5)
+            engine = pdp.DPEngine(acc, backend)
+            result = engine.aggregate(encoded, params, ext)
+            acc.compute_budgets()
+            return dict(result)
+
+        blocked = run(pdp.TPUBackend(noise_seed=2,
+                                     large_partition_threshold=4))
+        dense = run(pdp.TPUBackend(noise_seed=2,
+                                   large_partition_threshold=None))
+        assert set(blocked) == set(dense)
+        for k in dense:
+            assert blocked[k].count == pytest.approx(dense[k].count,
+                                                     abs=0.1)
+
+
+def test_generate_file_zero_rows(tmp_path):
+    path = str(tmp_path / "empty.txt")
+    netflix_format.generate_file(path, 0)
+    assert open(path).read() == ""
