@@ -242,23 +242,33 @@ def _bench_end_to_end(on_tpu):
     extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
                                     partition_extractor=lambda r: r[1],
                                     value_extractor=lambda r: r[2])
-    start = time.perf_counter()
-    chunk_iter = ((u, m, r.astype(np.float32)) for u, m, r in
-                  netflix_format.parse_file_chunks(path))
-    encoded = ingest.stream_encode_columns(chunk_iter)
-    accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
-                                           total_delta=1e-6)
-    engine = pdp.DPEngine(accountant, pdp.TPUBackend(noise_seed=13))
-    result = engine.aggregate(encoded, params, extractors)
-    accountant.compute_budgets()
-    n_kept = sum(1 for _ in result)
-    elapsed = time.perf_counter() - start
+
+    def run_once():
+        start = time.perf_counter()
+        chunk_iter = ((u, m, r.astype(np.float32)) for u, m, r in
+                      netflix_format.parse_file_chunks(path))
+        encoded = ingest.stream_encode_columns(chunk_iter)
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                               total_delta=1e-6)
+        engine = pdp.DPEngine(accountant, pdp.TPUBackend(noise_seed=13))
+        result = engine.aggregate(encoded, params, extractors)
+        accountant.compute_budgets()
+        n_kept = sum(1 for _ in result)
+        return time.perf_counter() - start, n_kept
+
+    # Cold includes jit compilation of every kernel shape (minutes over the
+    # tunnel); warm re-runs the identical shapes against the compile cache
+    # and is the steady-state number a long-running pipeline sees.
+    cold_sec, n_kept = run_once()
+    warm_sec, n_kept_warm = run_once()
     os.unlink(path)
     return {
         "end_to_end_rows": n,
-        "end_to_end_sec": round(elapsed, 3),
-        "end_to_end_rows_per_sec": round(n / elapsed),
-        "end_to_end_kept_partitions": n_kept,
+        "end_to_end_sec_cold": round(cold_sec, 3),
+        "end_to_end_rows_per_sec_cold": round(n / cold_sec),
+        "end_to_end_sec": round(warm_sec, 3),
+        "end_to_end_rows_per_sec_warm": round(n / warm_sec),
+        "end_to_end_kept_partitions": n_kept_warm,
     }
 
 
@@ -274,9 +284,27 @@ def _bench_ingest():
     start = time.perf_counter()
     encoded = columnar.encode_columns(pids, pks, vals)
     elapsed = time.perf_counter() - start
+
+    # Fallback path (pandas masked): the vectorized searchsorted remap in
+    # ChunkedVocabEncoder, measured host-side on the same columns.
+    from pipelinedp_tpu import ingest as ingest_mod
+    saved = ingest_mod._pd, columnar._pd
+    ingest_mod._pd = columnar._pd = None
+    try:
+        start = time.perf_counter()
+        enc_pid = ingest_mod.ChunkedVocabEncoder()
+        enc_pk = ingest_mod.ChunkedVocabEncoder()
+        chunk = 1 << 19
+        for i in range(0, n, chunk):
+            enc_pid.encode(pids[i:i + chunk])
+            enc_pk.encode(pks[i:i + chunk])
+        fb_elapsed = time.perf_counter() - start
+    finally:
+        ingest_mod._pd, columnar._pd = saved
     return {
         "ingest_rows": n,
         "ingest_rows_per_sec": round(n / elapsed),
+        "ingest_fallback_rows_per_sec": round(n / fb_elapsed),
         "ingest_partitions": encoded.n_partitions,
     }
 

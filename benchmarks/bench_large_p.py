@@ -37,3 +37,27 @@ kept, outs = run(9)
 t1 = time.perf_counter()
 print(f"timed kept: {len(kept)}  {t1-t0:.3f}s  "
       f"{n/(t1-t0)/1e3:.0f}K rows/s", flush=True)
+
+# --- Standalone selection at the same P: O(kept) host transfer. -----------
+from pipelinedp_tpu.ops import selection_ops  # noqa: E402
+
+params, _, _, _ = _common.build_spec(P)
+selection = selection_ops.selection_params_from_host(
+    params.partition_selection_strategy, 1.0, 1e-6,
+    params.max_partitions_contributed, None)
+
+
+def run_select(seed):
+    return large_p.select_partitions_blocked(
+        pid, pk, valid, jax.random.PRNGKey(seed),
+        params.max_partitions_contributed, P, selection,
+        block_partitions=1 << 20)
+
+
+sel_kept = run_select(8)
+print("select warmup kept:", len(sel_kept), flush=True)
+t0 = time.perf_counter()
+sel_kept = run_select(9)
+t1 = time.perf_counter()
+print(f"select_partitions kept: {len(sel_kept)}  {t1-t0:.3f}s  "
+      f"{n/(t1-t0)/1e3:.0f}K rows/s", flush=True)

@@ -912,6 +912,24 @@ def select_partition_counts(pid, pk, valid, key: jax.Array, l0: int,
 
     Returns counts: int32[n_partitions].
     """
+    spk, kept_pair = _select_kept_pairs(pid, pk, valid, key, l0,
+                                        n_partitions)
+    P = n_partitions
+    idx = jnp.where(kept_pair, spk, P)
+    counts = jnp.zeros((P + 1,), jnp.int32).at[idx].add(
+        kept_pair.astype(jnp.int32))
+    return counts[:P]
+
+
+def _select_kept_pairs(pid, pk, valid, key: jax.Array, l0: int,
+                       n_partitions: int):
+    """Dedupe (pid, pk) pairs and L0-sample each id's partitions.
+
+    The shared counting core of standalone selection: returns
+    (spk int32[n], kept_pair bool[n]) — the pid-sorted stream's partition
+    ids and the mask of pair-start rows that survive sampling; each kept
+    row contributes exactly one privacy id to its partition's count.
+    """
     i32 = jnp.int32
     P = n_partitions
     pid_sent = jnp.where(valid, pid, jnp.iinfo(i32).max).astype(i32)
@@ -924,9 +942,28 @@ def select_partition_counts(pid, pk, valid, key: jax.Array, l0: int,
     new_pid = segment_ops.boundary_mask(spid)
     pair_rank = segment_ops.segment_rank_of_segments(new_pair, new_pid)
     kept_pair = new_pair & svalid & (pair_rank < l0)
-    idx = jnp.where(kept_pair, spk, P)
-    counts = jnp.zeros((P + 1,), i32).at[idx].add(kept_pair.astype(i32))
-    return counts[:P]
+    return spk, kept_pair
+
+
+@functools.partial(jax.jit, static_argnames=("l0", "n_partitions"))
+def select_kept_pair_stream(pid, pk, valid, rng_key, l0: int,
+                            n_partitions: int):
+    """Compacting counterpart of select_partition_counts for huge P.
+
+    Instead of scatter-adding into a dense int32[P] vector, sorts the
+    surviving pairs' partition ids to the front (dropped rows carry an
+    int32-max sentinel and sink to the tail). The resulting
+    partition-ascending stream is what the blocked selection path
+    (parallel/large_p.select_partitions_blocked) bins into partition
+    blocks — dense [P] state never exists on any device.
+
+    Returns (spk_sorted int32[n], n_kept int32[]).
+    """
+    spk, kept_pair = _select_kept_pairs(pid, pk, valid, rng_key, l0,
+                                        n_partitions)
+    sort_key = jnp.where(kept_pair, spk, jnp.iinfo(jnp.int32).max)
+    (spk_sorted,), _ = _sort_rows([sort_key], [])
+    return spk_sorted, kept_pair.sum()
 
 
 @functools.partial(jax.jit,
@@ -983,6 +1020,31 @@ def lazy_select_partitions(backend, col, params, data_extractors,
             params.max_partitions_contributed, params.pre_threshold)
         n_partitions = resolve_n_partitions(backend, encoded.n_partitions)
         key = noise_ops.make_noise_key(getattr(backend, "noise_seed", None))
+        threshold = getattr(backend, "large_partition_threshold", None)
+        if threshold is not None and n_partitions > threshold:
+            # Huge partition spaces: neither the dense count vector nor
+            # the bool[P] keep vector (whose wholesale download would
+            # dominate under a remote-attached chip) is ever materialized
+            # — the blocked path transfers O(kept) ids only. With a mesh
+            # the blocked path itself runs sharded (pid-sharded pass 1,
+            # one int32[C] psum per block).
+            from pipelinedp_tpu.parallel import large_p
+            if backend.mesh is not None:
+                kept_ids = large_p.select_partitions_blocked_sharded(
+                    backend.mesh, encoded.pid, encoded.pk, encoded.valid,
+                    key, params.max_partitions_contributed, n_partitions,
+                    selection)
+            else:
+                kept_ids = large_p.select_partitions_blocked(
+                    encoded.pid, encoded.pk, encoded.valid, key,
+                    params.max_partitions_contributed, n_partitions,
+                    selection)
+            vocab = encoded.partition_vocab
+            n_real = len(vocab)
+            for idx in kept_ids:
+                if idx < n_real:
+                    yield vocab[idx]
+            return
         if backend.mesh is not None:
             from pipelinedp_tpu.parallel import sharded
             keep = sharded.sharded_select_partitions(
@@ -1195,19 +1257,27 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
         key = noise_ops.make_noise_key(getattr(backend, "noise_seed", None))
         min_v, max_v, min_s, max_s, mid = kernel_scalars(params)
         threshold = getattr(backend, "large_partition_threshold", None)
-        if (threshold is not None and n_partitions > threshold and
-                backend.mesh is None):
+        if threshold is not None and n_partitions > threshold:
             # Very large partition spaces: never materialize dense [0, P)
             # columns; process the partition axis in blocks
             # (parallel/large_p.py) and emit only kept partitions. Raw
             # encoded columns go in directly — large_p pads to its own
             # capacities, so the dense path's pow2 pad_rows copy would
-            # only inflate the row count here.
+            # only inflate the row count here. With a meshed backend the
+            # blocked path itself runs over the mesh (pid-sharded pass 1,
+            # one [C] psum per block).
             from pipelinedp_tpu.parallel import large_p
-            kept_ids, blocked_outputs = large_p.aggregate_blocked(
-                encoded.pid, encoded.pk, encoded.values, encoded.valid,
-                min_v, max_v, min_s, max_s, mid, np.asarray(stds), key, cfg,
-                secure_tables=secure_tables)
+            if backend.mesh is not None:
+                kept_ids, blocked_outputs = large_p.aggregate_blocked_sharded(
+                    backend.mesh, encoded.pid, encoded.pk, encoded.values,
+                    encoded.valid, min_v, max_v, min_s, max_s, mid,
+                    np.asarray(stds), key, cfg,
+                    secure_tables=secure_tables)
+            else:
+                kept_ids, blocked_outputs = large_p.aggregate_blocked(
+                    encoded.pid, encoded.pk, encoded.values, encoded.valid,
+                    min_v, max_v, min_s, max_s, mid, np.asarray(stds), key,
+                    cfg, secure_tables=secure_tables)
             yield from decode_blocked_results(kept_ids, blocked_outputs,
                                               encoded.partition_vocab,
                                               compound)

@@ -39,17 +39,25 @@ class ChunkedVocabEncoder:
     """Incremental first-occurrence vocabulary encoding across chunks.
 
     Feeding chunks in order yields exactly the codes columnar.factorize
-    would assign to the concatenation: per-chunk factorization (C speed)
-    followed by a remap of the chunk's uniques against the growing global
-    vocabulary — O(chunk + new uniques) per chunk, never O(total).
+    would assign to the concatenation, on every path: per-chunk
+    factorization (C speed) followed by a remap of the chunk's uniques
+    against the growing global vocabulary — O(chunk + new uniques) per
+    chunk, never O(total). Without pandas the remap runs vectorized
+    against a sorted copy of the vocabulary (searchsorted + insert,
+    O(V + new·log new) per chunk); only key types numpy cannot order
+    fall back to a per-unique dict loop.
     """
 
     def __init__(self):
         self._index = None  # pandas Index (fast path)
-        self._dict: Optional[dict] = None  # fallback vocab
+        self._sorted_vocab = None  # numpy fallback: sorted uniques
+        self._sorted_codes = None  # global code of each sorted entry
+        self._dict: Optional[dict] = None  # unorderable-key last resort
 
     def encode(self, raw) -> np.ndarray:
-        raw = columnar._as_key_array(np.asarray(raw))
+        # _as_key_array directly: np.asarray first would explode composite
+        # (tuple) keys into a 2-D array instead of object elements.
+        raw = columnar._as_key_array(raw)
         if _pd is not None:
             codes, uniques = _pd.factorize(raw, use_na_sentinel=False)
             uniques = _pd.Index(uniques)
@@ -63,25 +71,96 @@ class ChunkedVocabEncoder:
                     int(is_new.sum()))
                 self._index = self._index.append(uniques[is_new])
             return mapped.astype(np.int32)[codes]
-        # No pandas: chunk-local factorize + dict remap of uniques.
+        # No pandas: chunk-local factorize, then a vectorized remap.
         codes, uniques = columnar.factorize(raw)
-        if self._dict is None:
-            self._dict = {}
-        remap = np.empty(len(uniques), np.int32)
+        uniques = np.asarray(uniques)
+        # Normalize the chunk's uniques to first-occurrence order
+        # (factorize's np.unique branch yields sorted order) so new global
+        # codes are assigned exactly as one factorize over the
+        # concatenation would.
+        if len(uniques) > 1:
+            _, first_idx = np.unique(codes, return_index=True)
+            perm = np.argsort(first_idx)
+            if not np.array_equal(perm, np.arange(len(perm))):
+                inv = np.empty_like(perm)
+                inv[perm] = np.arange(len(perm))
+                codes = inv[codes].astype(np.int32)
+                uniques = uniques[perm]
+        if self._dict is not None:
+            return self._remap_dict(codes, uniques)
+        try:
+            return self._remap_sorted(codes, uniques)
+        except TypeError:  # unorderable mixed-type keys
+            self._spill_to_dict()
+            return self._remap_dict(codes, uniques)
+
+    def _remap_sorted(self, codes: np.ndarray,
+                      uniques: np.ndarray) -> np.ndarray:
+        """Vectorized remap of chunk uniques (first-occurrence order)
+        against the sorted global vocabulary."""
+        if self._sorted_vocab is None or not len(self._sorted_vocab):
+            order = np.argsort(uniques, kind="stable")  # may TypeError
+            self._sorted_vocab = uniques[order]
+            self._sorted_codes = order.astype(np.int64)
+            return codes.astype(np.int32)
+        n_old = len(self._sorted_vocab)
+        pos = np.searchsorted(self._sorted_vocab, uniques)
+        pos_c = np.minimum(pos, n_old - 1)
+        found = (pos < n_old) & (self._sorted_vocab[pos_c] == uniques)
+        remap = np.empty(len(uniques), np.int64)
+        remap[found] = self._sorted_codes[pos_c[found]]
+        new_mask = ~found
+        n_new = int(new_mask.sum())
+        # uniques are in first-occurrence order, so arange over the new
+        # ones IS the order a global factorize would meet them.
+        remap[new_mask] = n_old + np.arange(n_new)
+        if n_new:
+            new_u, new_c = uniques[new_mask], remap[new_mask]
+            no = np.argsort(new_u, kind="stable")
+            new_u, new_c = new_u[no], new_c[no]
+            ins = np.searchsorted(self._sorted_vocab, new_u)
+            self._sorted_vocab = np.insert(self._sorted_vocab, ins, new_u)
+            self._sorted_codes = np.insert(self._sorted_codes, ins, new_c)
+        return remap[codes].astype(np.int32)
+
+    def _spill_to_dict(self) -> None:
+        """Migrates the sorted-vocab state into the dict fallback when a
+        chunk introduces keys numpy cannot order."""
+        self._dict = {}
+        if self._sorted_vocab is not None:
+            for key, code in zip(self._sorted_vocab, self._sorted_codes):
+                self._dict[key] = int(code)
+            # Re-key by code order is unnecessary: dict lookups are by key.
+            self._sorted_vocab = self._sorted_codes = None
+
+    def _remap_dict(self, codes: np.ndarray,
+                    uniques: np.ndarray) -> np.ndarray:
+        remap = np.empty(len(uniques), np.int64)
         for j, key in enumerate(uniques):
             remap[j] = self._dict.setdefault(key, len(self._dict))
-        return remap[codes]
+        return remap[codes].astype(np.int32)
 
     @property
     def vocabulary(self) -> Sequence[Any]:
         if self._index is not None:
             return np.asarray(self._index)
-        return np.fromiter(self._dict or (), dtype=object,
-                           count=len(self._dict or ()))
+        if self._sorted_vocab is not None:
+            out = np.empty(len(self._sorted_vocab),
+                           dtype=self._sorted_vocab.dtype)
+            out[self._sorted_codes] = self._sorted_vocab
+            return out
+        if self._dict:
+            vocab = np.empty(len(self._dict), dtype=object)
+            for key, code in self._dict.items():
+                vocab[code] = key
+            return vocab
+        return np.empty(0, dtype=object)
 
     def __len__(self) -> int:
         if self._index is not None:
             return len(self._index)
+        if self._sorted_vocab is not None:
+            return len(self._sorted_vocab)
         return len(self._dict or ())
 
 
@@ -93,10 +172,15 @@ def stream_encode_columns(
     """Encodes and uploads (pid_raw, pk_raw, values) column chunks,
     overlapping each chunk's device copy with the next chunk's parsing.
 
-    Returns a device-resident EncodedData (jax-array columns, float32
-    values — the kernel compute dtype, at half the f64 upload volume).
+    Returns a device-resident EncodedData (jax-array columns, values in
+    the kernel compute dtype — float32 normally, at half the f64 upload
+    volume; float64 when jax_enable_x64 is on, so streamed input loses no
+    precision relative to the row-input path).
     """
     import jax.numpy as jnp
+
+    from pipelinedp_tpu import executor
+    value_dtype = np.dtype(executor._ftype())
 
     pid_enc = ChunkedVocabEncoder()
     pk_enc = ChunkedVocabEncoder()
@@ -108,7 +192,7 @@ def stream_encode_columns(
         pid = pid_enc.encode(pid_raw)
         if partition_vocab is not None:
             pk = columnar.encode_with_vocab(
-                columnar._as_key_array(np.asarray(pk_raw)), partition_vocab)
+                columnar._as_key_array(pk_raw), partition_vocab)
         else:
             pk = pk_enc.encode(pk_raw)
         # jnp.asarray dispatches the host->device copy asynchronously; the
@@ -116,11 +200,11 @@ def stream_encode_columns(
         dev_pid.append(jnp.asarray(pid))
         dev_pk.append(jnp.asarray(pk))
         dev_vals.append(
-            jnp.asarray(np.asarray(values, dtype=np.float32)))
+            jnp.asarray(np.asarray(values, dtype=value_dtype)))
     if not dev_pid:
         empty = jnp.zeros(0, jnp.int32)
         dev_pid, dev_pk = [empty], [empty]
-        dev_vals = [jnp.zeros(0, jnp.float32)]
+        dev_vals = [jnp.zeros(0, value_dtype)]
     return columnar.EncodedData(
         pid=jnp.concatenate(dev_pid),
         pk=jnp.concatenate(dev_pk),

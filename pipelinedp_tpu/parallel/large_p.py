@@ -57,17 +57,12 @@ def round_capacity(x: int, min_cap: int = 8) -> int:
     return -(-x // step) * step
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def _bounded_compact_kernel(pid, pk, values, valid, min_v, max_v, min_s,
-                            max_s, mid, key, cfg: executor.KernelConfig):
-    """Bound contributions, drop bounded-away rows, order by partition.
-
-    Returns (spk, pair_start, reduce_cols, leaf, n_kept): the surviving
-    bounded rows sorted by partition id (dropped rows carry an int32-max
-    sentinel key and sort to the tail; n_kept counts the survivors). With
-    percentiles, `leaf` carries each row's quantile-tree leaf index through
-    the same compaction sort (None otherwise).
-    """
+def _bound_compact_trace(pid, pk, values, valid, min_v, max_v, min_s, max_s,
+                         mid, key, cfg: executor.KernelConfig):
+    """Traceable body shared by the single-device kernel and the per-shard
+    function of the meshed path: bound contributions, drop bounded-away
+    rows, order survivors by partition id (dropped rows carry an int32-max
+    sentinel and sort to the tail)."""
     spk, keep_row, pair_start, reduce_cols, qrows = \
         executor.bounded_row_columns(pid, pk, values, valid, min_v, max_v,
                                      min_s, max_s, mid, key, cfg)
@@ -83,17 +78,29 @@ def _bounded_compact_kernel(pid, pk, values, valid, min_v, max_v, min_s,
     return spk_s, pay[0].astype(bool), cols_s, leaf_s, keep_row.sum()
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "cap"))
-def _block_kernel_dev(spk_s, pair_s, cols_s, leaf_s, lo, length, base, min_v,
-                      max_v, mid, stds, key, cfg: executor.KernelConfig,
-                      cap: int, secure_tables=None):
-    """Finalize one partition block from the device-resident row stream.
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _bounded_compact_kernel(pid, pk, values, valid, min_v, max_v, min_s,
+                            max_s, mid, key, cfg: executor.KernelConfig):
+    """Single-device bound+compact. Returns (spk, pair_start, reduce_cols,
+    leaf, n_kept); with percentiles, `leaf` carries each row's
+    quantile-tree leaf index through the same compaction sort."""
+    return _bound_compact_trace(pid, pk, values, valid, min_v, max_v, min_s,
+                                max_s, mid, key, cfg)
+
+
+def _block_trace(spk_s, pair_s, cols_s, leaf_s, lo, length, base, min_v,
+                 max_v, mid, stds, key, cfg: executor.KernelConfig,
+                 cap: int, secure_tables=None, psum_axis=None):
+    """Traceable body shared by the single-device block kernel and the
+    per-shard function of the meshed path: finalize one partition block
+    from the (shard-local) compacted row stream.
 
     Gathers `cap` rows at host-known offset `lo` (rows beyond `length` are
-    masked), reduces them onto the block's dense [C] slice, runs selection
-    + noise (and, with percentiles, the block's quantile descent), and
-    sorts kept partitions to the front so the host can fetch exactly
-    n_kept results.
+    masked), reduces them onto the block's dense [C] slice — psum'd over
+    `psum_axis` when running under shard_map, the meshed path's one
+    collective per block — then runs selection + noise (and, with
+    percentiles, the block's quantile descent) and sorts kept partitions
+    to the front so the host can fetch exactly n_kept results.
     """
     idx = jnp.arange(cap, dtype=jnp.int32)
     valid = idx < length
@@ -106,12 +113,14 @@ def _block_kernel_dev(spk_s, pair_s, cols_s, leaf_s, lo, length, base, min_v,
         for name, col in cols_s.items()
     }
     # Rows were compacted into (kept-first, spk-ascending) order by
-    # _bounded_compact_kernel; the block slice preserves it, and masked
+    # _bound_compact_trace; the block slice preserves it, and masked
     # tail rows carry the cfg.n_partitions sentinel — still sorted.
     dense = executor.reduce_rows_to_partitions(spk_rel, valid, pair, cols,
                                                cfg.n_partitions,
                                                cfg.vector_size,
                                                presorted=True)
+    if psum_axis is not None:
+        dense = jax.tree.map(lambda x: jax.lax.psum(x, psum_axis), dense)
     outputs, keep, _ = executor.finalize(dense, min_v, mid, stds, key, cfg,
                                          secure_tables)
     if cfg.quantiles:
@@ -123,11 +132,22 @@ def _block_kernel_dev(spk_s, pair_s, cols_s, leaf_s, lo, length, base, min_v,
         outputs.update(
             executor.quantile_outputs((spk_rel, take(leaf_s), valid), min_v,
                                       max_v, stds, qkey, cfg,
+                                      psum_axis=psum_axis,
                                       secure_tables=secure_tables))
     order = jnp.argsort(~keep, stable=True)  # kept partitions first
     ids_sorted = order.astype(jnp.int32)
     outputs_sorted = {name: col[order] for name, col in outputs.items()}
     return keep.sum(), ids_sorted, outputs_sorted
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "cap"))
+def _block_kernel_dev(spk_s, pair_s, cols_s, leaf_s, lo, length, base, min_v,
+                      max_v, mid, stds, key, cfg: executor.KernelConfig,
+                      cap: int, secure_tables=None):
+    """Single-device finalize of one partition block (see _block_trace)."""
+    return _block_trace(spk_s, pair_s, cols_s, leaf_s, lo, length, base,
+                        min_v, max_v, mid, stds, key, cfg, cap,
+                        secure_tables)
 
 
 def _chunk_ends(pid_sorted: np.ndarray, row_chunk: int) -> np.ndarray:
@@ -157,6 +177,32 @@ def _chunk_ends(pid_sorted: np.ndarray, row_chunk: int) -> np.ndarray:
         ends.append(end)
         start = end
     return np.asarray(ends)
+
+
+def _dispatch_blocks(block_iter, consume, max_in_flight: int = 8) -> int:
+    """Bounded-window async block dispatch shared by every blocked driver.
+
+    jax execution is async, so the device pipelines upcoming block kernels
+    while the host drains earlier results — one latency-bound sync per
+    block would otherwise dominate under a remote-attached chip. The
+    window is bounded: each in-flight block pins O(C) output buffers in
+    HBM, and an unbounded pipeline over P/C blocks would hold O(P)
+    results — the exact footprint this module exists to avoid.
+
+    `block_iter` yields (block_index, dispatched_result) pairs;
+    `consume(block_index, result)` syncs and drains one block. Returns
+    the number of blocks dispatched.
+    """
+    pending = []
+    n_dispatched = 0
+    for item in block_iter:
+        n_dispatched += 1
+        pending.append(item)
+        if len(pending) >= max_in_flight:
+            consume(*pending.pop(0))
+    for entry in pending:
+        consume(*entry)
+    return n_dispatched
 
 
 def _pad_to(a, cap: int, fill):
@@ -216,6 +262,434 @@ def _bound_and_compact_host_staged(pid, pk, values, valid, min_v, max_v,
     }, leaf_all
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "mesh"))
+def _sharded_bound_compact(pid, pk, values, valid, min_v, max_v, min_s,
+                           max_s, mid, rows_key, boundaries,
+                           cfg: executor.KernelConfig, mesh):
+    """Pass 1 over the mesh: per-shard bound + compact + spk-sort.
+
+    Rows are pid-sharded, so contribution bounding (global per privacy id)
+    is shard-local and the O(n log n) compaction sort — the dominant
+    pass-1 cost — parallelizes D ways with zero collectives. Each shard
+    also searchsorts its own stream against the block boundaries, so the
+    host downloads one [S, n_blocks+1] offsets table instead of any rows.
+    """
+    from jax.sharding import PartitionSpec
+    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
+    SP = PartitionSpec
+
+    def per_shard(pid_s, pk_s, values_s, valid_s, key_r, boundaries_r):
+        shard_idx = jax.lax.axis_index(SHARD_AXIS)
+        key_s = jax.random.fold_in(key_r, shard_idx)
+        spk_sorted, pair_s, cols_s, leaf_s, _ = _bound_compact_trace(
+            pid_s, pk_s, values_s, valid_s, min_v, max_v, min_s, max_s, mid,
+            key_s, cfg)
+        starts = jnp.searchsorted(spk_sorted, boundaries_r,
+                                  side="left").astype(jnp.int32)
+        if leaf_s is None:  # shard_map needs a concrete pytree leaf
+            leaf_s = jnp.zeros(0, jnp.int32)
+        return spk_sorted, pair_s, cols_s, leaf_s, starts
+
+    fn = jax.shard_map(per_shard,
+                       mesh=mesh,
+                       in_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
+                                 SP(SHARD_AXIS), SP(SHARD_AXIS), SP(), SP()),
+                       out_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
+                                  SP(SHARD_AXIS), SP(SHARD_AXIS),
+                                  SP(SHARD_AXIS)),
+                       check_vma=False)
+    return fn(pid, pk, values, valid, rows_key, boundaries)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "cap", "mesh"))
+def _sharded_block_kernel(spk_all, pair_all, cols_all, leaf_all, lo_r, len_r,
+                          base, min_v, max_v, mid, stds, key,
+                          cfg: executor.KernelConfig, cap: int, mesh,
+                          secure_tables=None):
+    """Pass 2 over the mesh: one partition block, shard-local reduce + one
+    [C] psum + replicated finalize.
+
+    Each shard gathers its own `cap` stream rows at its own host-known
+    offset (lo_r/len_r are per-shard tables indexed by axis_index),
+    segment-sums them onto the block's dense [C] slice, and ONE psum over
+    ICI combines the partials — the only collective. Selection + noise +
+    kept-first compaction then run replicated under the same key, so every
+    device holds identical O(kept)-transferable results.
+    """
+    from jax.sharding import PartitionSpec
+    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
+    SP = PartitionSpec
+
+    def per_shard(spk_s, pair_s, cols_s, leaf_s, lo_all, len_all, stds_r,
+                  key_r, tables_r):
+        shard_idx = jax.lax.axis_index(SHARD_AXIS)
+        return _block_trace(spk_s, pair_s, cols_s, leaf_s,
+                            lo_all[shard_idx], len_all[shard_idx], base,
+                            min_v, max_v, mid, stds_r, key_r, cfg, cap,
+                            tables_r, psum_axis=SHARD_AXIS)
+
+    fn = jax.shard_map(per_shard,
+                       mesh=mesh,
+                       in_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
+                                 SP(SHARD_AXIS), SP(SHARD_AXIS), SP(), SP(),
+                                 SP(), SP(), SP()),
+                       out_specs=(SP(), SP(), SP()),
+                       check_vma=False)
+    return fn(spk_all, pair_all, cols_all, leaf_all, lo_r, len_r, stds, key,
+              secure_tables)
+
+
+def aggregate_blocked_sharded(mesh,
+                              pid,
+                              pk,
+                              values,
+                              valid,
+                              min_v,
+                              max_v,
+                              min_s,
+                              max_s,
+                              mid,
+                              stds,
+                              rng_key,
+                              cfg: executor.KernelConfig,
+                              *,
+                              block_partitions: int = 1 << 20,
+                              secure_tables=None
+                              ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+    """aggregate_blocked over a device mesh: the huge-P counterpart of
+    sharded.sharded_aggregate_arrays.
+
+    The reference's unbounded-key regime scales across workers by handing
+    the shuffle to Beam/Spark (pipeline_dp/pipeline_backend.py:339-352);
+    here the same scaling is mesh-native: rows shard by privacy id (pass 1
+    — bounding + the dominant compaction sort — runs D-way parallel with
+    no collectives), and each partition block costs exactly one [C]-sized
+    psum over ICI before replicated selection/noise. Dense [P] state never
+    exists on any device, host traffic stays O(kept), and per-device HBM
+    holds O(rows/D + C).
+
+    Returns (kept_partition_ids int64[M], {metric: f[M]}) — identical
+    contract to aggregate_blocked.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+    from pipelinedp_tpu.parallel import sharded
+    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
+
+    P = cfg.n_partitions
+    n_shards = mesh.devices.size
+    values = np.asarray(values, dtype=np.dtype(executor._ftype()))
+    pid, pk, values, valid = sharded.shard_rows_by_pid(
+        np.asarray(pid), np.asarray(pk), values, np.asarray(valid), n_shards)
+    sharding = NamedSharding(mesh, PartitionSpec(SHARD_AXIS))
+    pid = jax.device_put(jnp.asarray(pid), sharding)
+    pk = jax.device_put(jnp.asarray(pk), sharding)
+    values = jax.device_put(jnp.asarray(values), sharding)
+    valid = jax.device_put(jnp.asarray(valid), sharding)
+
+    rows_key, final_key = jax.random.split(rng_key, 2)
+    stds = jnp.asarray(stds)
+
+    C = min(block_partitions, P)
+    n_blocks = -(-P // C)
+    # int64 boundaries clamped into int32 range: same overflow guard as
+    # the single-device path (P within one block of 2^31).
+    boundaries = np.minimum(
+        np.arange(n_blocks + 1, dtype=np.int64) * C,
+        np.iinfo(np.int32).max).astype(np.int32)
+
+    spk_all, pair_all, cols_all, leaf_all, starts = _sharded_bound_compact(
+        pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, rows_key,
+        jnp.asarray(boundaries), cfg, mesh)
+    # The one per-aggregation host download that scales with n_blocks, not
+    # rows: each shard's block offsets.
+    starts = np.asarray(starts).reshape(n_shards, n_blocks + 1)
+
+    output_names = [name for e in cfg.plan for name in e.outputs]
+    kept_ids = []
+    kept_outputs = {name: [] for name in output_names}
+
+    def consume(b, result):
+        n_kept, ids_sorted, outputs_sorted = result
+        k = int(n_kept)  # sync; gates O(kept) transfers
+        if k:
+            kept_ids.append(
+                np.asarray(ids_sorted[:k]).astype(np.int64) + b * C)
+            for name, col in outputs_sorted.items():
+                kept_outputs.setdefault(name, []).append(np.asarray(col[:k]))
+
+    def block_iter():
+        for b in range(n_blocks):
+            lo = starts[:, b].astype(np.int32)
+            lens = (starts[:, b + 1] - starts[:, b]).astype(np.int32)
+            if int(lens.sum()) == 0 and cfg.private_selection:
+                # Row-less on every shard: selection provably emits
+                # nothing.
+                continue
+            c_actual = min(C, P - b * C)
+            cfg_block = dataclasses.replace(cfg, n_partitions=c_actual)
+            yield (b, _sharded_block_kernel(
+                spk_all, pair_all, cols_all, leaf_all, jnp.asarray(lo),
+                jnp.asarray(lens), b * C, min_v, max_v, mid, stds,
+                jax.random.fold_in(final_key, b), cfg_block,
+                round_capacity(int(lens.max())), mesh, secure_tables))
+
+    _dispatch_blocks(block_iter(), consume)
+
+    kept = (np.concatenate(kept_ids) if kept_ids else np.zeros(0, np.int64))
+    return kept, {
+        name: (np.concatenate(chunks) if chunks else np.zeros(0))
+        for name, chunks in kept_outputs.items()
+    }
+
+
+def _selection_block_trace(spk_kept, lo, length, base, c_actual, key,
+                           selection, cap: int, psum_axis=None):
+    """Traceable body shared by the single-device and meshed selection
+    block kernels: selection decisions for one partition block of the
+    kept-pair stream.
+
+    Gathers `cap` stream rows at host-known offset `lo`, scatter-adds the
+    block's per-partition privacy-id counts into a dense [C] slice —
+    psum'd over `psum_axis` under shard_map — draws the keep decisions,
+    and sorts kept relative ids to the front so the host fetches exactly
+    n_kept ids — the aggregate path's O(kept) compaction (_block_trace)
+    applied to standalone selection.
+    """
+    from pipelinedp_tpu.ops import selection_ops
+    idx = jnp.arange(cap, dtype=jnp.int32)
+    valid = idx < length
+    rel = jnp.where(valid,
+                    jnp.take(spk_kept, lo + idx, mode="clip") - base,
+                    c_actual).astype(jnp.int32)
+    counts = jnp.zeros((c_actual + 1,), jnp.int32).at[rel].add(
+        valid.astype(jnp.int32))[:c_actual]
+    if psum_axis is not None:
+        counts = jax.lax.psum(counts, psum_axis)
+    keep = selection_ops.sample_keep_decisions(key, counts, selection)
+    order = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+    return keep.sum(), order
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("c_actual", "selection", "cap"))
+def _selection_block_kernel(spk_kept, lo, length, base, c_actual, key,
+                            selection, cap: int):
+    """Single-device selection block kernel (see _selection_block_trace)."""
+    return _selection_block_trace(spk_kept, lo, length, base, c_actual, key,
+                                  selection, cap)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("l0", "n_partitions", "mesh"))
+def _sharded_select_compact(pid, pk, valid, rows_key, boundaries, l0: int,
+                            n_partitions: int, mesh):
+    """Selection pass 1 over the mesh: per-shard kept-pair compaction.
+
+    Rows are pid-sharded, so pair dedupe + L0 sampling
+    (executor.select_kept_pair_stream) are shard-local; each shard also
+    searchsorts its own stream against the block boundaries.
+    """
+    from jax.sharding import PartitionSpec
+    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
+    SP = PartitionSpec
+
+    def per_shard(pid_s, pk_s, valid_s, key_r, boundaries_r):
+        shard_idx = jax.lax.axis_index(SHARD_AXIS)
+        key_s = jax.random.fold_in(key_r, shard_idx)
+        spk_sorted, _ = executor.select_kept_pair_stream(
+            pid_s, pk_s, valid_s, key_s, l0, n_partitions)
+        starts = jnp.searchsorted(spk_sorted, boundaries_r,
+                                  side="left").astype(jnp.int32)
+        return spk_sorted, starts
+
+    fn = jax.shard_map(per_shard,
+                       mesh=mesh,
+                       in_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
+                                 SP(SHARD_AXIS), SP(), SP()),
+                       out_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS)),
+                       check_vma=False)
+    return fn(pid, pk, valid, rows_key, boundaries)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("c_actual", "selection", "cap", "mesh"))
+def _sharded_selection_block(spk_all, lo_r, len_r, base, c_actual, key,
+                             selection, cap: int, mesh):
+    """Selection pass 2 over the mesh: shard-local block counts + one [C]
+    psum + replicated decisions/compaction (see _selection_block_trace)."""
+    from jax.sharding import PartitionSpec
+    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
+    SP = PartitionSpec
+
+    def per_shard(spk_s, lo_all, len_all, key_r):
+        shard_idx = jax.lax.axis_index(SHARD_AXIS)
+        return _selection_block_trace(spk_s, lo_all[shard_idx],
+                                      len_all[shard_idx], base, c_actual,
+                                      key_r, selection, cap,
+                                      psum_axis=SHARD_AXIS)
+
+    fn = jax.shard_map(per_shard,
+                       mesh=mesh,
+                       in_specs=(SP(SHARD_AXIS), SP(), SP(), SP()),
+                       out_specs=(SP(), SP()),
+                       check_vma=False)
+    return fn(spk_all, lo_r, len_r, key)
+
+
+def select_partitions_blocked_sharded(mesh,
+                                      pid,
+                                      pk,
+                                      valid,
+                                      rng_key,
+                                      l0: int,
+                                      n_partitions: int,
+                                      selection,
+                                      *,
+                                      block_partitions: int = 1 << 20
+                                      ) -> np.ndarray:
+    """select_partitions_blocked over a device mesh.
+
+    Rows shard by privacy id (pass 1 — pair dedupe, L0 sampling and the
+    compaction sort — runs D-way parallel with no collectives); each
+    partition block costs one int32[C] psum over ICI before replicated
+    decisions. Neither dense [P] counts nor a bool[P] keep vector ever
+    exists on any device, and host traffic stays O(rows/D + kept).
+
+    Returns kept_partition_ids int64[M], ascending — identical contract
+    to select_partitions_blocked.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+    from pipelinedp_tpu.parallel import sharded
+    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
+
+    P = n_partitions
+    n_shards = mesh.devices.size
+    key_l0, key_sel = jax.random.split(rng_key)
+    # Zero-width values column: selection never reads values.
+    dummy_values = np.zeros((len(pid), 0), np.float32)
+    pid, pk, _, valid = sharded.shard_rows_by_pid(np.asarray(pid),
+                                                  np.asarray(pk),
+                                                  dummy_values,
+                                                  np.asarray(valid),
+                                                  n_shards)
+    sharding = NamedSharding(mesh, PartitionSpec(SHARD_AXIS))
+    pid = jax.device_put(jnp.asarray(pid), sharding)
+    pk = jax.device_put(jnp.asarray(pk), sharding)
+    valid = jax.device_put(jnp.asarray(valid), sharding)
+
+    C = min(block_partitions, P)
+    n_blocks = -(-P // C)
+    boundaries = np.minimum(
+        np.arange(n_blocks + 1, dtype=np.int64) * C,
+        np.iinfo(np.int32).max).astype(np.int32)
+    spk_all, starts = _sharded_select_compact(pid, pk, valid, key_l0,
+                                              jnp.asarray(boundaries), l0, P,
+                                              mesh)
+    starts = np.asarray(starts).reshape(n_shards, n_blocks + 1)
+
+    kept_ids = []
+
+    def consume(b, result):
+        n_kept, order = result
+        k = int(n_kept)  # sync; gates the O(kept) transfer
+        if k:
+            kept_ids.append(np.asarray(order[:k]).astype(np.int64) + b * C)
+
+    def block_iter():
+        for b in range(n_blocks):
+            lo = starts[:, b].astype(np.int32)
+            lens = (starts[:, b + 1] - starts[:, b]).astype(np.int32)
+            if int(lens.sum()) == 0:
+                # Row-less on every shard: keep probability is 0.
+                continue
+            c_actual = min(C, P - b * C)
+            yield (b, _sharded_selection_block(
+                spk_all, jnp.asarray(lo), jnp.asarray(lens), b * C,
+                c_actual, jax.random.fold_in(key_sel, b), selection,
+                round_capacity(int(lens.max())), mesh))
+
+    _dispatch_blocks(block_iter(), consume)
+
+    if not kept_ids:
+        return np.zeros(0, np.int64)
+    return np.concatenate(kept_ids)
+
+
+def select_partitions_blocked(pid,
+                              pk,
+                              valid,
+                              rng_key,
+                              l0: int,
+                              n_partitions: int,
+                              selection,
+                              *,
+                              block_partitions: int = 1 << 20
+                              ) -> np.ndarray:
+    """Standalone DP partition selection over a huge partition space.
+
+    Same semantics as executor.select_partitions_kernel (the reference's
+    select_partitions at unbounded key cardinality,
+    pipeline_dp/dp_engine.py:224-278), but neither the dense int32[P]
+    count vector nor the bool[P] keep vector ever exists: pass 1 compacts
+    the L0-sampled pair stream on device (executor.select_kept_pair_stream),
+    pass 2 bins it into partition blocks and transfers only each block's
+    kept ids — O(rows + kept) host traffic at any P.
+
+    Returns kept_partition_ids int64[M], ascending.
+    """
+    P = n_partitions
+    key_l0, key_sel = jax.random.split(rng_key)
+    if not isinstance(pid, jax.Array):
+        pid, pk, valid = np.asarray(pid), np.asarray(pk), np.asarray(valid)
+    cap = round_capacity(len(pid))
+    spk_sorted, _ = executor.select_kept_pair_stream(
+        jnp.asarray(_pad_to(pid, cap, 0)), jnp.asarray(_pad_to(pk, cap, 0)),
+        jnp.asarray(_pad_to(valid, cap, False)), key_l0, l0, P)
+
+    C = min(block_partitions, P)
+    n_blocks = -(-P // C)
+    # int64 boundaries clamped into int32 range: same overflow guard as
+    # aggregate_blocked (P within one block of 2^31).
+    boundaries = np.minimum(
+        np.arange(n_blocks + 1, dtype=np.int64) * C,
+        np.iinfo(np.int32).max).astype(np.int32)
+    block_starts = np.asarray(
+        jnp.searchsorted(spk_sorted, jnp.asarray(boundaries), side="left"))
+
+    kept_ids = []
+
+    def consume(b, result):
+        n_kept, order = result
+        k = int(n_kept)  # sync; gates the O(kept) transfer
+        if k:
+            kept_ids.append(
+                np.asarray(order[:k]).astype(np.int64) + b * C)
+
+    def block_iter():
+        for b in range(n_blocks):
+            lo, hi = int(block_starts[b]), int(block_starts[b + 1])
+            if lo == hi:
+                # Selection keeps empty partitions with probability 0
+                # (selection_ops.keep_probabilities: n <= 0 -> 0):
+                # row-less blocks provably emit nothing.
+                continue
+            c_actual = min(C, P - b * C)
+            yield (b, _selection_block_kernel(
+                spk_sorted, lo, hi - lo, b * C, c_actual,
+                jax.random.fold_in(key_sel, b), selection,
+                round_capacity(hi - lo)))
+
+    _dispatch_blocks(block_iter(), consume)
+
+    if not kept_ids:
+        return np.zeros(0, np.int64)
+    out = np.concatenate(kept_ids)
+    # Blocks are consumed in order but each block's kept ids arrive in
+    # keep-first argsort order (ascending within the kept prefix because
+    # the argsort is stable) — already globally ascending.
+    return out
+
+
 def aggregate_blocked(pid,
                       pk,
                       values,
@@ -242,8 +716,8 @@ def aggregate_blocked(pid,
     in blocks of `block_partitions` and only kept partitions are returned.
 
     phase_times: optional dict populated with per-phase wall-clock seconds
-    (p1_bound_compact, block_offsets, p2_blocks_total, p2_drain,
-    blocks_dispatched, total) — the profiling hook used by
+    (p1_bound_compact, block_offsets, p2_blocks_total, p2_sync_wait,
+    p2_drain, blocks_dispatched, total) — the profiling hook used by
     benchmarks/profile_large_p.py so the profiler times THIS code, not a
     replica. Adds one device sync after pass 1; leave None in production.
 
@@ -341,39 +815,27 @@ def aggregate_blocked(pid,
             phase_times["p2_drain"] = (phase_times.get("p2_drain", 0.0) +
                                        time.perf_counter() - ta)
 
-    # Dispatch ahead of the sync point: jax execution is async, so the
-    # device pipelines upcoming block kernels while the host drains earlier
-    # results — one latency-bound sync per block would otherwise dominate
-    # under a remote-attached chip. The window is bounded: each in-flight
-    # block pins O(C) output buffers in HBM, and an unbounded pipeline over
-    # P/C blocks would hold O(P) results — the exact footprint this module
-    # exists to avoid.
-    max_in_flight = 8
-    pending = []
-    n_dispatched = 0
+    def block_iter():
+        for b in range(n_blocks):
+            lo, hi = int(block_starts[b]), int(block_starts[b + 1])
+            if lo == hi and cfg.private_selection:
+                # Private selection keeps empty partitions with probability
+                # 0 (selection_ops.keep_probabilities: n <= 0 -> 0), so
+                # row-less blocks provably emit nothing — skip their device
+                # work. In the sparse 10^9-partition regime this skips
+                # nearly every block.
+                continue
+            c_actual = min(C, P - b * C)
+            cfg_block = dataclasses.replace(cfg, n_partitions=c_actual)
+            yield (b, _block_kernel_dev(spk_all, pair_all, cols_all,
+                                        leaf_all, lo, hi - lo, b * C, min_v,
+                                        max_v, mid, stds,
+                                        jax.random.fold_in(final_key, b),
+                                        cfg_block, round_capacity(hi - lo),
+                                        secure_tables))
+
     t2 = time.perf_counter()
-    for b in range(n_blocks):
-        lo, hi = int(block_starts[b]), int(block_starts[b + 1])
-        if lo == hi and cfg.private_selection:
-            # Private selection keeps empty partitions with probability 0
-            # (selection_ops.keep_probabilities: n <= 0 -> 0), so row-less
-            # blocks provably emit nothing — skip their device work. In the
-            # sparse 10^9-partition regime this skips nearly every block.
-            continue
-        n_dispatched += 1
-        c_actual = min(C, P - b * C)
-        cfg_block = dataclasses.replace(cfg, n_partitions=c_actual)
-        pending.append((b, _block_kernel_dev(spk_all, pair_all, cols_all,
-                                             leaf_all, lo, hi - lo, b * C,
-                                             min_v, max_v, mid, stds,
-                                             jax.random.fold_in(final_key, b),
-                                             cfg_block,
-                                             round_capacity(hi - lo),
-                                             secure_tables)))
-        if len(pending) >= max_in_flight:
-            consume(*pending.pop(0))
-    for entry in pending:
-        consume(*entry)
+    n_dispatched = _dispatch_blocks(block_iter(), consume)
     if profiling:
         now = time.perf_counter()
         phase_times["p2_blocks_total"] = now - t2

@@ -327,11 +327,14 @@ class TPUBackend(LocalBackend):
             device counterpart of the reference's PyDP snapped secure
             mechanisms (dp_computations.py:131-152). Costs one O(log K)
             table search per released value.
-        large_partition_threshold: partition counts above this route the
-            (single-device) aggregation through the blocked
-            partition-axis path (parallel/large_p.py), which never
-            materializes dense [0, P) columns — the reference's
-            unbounded-key regime. None disables the routing.
+        large_partition_threshold: partition counts above this route
+            aggregation AND standalone partition selection through the
+            blocked partition-axis path (parallel/large_p.py), which
+            never materializes dense [0, P) state and transfers only
+            kept partitions — the reference's unbounded-key regime. With
+            a mesh the blocked path runs sharded (pid-sharded pass 1,
+            one [C]-sized psum per partition block over ICI). None
+            disables the routing.
     """
 
     def __init__(self,

@@ -471,6 +471,31 @@ class TestSelectPartitions:
         assert run(pdp.LocalBackend(seed=0)) == run(
             pdp.TPUBackend(noise_seed=0))
 
+    def test_select_partitions_blocked_route_parity(self):
+        # large_partition_threshold below the partition count routes the
+        # standalone selection through the O(kept) blocked path
+        # (parallel/large_p.select_partitions_blocked); at huge eps the
+        # result must match LocalBackend exactly.
+        rng = np.random.default_rng(3)
+        rows = [(f"u{i % 90}", f"pk{k}", 0)
+                for i, k in enumerate(rng.integers(0, 25, size=3000))]
+
+        def run(backend):
+            accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                                   total_delta=1e-5)
+            engine = pdp.DPEngine(accountant, backend)
+            extractors = pdp.DataExtractors(
+                privacy_id_extractor=lambda r: r[0],
+                partition_extractor=lambda r: r[1],
+                value_extractor=lambda r: r[2])
+            params = pdp.SelectPartitionsParams(max_partitions_contributed=30)
+            result = engine.select_partitions(rows, params, extractors)
+            accountant.compute_budgets()
+            return set(result)
+
+        assert run(pdp.LocalBackend(seed=0)) == run(
+            pdp.TPUBackend(noise_seed=0, large_partition_threshold=8))
+
     def test_select_partitions_tpu_static_width_reuse(self):
         rows = [(f"u{i}", f"pk{i % 3}", 0) for i in range(300)]
         accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,

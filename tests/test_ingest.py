@@ -38,6 +38,55 @@ class TestChunkedVocabEncoder:
         np.testing.assert_array_equal(codes, [0, 0, 1, 0, 2, 1])
         assert list(enc.vocabulary) == [5, 7, 9]
 
+    def test_composite_tuple_keys(self):
+        # Tuple keys must stay single object elements (not explode into a
+        # 2-D array) and encode consistently across chunks.
+        chunk1 = [("a", 1), ("b", 2), ("a", 1)]
+        chunk2 = [("b", 2), ("c", 3), ("a", 1)]
+        enc = ingest.ChunkedVocabEncoder()
+        c1 = enc.encode(chunk1)
+        c2 = enc.encode(chunk2)
+        np.testing.assert_array_equal(c1, [0, 1, 0])
+        np.testing.assert_array_equal(c2, [1, 2, 0])
+        assert list(enc.vocabulary) == [("a", 1), ("b", 2), ("c", 3)]
+
+    @pytest.mark.parametrize("dtype", ["str", "int"])
+    def test_fallback_matches_global_factorize_first_occurrence(
+            self, monkeypatch, dtype):
+        # With pandas masked out the chunk-local factorize can yield
+        # SORTED uniques (np.unique branch); the encoder must still assign
+        # global codes in first-occurrence order of the concatenation.
+        rng = np.random.default_rng(1)
+        ints = rng.integers(0, 500, 10_000)
+        raw = (np.char.add("k", ints.astype(str)).astype(object)
+               if dtype == "str" else ints)
+        expected_codes, expected_vocab = columnar.factorize(
+            columnar._as_key_array(raw))  # pandas path: first-occurrence
+        monkeypatch.setattr(ingest, "_pd", None)
+        monkeypatch.setattr(columnar, "_pd", None)
+        enc = ingest.ChunkedVocabEncoder()
+        got = np.concatenate([
+            enc.encode(raw[i:i + 1234]) for i in range(0, len(raw), 1234)
+        ])
+        np.testing.assert_array_equal(got, expected_codes)
+        assert list(enc.vocabulary) == list(expected_vocab)
+
+    def test_fallback_unorderable_keys_spill_to_dict(self, monkeypatch):
+        # A chunk mixing unorderable key types mid-stream must spill to
+        # the dict path without invalidating already-assigned codes.
+        monkeypatch.setattr(ingest, "_pd", None)
+        monkeypatch.setattr(columnar, "_pd", None)
+        enc = ingest.ChunkedVocabEncoder()
+        c1 = enc.encode(np.array(["x", "y", "x"], dtype=object))
+        c2 = enc.encode(
+            np.array(["y", ("tup", 1), 3, "z"], dtype=object))
+        np.testing.assert_array_equal(c1, [0, 1, 0])
+        np.testing.assert_array_equal(c2, [1, 2, 3, 4])
+        assert list(enc.vocabulary) == ["x", "y", ("tup", 1), 3, "z"]
+        # Codes keep accumulating on the dict path.
+        c3 = enc.encode(np.array([3, "w"], dtype=object))
+        np.testing.assert_array_equal(c3, [3, 5])
+
 
 class TestNetflixChunkedParse:
 

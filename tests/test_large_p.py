@@ -454,3 +454,78 @@ class TestPresortedReduceContract:
         for name in ref:
             np.testing.assert_allclose(np.asarray(fast[name]),
                                        np.asarray(ref[name]), atol=1e-5)
+
+
+class TestBlockedSelection:
+    """O(kept) standalone selection (large_p.select_partitions_blocked)."""
+
+    def _mixed_data(self, P, dense_parts, n_users=60, l0=30, seed=0):
+        # Dense partitions get n_users distinct ids each; every 7th other
+        # partition gets exactly one id -> huge-eps selection decisions are
+        # deterministic (keep prob 1 vs <= delta), so the blocked path's
+        # different per-block RNG stream cannot change the outcome.
+        rows = []
+        for p in dense_parts:
+            for u in range(n_users):
+                rows.append((u * 100_003 + p, p))
+        sparse = [p for p in range(P) if p not in set(dense_parts)][::7]
+        for i, p in enumerate(sparse):
+            rows.append((10_000_000 + i, p))
+        pid = np.array([r[0] for r in rows], np.int64)
+        pk = np.array([r[1] for r in rows], np.int32)
+        valid = np.ones(len(rows), bool)
+        return pid, pk, valid
+
+    def _selection(self, l0):
+        return selection_ops.selection_params_from_host(
+            pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC, 1e7, 1e-5,
+            l0, None)
+
+    def test_matches_dense_kernel_across_blocks(self):
+        import jax.numpy as jnp
+        P, l0 = 300, 30
+        dense_parts = list(range(10)) + [150] + list(range(290, 300))
+        pid, pk, valid = self._mixed_data(P, dense_parts, l0=l0)
+        sel = self._selection(l0)
+        key = jax.random.PRNGKey(5)
+        dense_keep = np.asarray(
+            executor.select_partitions_kernel(jnp.asarray(pid), jnp.asarray(
+                pk), jnp.asarray(valid), key, l0, P, sel))
+        kept = large_p.select_partitions_blocked(pid,
+                                                 pk,
+                                                 valid,
+                                                 key,
+                                                 l0,
+                                                 P,
+                                                 sel,
+                                                 block_partitions=64)
+        np.testing.assert_array_equal(kept, np.nonzero(dense_keep)[0])
+        assert kept.dtype == np.int64
+
+    def test_single_block_and_empty(self):
+        P, l0 = 50, 10
+        sel = self._selection(l0)
+        key = jax.random.PRNGKey(9)
+        pid, pk, valid = self._mixed_data(P, [3, 40], l0=l0)
+        kept = large_p.select_partitions_blocked(pid, pk, valid, key, l0, P,
+                                                 sel)
+        assert set(kept) == {3, 40}
+        # All rows invalid -> every block is empty and skipped.
+        kept = large_p.select_partitions_blocked(pid, pk,
+                                                 np.zeros_like(valid), key,
+                                                 l0, P, sel)
+        assert len(kept) == 0
+
+    def test_l0_sampling_binds(self):
+        # One privacy id spread over every partition with l0=2: at most 2
+        # pair contributions survive, none reach keep-probability 1, and
+        # with delta tiny every partition must be dropped.
+        P = 96
+        pid = np.zeros(P, np.int32)
+        pk = np.arange(P, dtype=np.int32)
+        valid = np.ones(P, bool)
+        sel = self._selection(l0=2)
+        kept = large_p.select_partitions_blocked(pid, pk, valid,
+                                                 jax.random.PRNGKey(1), 2, P,
+                                                 sel, block_partitions=32)
+        assert len(kept) == 0

@@ -359,3 +359,194 @@ class TestShardedSelectPartitions:
         ])
         assert (keep_mesh == expected).all()
         assert (keep_single == expected).all()
+
+
+class TestShardedBlockedLargeP:
+    """Mesh-sharded blocked large-P path (aggregate_blocked_sharded)."""
+
+    @staticmethod
+    def _spec(P, **kw):
+        from tests.test_large_p import _spec
+        return _spec(P, **kw)
+
+    @staticmethod
+    def _data(n, n_ids, P, seed=0):
+        rng = np.random.default_rng(seed)
+        pid = rng.integers(0, n_ids, n).astype(np.int32)
+        pk = rng.integers(0, P, n).astype(np.int32)
+        values = rng.uniform(0, 5, n)
+        return pid, pk, values, np.ones(n, bool)
+
+    @pytest.mark.parametrize("n_devices", [1, 8])
+    def test_public_noise_free_exact_parity(self, n_devices):
+        # Multiple blocks, no selection, zero noise: the sharded blocked
+        # result must EXACTLY match the single-device blocked path and the
+        # raw numpy aggregate.
+        import jax
+        from pipelinedp_tpu.parallel import large_p
+        mesh = make_mesh(n_devices=n_devices)
+        P = 1000
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = self._spec(
+            P, private=False, l0=P, linf=64)
+        stds = np.zeros_like(np.asarray(stds))
+        pid, pk, values, valid = self._data(20_000, 500, P)
+        key = jax.random.PRNGKey(0)
+        kept, outputs = large_p.aggregate_blocked_sharded(
+            mesh, pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+            stds, key, cfg, block_partitions=128)
+        ref_kept, ref_outputs = large_p.aggregate_blocked(
+            pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, stds,
+            key, cfg, block_partitions=128)
+        assert list(kept) == list(range(P))
+        assert list(ref_kept) == list(kept)
+        expected_count = np.bincount(pk, minlength=P)
+        expected_sum = np.bincount(pk, weights=np.clip(values, 0, 5),
+                                   minlength=P)
+        np.testing.assert_allclose(outputs["count"], expected_count,
+                                   atol=1e-4)
+        np.testing.assert_allclose(outputs["sum"], expected_sum, rtol=1e-5)
+        np.testing.assert_allclose(outputs["sum"], ref_outputs["sum"],
+                                   rtol=1e-5)
+
+    def test_private_selection_across_blocks(self):
+        # Dense partitions in first/middle/last block kept, single-id
+        # partitions dropped — decisions deterministic at huge eps, so the
+        # kept set must equal the single-device blocked path's.
+        import jax
+        from pipelinedp_tpu.parallel import large_p
+        mesh = make_mesh(n_devices=8)
+        P = 300
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = self._spec(
+            P, l0=20, linf=4, eps=30)
+        stds = np.zeros_like(np.asarray(stds))
+        rows = []
+        for p in list(range(10)) + [150] + list(range(290, 300)):
+            for u in range(200):
+                rows.append((u * 100_003 + p, p))
+        for i, p in enumerate(range(20, 280, 13)):
+            rows.append((50_000_000 + i, p))
+        pid = np.array([r[0] for r in rows], np.int64)
+        pk = np.array([r[1] for r in rows], np.int32)
+        values = np.ones(len(rows))
+        valid = np.ones(len(rows), bool)
+        key = jax.random.PRNGKey(3)
+        kept, outputs = large_p.aggregate_blocked_sharded(
+            mesh, pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+            stds, key, cfg, block_partitions=64)
+        ref_kept, _ = large_p.aggregate_blocked(
+            pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, stds,
+            key, cfg, block_partitions=64)
+        expected = set(list(range(10)) + [150] + list(range(290, 300)))
+        assert set(kept.tolist()) == expected
+        assert set(ref_kept.tolist()) == expected
+        # Noise-free counts: l0=20 does not bind (each id hits one
+        # partition), so kept counts equal the raw per-partition bincount
+        # (partition 150 also catches one sparse row: 201).
+        truth = np.bincount(pk, minlength=P)
+        np.testing.assert_allclose(outputs["count"], truth[kept], atol=1e-4)
+
+    def test_percentile_blocked_sharded(self):
+        # Per-block lazy quantile descent over the mesh: the [C, B]
+        # child-count psum inside quantile_outputs is the collective under
+        # test. Noise-free medians must land within leaf width of numpy.
+        import jax
+        from pipelinedp_tpu.parallel import large_p
+        mesh = make_mesh(n_devices=8)
+        P = 3000
+        metrics = [pdp.Metrics.COUNT, pdp.Metrics.PERCENTILE(50)]
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = self._spec(
+            P, private=False, metrics_list=metrics, l0=P, linf=64)
+        stds = np.zeros_like(np.asarray(stds))
+        rng = np.random.default_rng(5)
+        n = 30_000
+        pid = rng.integers(0, 400, n).astype(np.int32)
+        pk = rng.integers(0, 40, n).astype(np.int32) * 75  # spread blocks
+        values = rng.uniform(0, 5, n)
+        valid = np.ones(n, bool)
+        kept, outputs = large_p.aggregate_blocked_sharded(
+            mesh, pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+            stds, jax.random.PRNGKey(2), cfg, block_partitions=256)
+        leaf = (max_v - min_v) / (cfg.branching**cfg.tree_height)
+        kept_list = kept.tolist()
+        for p in range(0, 3000, 75):
+            j = kept_list.index(p)
+            true_median = np.quantile(values[pk == p], 0.5,
+                                      method="inverted_cdf")
+            assert abs(outputs["percentile_50"][j] -
+                       true_median) < 3 * leaf + 0.05
+
+    def test_select_partitions_blocked_sharded_matches_single(self):
+        # Mesh + blocked standalone selection: kept set must equal the
+        # single-device blocked path's at huge eps (deterministic
+        # decisions), across block boundaries.
+        import jax
+        from pipelinedp_tpu.ops import selection_ops
+        from pipelinedp_tpu.parallel import large_p
+        mesh = make_mesh(n_devices=8)
+        P, l0 = 300, 30
+        rows = []
+        for p in list(range(10)) + [150] + list(range(290, 300)):
+            for u in range(60):
+                rows.append((u * 100_003 + p, p))
+        for i, p in enumerate(range(21, 280, 13)):
+            rows.append((50_000_000 + i, p))
+        pid = np.array([r[0] for r in rows], np.int64)
+        pk = np.array([r[1] for r in rows], np.int32)
+        valid = np.ones(len(rows), bool)
+        sel = selection_ops.selection_params_from_host(
+            pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC, 1e7, 1e-5,
+            l0, None)
+        key = jax.random.PRNGKey(5)
+        kept = large_p.select_partitions_blocked_sharded(
+            mesh, pid, pk, valid, key, l0, P, sel, block_partitions=64)
+        ref = large_p.select_partitions_blocked(pid, pk, valid, key, l0, P,
+                                                sel, block_partitions=64)
+        expected = sorted(list(range(10)) + [150] + list(range(290, 300)))
+        assert kept.tolist() == expected
+        assert ref.tolist() == expected
+
+    def test_select_partitions_engine_meshed_blocked_route(self):
+        # TPUBackend(mesh, threshold below P): standalone selection must
+        # route through the sharded blocked path and match LocalBackend.
+        rng = np.random.default_rng(11)
+        rows = [(f"u{i % 120}", f"pk{k}", 0.0)
+                for i, k in enumerate(rng.integers(0, 20, size=4000))]
+        mesh = make_mesh(n_devices=8)
+
+        def run(backend):
+            accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                                   total_delta=1e-5)
+            engine = pdp.DPEngine(accountant, backend)
+            params = pdp.SelectPartitionsParams(max_partitions_contributed=30)
+            result = engine.select_partitions(rows, params, EXTRACTORS)
+            accountant.compute_budgets()
+            return set(result)
+
+        expected = run(pdp.LocalBackend(seed=0))
+        assert run(
+            pdp.TPUBackend(mesh=mesh, noise_seed=3,
+                           large_partition_threshold=8)) == expected
+        assert len(expected) == 20
+
+    def test_engine_routes_meshed_blocked(self):
+        # TPUBackend(mesh, large_partition_threshold below P) must route
+        # through the sharded blocked path and agree with LocalBackend.
+        mesh = make_mesh(n_devices=8)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT,
+                                              pdp.Metrics.SUM],
+                                     max_partitions_contributed=7,
+                                     max_contributions_per_partition=30,
+                                     min_value=0.0,
+                                     max_value=5.0)
+        public = ["pk%d" % i for i in range(7)]
+        expected = _aggregate(pdp.LocalBackend(seed=0), ROWS, params, public)
+        actual = _aggregate(
+            pdp.TPUBackend(mesh=mesh, noise_seed=0,
+                           large_partition_threshold=4), ROWS, params,
+            public)
+        assert set(actual) == set(expected)
+        for pk in expected:
+            assert actual[pk].count == pytest.approx(expected[pk].count,
+                                                     abs=0.05)
+            assert actual[pk].sum == pytest.approx(expected[pk].sum,
+                                                   abs=0.05)
