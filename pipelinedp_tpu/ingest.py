@@ -23,7 +23,8 @@ memory and overlaps transfer, not device memory (the blocked large-P path
 owns that axis).
 """
 
-from typing import Any, Iterable, Optional, Sequence, Tuple
+import dataclasses
+from typing import Any, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -34,24 +35,53 @@ try:
 except ImportError:  # pragma: no cover - pandas is in the standard image
     _pd = None
 
+_NAN_KEY = object()  # canonical dict key for NaN (NaN != NaN breaks lookup)
+
+
+def _dict_key(key):
+    """Canonicalizes NaN to a shared sentinel for the dict fallback: every
+    float('nan') object is distinct under ==, so raw NaN keys would each
+    get their own code."""
+    try:
+        if key != key:  # NaN is the only self-unequal value
+            return _NAN_KEY
+    except Exception:  # exotic __ne__ — treat as an ordinary key
+        pass
+    return key
+
+
+def _kind_group(dtype) -> str:
+    """Coarse dtype family for the sorted-vocab compatibility check."""
+    if dtype.kind in "biuf":
+        return "num"
+    if dtype.kind in "SU":
+        return "str"
+    return "obj"
+
 
 class ChunkedVocabEncoder:
     """Incremental first-occurrence vocabulary encoding across chunks.
 
     Feeding chunks in order yields exactly the codes columnar.factorize
-    would assign to the concatenation, on every path: per-chunk
-    factorization (C speed) followed by a remap of the chunk's uniques
-    against the growing global vocabulary — O(chunk + new uniques) per
-    chunk, never O(total). Without pandas the remap runs vectorized
+    would assign to the concatenation — on the pandas path and on the
+    vectorized numpy fallback, including NaN unification (all NaN keys
+    share one code, kept out of the sorted vocabulary where comparisons
+    would mis-place it) and cross-chunk dtype promotion (a later chunk
+    with a wider string / finer numeric dtype widens the stored
+    vocabulary instead of truncating new keys). Per chunk: factorization
+    (C speed) followed by a vectorized remap of the chunk's uniques
     against a sorted copy of the vocabulary (searchsorted + insert,
-    O(V + new·log new) per chunk); only key types numpy cannot order
-    fall back to a per-unique dict loop.
+    O(V + new·log new)). Only key types numpy cannot order fall back to
+    a per-unique dict loop, which — like columnar.factorize's own
+    last-resort branch — treats each NaN object individually.
     """
 
     def __init__(self):
         self._index = None  # pandas Index (fast path)
-        self._sorted_vocab = None  # numpy fallback: sorted uniques
+        self._sorted_vocab = None  # numpy fallback: sorted non-NaN uniques
         self._sorted_codes = None  # global code of each sorted entry
+        self._nan_code: Optional[int] = None  # shared code for NaN keys
+        self._next_code = 0  # total codes assigned on the numpy fallback
         self._dict: Optional[dict] = None  # unorderable-key last resort
 
     def encode(self, raw) -> np.ndarray:
@@ -98,29 +128,84 @@ class ChunkedVocabEncoder:
                       uniques: np.ndarray) -> np.ndarray:
         """Vectorized remap of chunk uniques (first-occurrence order)
         against the sorted global vocabulary."""
-        if self._sorted_vocab is None or not len(self._sorted_vocab):
-            order = np.argsort(uniques, kind="stable")  # may TypeError
-            self._sorted_vocab = uniques[order]
-            self._sorted_codes = order.astype(np.int64)
-            return codes.astype(np.int32)
-        n_old = len(self._sorted_vocab)
-        pos = np.searchsorted(self._sorted_vocab, uniques)
-        pos_c = np.minimum(pos, n_old - 1)
-        found = (pos < n_old) & (self._sorted_vocab[pos_c] == uniques)
-        remap = np.empty(len(uniques), np.int64)
-        remap[found] = self._sorted_codes[pos_c[found]]
-        new_mask = ~found
-        n_new = int(new_mask.sum())
-        # uniques are in first-occurrence order, so arange over the new
-        # ones IS the order a global factorize would meet them.
-        remap[new_mask] = n_old + np.arange(n_new)
-        if n_new:
-            new_u, new_c = uniques[new_mask], remap[new_mask]
-            no = np.argsort(new_u, kind="stable")
+        n_u = len(uniques)
+        if self._sorted_vocab is None:
+            self._sorted_vocab = np.empty(0, uniques.dtype)
+            self._sorted_codes = np.empty(0, np.int64)
+        elif len(self._sorted_vocab):
+            # Mixed number/string chunks must spill to the dict path
+            # (where 1.5 and '1.5' stay distinct keys, matching pandas):
+            # numpy would otherwise silently STRINGIFY numbers via dtype
+            # promotion instead of raising.
+            a = _kind_group(self._sorted_vocab.dtype)
+            b = _kind_group(uniques.dtype)
+            if "obj" not in (a, b) and a != b:
+                raise TypeError(
+                    f"cannot mix {a} and {b} keys in the sorted vocab")
+        # NaN never matches itself under searchsorted/==, so NaN keys are
+        # tracked by a dedicated code and kept out of the sorted array
+        # (where they would also corrupt later binary searches). Object
+        # arrays get the per-element check: an all-float object chunk
+        # compares without raising, so it would NOT spill to the dict path.
+        if uniques.dtype.kind == "f":
+            is_nan = np.isnan(uniques)
+        elif uniques.dtype.kind == "O" and n_u:
+            is_nan = np.fromiter(
+                (_dict_key(k) is _NAN_KEY for k in uniques), bool, count=n_u)
+        else:
+            is_nan = np.zeros(n_u, bool)
+        nan_idx = np.nonzero(is_nan)[0]
+        remap = np.empty(n_u, np.int64)
+        known = np.zeros(n_u, bool)
+        if len(nan_idx) and self._nan_code is not None:
+            known[nan_idx] = True
+            remap[nan_idx] = self._nan_code
+        reg_idx = np.nonzero(~is_nan)[0]
+        u = uniques[reg_idx]
+        n_vocab = len(self._sorted_vocab)
+        if n_vocab and len(u):
+            pos = np.searchsorted(self._sorted_vocab, u)  # may TypeError
+            pos_c = np.minimum(pos, n_vocab - 1)
+            found = (pos < n_vocab) & (self._sorted_vocab[pos_c] == u)
+            known[reg_idx[found]] = True
+            remap[reg_idx[found]] = self._sorted_codes[pos_c[found]]
+        # New codes in first-occurrence order of the chunk (uniques are
+        # already ordered that way) = the order a global factorize would
+        # meet them. Duplicate NaN uniques (possible only from
+        # factorize's last-resort branch) alias to one representative.
+        assign_new = ~known
+        nan_is_new = bool(len(nan_idx)) and self._nan_code is None
+        if nan_is_new:
+            assign_new[nan_idx[1:]] = False
+        new_idx = np.nonzero(assign_new)[0]
+        remap[new_idx] = self._next_code + np.arange(len(new_idx))
+        new_nan_code = None
+        if nan_is_new:
+            new_nan_code = int(remap[nan_idx[0]])
+            remap[nan_idx] = new_nan_code
+        new_reg = new_idx[~is_nan[new_idx]]
+        if len(new_reg):
+            new_u, new_c = uniques[new_reg], remap[new_reg]
+            # Widen first: np.insert would silently cast new keys to the
+            # stored dtype (truncating e.g. '<U5' into a '<U2' vocab).
+            dt = np.promote_types(self._sorted_vocab.dtype,
+                                  new_u.dtype)  # may TypeError
+            if dt != new_u.dtype:
+                new_u = new_u.astype(dt)
+            no = np.argsort(new_u, kind="stable")  # may TypeError
             new_u, new_c = new_u[no], new_c[no]
-            ins = np.searchsorted(self._sorted_vocab, new_u)
-            self._sorted_vocab = np.insert(self._sorted_vocab, ins, new_u)
+            vocab = self._sorted_vocab
+            if dt != vocab.dtype:
+                vocab = vocab.astype(dt)
+            ins = np.searchsorted(vocab, new_u)  # may TypeError
+            # All TypeError-prone ops are done — commit state (a raise
+            # above must leave the encoder untouched so the dict spill
+            # rebuilds from a consistent vocabulary).
+            self._sorted_vocab = np.insert(vocab, ins, new_u)
             self._sorted_codes = np.insert(self._sorted_codes, ins, new_c)
+        self._next_code += len(new_idx)
+        if nan_is_new:
+            self._nan_code = new_nan_code
         return remap[codes].astype(np.int32)
 
     def _spill_to_dict(self) -> None:
@@ -130,6 +215,8 @@ class ChunkedVocabEncoder:
         if self._sorted_vocab is not None:
             for key, code in zip(self._sorted_vocab, self._sorted_codes):
                 self._dict[key] = int(code)
+            if self._nan_code is not None:
+                self._dict[_NAN_KEY] = self._nan_code
             # Re-key by code order is unnecessary: dict lookups are by key.
             self._sorted_vocab = self._sorted_codes = None
 
@@ -137,7 +224,8 @@ class ChunkedVocabEncoder:
                     uniques: np.ndarray) -> np.ndarray:
         remap = np.empty(len(uniques), np.int64)
         for j, key in enumerate(uniques):
-            remap[j] = self._dict.setdefault(key, len(self._dict))
+            remap[j] = self._dict.setdefault(_dict_key(key),
+                                             len(self._dict))
         return remap[codes].astype(np.int32)
 
     @property
@@ -145,14 +233,23 @@ class ChunkedVocabEncoder:
         if self._index is not None:
             return np.asarray(self._index)
         if self._sorted_vocab is not None:
-            out = np.empty(len(self._sorted_vocab),
-                           dtype=self._sorted_vocab.dtype)
+            dt = self._sorted_vocab.dtype
+            if self._nan_code is not None:
+                if dt.kind in "biu":
+                    dt = np.promote_types(dt, np.float64)
+                elif dt.kind != "f":
+                    # A string/object vocab cannot hold a float NaN;
+                    # promotion to '<U..' would store the STRING 'nan'.
+                    dt = np.dtype(object)
+            out = np.empty(self._next_code, dtype=dt)
             out[self._sorted_codes] = self._sorted_vocab
+            if self._nan_code is not None:
+                out[self._nan_code] = np.nan
             return out
         if self._dict:
             vocab = np.empty(len(self._dict), dtype=object)
             for key, code in self._dict.items():
-                vocab[code] = key
+                vocab[code] = np.nan if key is _NAN_KEY else key
             return vocab
         return np.empty(0, dtype=object)
 
@@ -160,7 +257,7 @@ class ChunkedVocabEncoder:
         if self._index is not None:
             return len(self._index)
         if self._sorted_vocab is not None:
-            return len(self._sorted_vocab)
+            return self._next_code
         return len(self._dict or ())
 
 
@@ -213,3 +310,146 @@ def stream_encode_columns(
                          pk_enc.vocabulary),
         n_privacy_ids=len(pid_enc),
         public_encoded=public_partitions is not None)
+
+
+# --- Multi-host ingest -----------------------------------------------------
+#
+# The reference scales unbounded IO by handing it to Beam/Spark workers
+# (pipeline_dp/pipeline_backend.py:223-374). The TPU-native equivalent is
+# host-sharded ingest: in a multi-host deployment each host process parses
+# and vocab-encodes ITS contiguous shard of the input independently
+# (encode_shard — pure numpy, no device), the per-host vocabularies are
+# merged with one pass of the same incremental encoder
+# (merge_host_vocabularies — the returned codes ARE each host's
+# local->global remap), and each host remaps + uploads only its own rows
+# to its local devices, so the only cross-host (DCN) traffic is the
+# vocabularies and O(uniques) remap vectors — never row data. With hosts
+# owning contiguous shards in stream order, the merged codes are exactly
+# what a single-process factorize of the whole stream would assign.
+
+
+@dataclasses.dataclass
+class ShardEncoding:
+    """One host's locally-encoded shard: int32 code columns + the local
+    vocabularies they index. Picklable (pure numpy) so worker processes
+    can ship it back to the coordinator."""
+    pid: np.ndarray
+    pk: np.ndarray
+    values: np.ndarray
+    pid_vocab: np.ndarray
+    pk_vocab: Optional[np.ndarray]  # None when pk was publicly encoded
+
+
+def encode_shard(
+        chunks: Iterable[Tuple[Sequence[Any], Sequence[Any],
+                               Sequence[float]]],
+        public_partitions: Optional[Sequence[Any]] = None) -> ShardEncoding:
+    """Host-local chunked encoding of one input shard (no device work).
+
+    The multi-host counterpart of stream_encode_columns' parse+factorize
+    stage: runs in each ingest process over its own chunk iterator.
+    """
+    pid_enc = ChunkedVocabEncoder()
+    pk_enc = ChunkedVocabEncoder()
+    partition_vocab = None
+    if public_partitions is not None:
+        partition_vocab = list(dict.fromkeys(public_partitions))
+    pids, pks, vals = [], [], []
+    for pid_raw, pk_raw, values in chunks:
+        pids.append(pid_enc.encode(pid_raw))
+        if partition_vocab is not None:
+            pks.append(
+                columnar.encode_with_vocab(columnar._as_key_array(pk_raw),
+                                           partition_vocab))
+        else:
+            pks.append(pk_enc.encode(pk_raw))
+        vals.append(np.asarray(values, dtype=np.float64))
+    empty = np.zeros(0, np.int32)
+    return ShardEncoding(
+        pid=np.concatenate(pids) if pids else empty,
+        pk=np.concatenate(pks) if pks else empty,
+        values=(np.concatenate(vals) if vals else np.zeros(0)),
+        pid_vocab=np.asarray(pid_enc.vocabulary),
+        pk_vocab=(None if partition_vocab is not None else np.asarray(
+            pk_enc.vocabulary)))
+
+
+def merge_host_vocabularies(
+        vocabs: Sequence[Sequence[Any]]
+) -> Tuple[np.ndarray, List[np.ndarray]]:
+    """Merges per-host vocabularies into one global first-occurrence
+    vocabulary (host order = stream order).
+
+    The merge primitive is the incremental encoder itself: feeding host
+    h's vocabulary (in local code order) as one "chunk" returns the
+    global code of each local code — i.e. the remap vector
+    ``global_code = remap[local_code]``.
+
+    Returns (global_vocabulary, [remap_int32 per host]).
+    """
+    enc = ChunkedVocabEncoder()
+    remaps = []
+    for vocab in vocabs:
+        vocab = columnar._as_key_array(vocab)
+        remaps.append(
+            enc.encode(vocab) if len(vocab) else np.zeros(0, np.int32))
+    return np.asarray(enc.vocabulary), remaps
+
+
+def merge_shards(shards: Sequence[ShardEncoding],
+                 public_partitions: Optional[Sequence[Any]] = None
+                 ) -> columnar.EncodedData:
+    """Coordinator step: merge per-host shard encodings into one
+    device-resident EncodedData.
+
+    Row columns are remapped with each host's O(local uniques) remap
+    vector and uploaded shard-by-shard (each shard's device copy overlaps
+    the next shard's remap, as in stream_encode_columns). In a real
+    multi-host deployment the remap vectors travel to the hosts instead
+    of the rows travelling here — see the module docstring's DCN note;
+    this single-process form is the semantics (and the dryrun target) of
+    that deployment.
+    """
+    import jax.numpy as jnp
+
+    from pipelinedp_tpu import executor
+
+    value_dtype = np.dtype(executor._ftype())
+    pid_vocab, pid_remaps = merge_host_vocabularies(
+        [s.pid_vocab for s in shards])
+    public = public_partitions is not None
+    if public:
+        for s in shards:
+            if s.pk_vocab is not None:
+                raise ValueError(
+                    "shard was encoded without public partitions but "
+                    "merge_shards was called with them — the shard's pk "
+                    "codes index its private vocabulary, not the public "
+                    "one")
+        partition_vocab = list(dict.fromkeys(public_partitions))
+        pk_remaps = None
+    else:
+        for s in shards:
+            if s.pk_vocab is None:
+                raise ValueError(
+                    "shard was encoded with public partitions but "
+                    "merge_shards was called without them")
+        partition_vocab, pk_remaps = merge_host_vocabularies(
+            [s.pk_vocab for s in shards])
+    dev_pid, dev_pk, dev_vals = [], [], []
+    for h, s in enumerate(shards):
+        dev_pid.append(jnp.asarray(pid_remaps[h][s.pid]))
+        dev_pk.append(
+            jnp.asarray(s.pk if public else pk_remaps[h][s.pk]))
+        dev_vals.append(jnp.asarray(s.values.astype(value_dtype)))
+    if not dev_pid:
+        empty = jnp.zeros(0, jnp.int32)
+        dev_pid, dev_pk = [empty], [empty]
+        dev_vals = [jnp.zeros(0, value_dtype)]
+    return columnar.EncodedData(
+        pid=jnp.concatenate(dev_pid),
+        pk=jnp.concatenate(dev_pk),
+        values=jnp.concatenate(dev_vals),
+        partition_vocab=partition_vocab,
+        n_privacy_ids=len(pid_vocab),
+        public_encoded=public)

@@ -71,6 +71,99 @@ class TestChunkedVocabEncoder:
         np.testing.assert_array_equal(got, expected_codes)
         assert list(enc.vocabulary) == list(expected_vocab)
 
+    def test_fallback_dtype_widening(self, monkeypatch):
+        # A later chunk with a wider string dtype must widen the stored
+        # vocabulary, not truncate the new keys into it (np.insert would
+        # silently cast 'hello' to 'he' in a '<U2' vocab).
+        monkeypatch.setattr(ingest, "_pd", None)
+        monkeypatch.setattr(columnar, "_pd", None)
+        enc = ingest.ChunkedVocabEncoder()
+        c1 = enc.encode(np.array(["ab", "cd"]))
+        c2 = enc.encode(np.array(["hello", "ab"]))
+        c3 = enc.encode(np.array(["hello", "cd"]))
+        np.testing.assert_array_equal(c1, [0, 1])
+        np.testing.assert_array_equal(c2, [2, 0])
+        np.testing.assert_array_equal(c3, [2, 1])
+        assert list(enc.vocabulary) == ["ab", "cd", "hello"]
+        # Numeric widening: float keys against an int vocab must not be
+        # floored into it.
+        enc2 = ingest.ChunkedVocabEncoder()
+        enc2.encode(np.array([1, 2]))
+        c = enc2.encode(np.array([1.5, 1.0]))
+        np.testing.assert_array_equal(c, [2, 0])
+        assert list(enc2.vocabulary) == [1.0, 2.0, 1.5]
+
+    def test_fallback_nan_keys_unify(self, monkeypatch):
+        # All NaN keys share one code across chunks (pandas
+        # use_na_sentinel=False semantics), and the NaN key never enters
+        # the sorted vocab where it would corrupt binary searches.
+        monkeypatch.setattr(ingest, "_pd", None)
+        monkeypatch.setattr(columnar, "_pd", None)
+        enc = ingest.ChunkedVocabEncoder()
+        c1 = enc.encode(np.array([1.0, np.nan, 2.0]))
+        c2 = enc.encode(np.array([np.nan, 1.0, 3.0]))
+        np.testing.assert_array_equal(c1, [0, 1, 2])
+        np.testing.assert_array_equal(c2, [1, 0, 3])
+        vocab = enc.vocabulary
+        assert len(vocab) == 4
+        assert vocab[0] == 1.0 and np.isnan(vocab[1]) and vocab[2] == 2.0
+        assert vocab[3] == 3.0
+        # Keys larger than everything must still be found after NaN
+        # appeared (NaN inside the sorted array would break the search).
+        c3 = enc.encode(np.array([99.0, np.nan, 3.0]))
+        np.testing.assert_array_equal(c3, [4, 1, 3])
+        c4 = enc.encode(np.array([99.0]))
+        np.testing.assert_array_equal(c4, [4])
+
+    def test_fallback_mixed_number_string_chunks_spill(self, monkeypatch):
+        # numpy silently PROMOTES numbers to strings instead of raising;
+        # the encoder must detect the kind mismatch and spill to the dict
+        # path where 1.5 and '1.5' stay distinct keys (pandas semantics).
+        monkeypatch.setattr(ingest, "_pd", None)
+        monkeypatch.setattr(columnar, "_pd", None)
+        enc = ingest.ChunkedVocabEncoder()
+        c1 = enc.encode(np.array(["ab", "cd"]))
+        c2 = enc.encode(np.array([1.5, 2.5]))
+        c3 = enc.encode(np.array([1.5, "1.5", "ab"], dtype=object))
+        np.testing.assert_array_equal(c1, [0, 1])
+        np.testing.assert_array_equal(c2, [2, 3])
+        np.testing.assert_array_equal(c3, [2, 4, 0])
+        assert list(enc.vocabulary) == ["ab", "cd", 1.5, 2.5, "1.5"]
+
+    def test_fallback_nan_survives_dict_spill(self, monkeypatch):
+        # The NaN code must keep matching after a spill to the dict path
+        # (every float('nan') object is distinct under ==).
+        monkeypatch.setattr(ingest, "_pd", None)
+        monkeypatch.setattr(columnar, "_pd", None)
+        enc = ingest.ChunkedVocabEncoder()
+        c1 = enc.encode(np.array([1.0, np.nan]))
+        c2 = enc.encode(np.array(["x", 2, np.nan], dtype=object))  # spills
+        c3 = enc.encode(np.array([np.nan, 1.0]))
+        np.testing.assert_array_equal(c1, [0, 1])
+        np.testing.assert_array_equal(c2, [2, 3, 1])
+        np.testing.assert_array_equal(c3, [1, 0])
+        vocab = enc.vocabulary
+        assert vocab[0] == 1.0 and np.isnan(vocab[1])
+
+    def test_fallback_nan_with_string_vocab(self, monkeypatch):
+        # A float NaN key alongside string keys: the vocabulary must hold
+        # a REAL NaN (object dtype), not the string 'nan'.
+        monkeypatch.setattr(ingest, "_pd", None)
+        monkeypatch.setattr(columnar, "_pd", None)
+        enc = ingest.ChunkedVocabEncoder()
+        enc.encode(np.array(["a", "b"], dtype=object))
+        enc.encode(np.array([np.nan], dtype=object))
+        vocab = enc.vocabulary
+        assert list(vocab[:2]) == ["a", "b"]
+        assert isinstance(vocab[2], float) and np.isnan(vocab[2])
+        # An int vocab with NaN promotes to float64, not to a string.
+        enc2 = ingest.ChunkedVocabEncoder()
+        enc2.encode(np.array([7, 9]))
+        enc2.encode(np.array([np.nan]))
+        vocab2 = enc2.vocabulary
+        assert vocab2.dtype.kind in "fO"
+        assert vocab2[0] == 7 and np.isnan(vocab2[2])
+
     def test_fallback_unorderable_keys_spill_to_dict(self, monkeypatch):
         # A chunk mixing unorderable key types mid-stream must spill to
         # the dict path without invalidating already-assigned codes.
@@ -296,3 +389,142 @@ def test_generate_file_zero_rows(tmp_path):
     path = str(tmp_path / "empty.txt")
     netflix_format.generate_file(path, 0)
     assert open(path).read() == ""
+
+
+class TestMultiHostIngest:
+    """Host-sharded ingest: encode_shard + vocabulary merge + remap."""
+
+    @staticmethod
+    def _raw(n=6000, seed=7):
+        rng = np.random.default_rng(seed)
+        pids = np.char.add("u", rng.integers(0, 500, n).astype(str))
+        pks = np.char.add("pk", rng.integers(0, 60, n).astype(str))
+        vals = rng.uniform(0, 5, n)
+        return pids, pks, vals
+
+    def _shard_chunks(self, pids, pks, vals, h, n_hosts, chunk=517):
+        n = len(pids)
+        per = -(-n // n_hosts)
+        lo, hi = h * per, min((h + 1) * per, n)
+        return [(pids[i:min(i + chunk, hi)], pks[i:min(i + chunk, hi)],
+                 vals[i:min(i + chunk, hi)]) for i in range(lo, hi, chunk)]
+
+    def test_merge_matches_single_process_factorize(self):
+        pids, pks, vals = self._raw()
+        n_hosts = 3
+        shards = [
+            ingest.encode_shard(self._shard_chunks(pids, pks, vals, h,
+                                                   n_hosts))
+            for h in range(n_hosts)
+        ]
+        merged = ingest.merge_shards(shards)
+        expected = columnar.encode_columns(pids, pks, vals)
+        np.testing.assert_array_equal(np.asarray(merged.pid), expected.pid)
+        np.testing.assert_array_equal(np.asarray(merged.pk), expected.pk)
+        assert list(merged.partition_vocab) == list(
+            expected.partition_vocab)
+        assert merged.n_privacy_ids == expected.n_privacy_ids
+        np.testing.assert_allclose(np.asarray(merged.values),
+                                   vals.astype(np.float32), rtol=1e-6)
+
+    def test_merge_public_partitions(self):
+        pids, pks, vals = self._raw(2000)
+        public = [f"pk{i}" for i in range(40)]
+        shards = [
+            ingest.encode_shard(self._shard_chunks(pids, pks, vals, h, 2),
+                                public_partitions=public)
+            for h in range(2)
+        ]
+        merged = ingest.merge_shards(shards, public_partitions=public)
+        expected = columnar.encode_columns(pids, pks, vals,
+                                           public_partitions=public)
+        np.testing.assert_array_equal(np.asarray(merged.pk), expected.pk)
+        assert merged.public_encoded
+
+    def test_merge_public_mismatch_raises(self):
+        pids, pks, vals = self._raw(200)
+        shard = ingest.encode_shard(self._shard_chunks(pids, pks, vals, 0, 1),
+                                    public_partitions=["pk1"])
+        with pytest.raises(ValueError, match="public"):
+            ingest.merge_shards([shard])
+        # Reverse direction: privately-encoded shard + public merge must
+        # also raise (the pk codes index the wrong vocabulary).
+        shard_priv = ingest.encode_shard(
+            self._shard_chunks(pids, pks, vals, 0, 1))
+        with pytest.raises(ValueError, match="without public"):
+            ingest.merge_shards([shard_priv], public_partitions=["pk1"])
+
+    def test_merge_fallback_no_pandas(self, monkeypatch):
+        monkeypatch.setattr(ingest, "_pd", None)
+        monkeypatch.setattr(columnar, "_pd", None)
+        pids, pks, vals = self._raw(3000)
+        pids = pids.astype(object)
+        pks = pks.astype(object)
+        shards = [
+            ingest.encode_shard(self._shard_chunks(pids, pks, vals, h, 3))
+            for h in range(3)
+        ]
+        merged = ingest.merge_shards(shards)
+        monkeypatch.undo()
+        expected = columnar.encode_columns(pids, pks, vals)
+        np.testing.assert_array_equal(np.asarray(merged.pid), expected.pid)
+        np.testing.assert_array_equal(np.asarray(merged.pk), expected.pk)
+
+    def test_n_process_dryrun_and_engine(self, tmp_path):
+        # REAL process isolation: each "host" encodes its shard in a
+        # separate python process (no shared encoder state), the parent
+        # merges and runs the engine — codes must equal the single-process
+        # factorize and the DP result must match the row-input path.
+        import pickle
+        import subprocess
+
+        pids, pks, vals = self._raw(4000)
+        n_hosts = 3
+        worker = tmp_path / "worker.py"
+        worker.write_text(
+            "import os, pickle, sys\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "sys.path.insert(0, %r)\n"
+            "import numpy as np\n"
+            "from pipelinedp_tpu import ingest\n"
+            "with open(sys.argv[1], 'rb') as f:\n"
+            "    chunks = pickle.load(f)\n"
+            "shard = ingest.encode_shard(chunks)\n"
+            "with open(sys.argv[2], 'wb') as f:\n"
+            "    pickle.dump(shard, f)\n" %
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+        shards = []
+        for h in range(n_hosts):
+            inp, out = tmp_path / f"in{h}.pkl", tmp_path / f"out{h}.pkl"
+            with open(inp, "wb") as f:
+                pickle.dump(self._shard_chunks(pids, pks, vals, h, n_hosts),
+                            f)
+            subprocess.run([sys.executable, str(worker), str(inp), str(out)],
+                           check=True, timeout=300)
+            with open(out, "rb") as f:
+                shards.append(pickle.load(f))
+        merged = ingest.merge_shards(shards)
+        expected = columnar.encode_columns(pids, pks, vals)
+        np.testing.assert_array_equal(np.asarray(merged.pid), expected.pid)
+        np.testing.assert_array_equal(np.asarray(merged.pk), expected.pk)
+
+        rows = list(zip(pids, pks, vals))
+        ex = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                partition_extractor=lambda r: r[1],
+                                value_extractor=lambda r: float(r[2]))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=60,
+            max_contributions_per_partition=30,
+            min_value=0.0,
+            max_value=5.0)
+
+        def agg(data):
+            acc = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                            total_delta=1e-6)
+            engine = pdp.DPEngine(acc, pdp.TPUBackend(noise_seed=5))
+            result = engine.aggregate(data, params, ex)
+            acc.compute_budgets()
+            return {k: round(v.count, 2) for k, v in dict(result).items()}
+
+        assert agg(merged) == agg(rows)
