@@ -10,8 +10,17 @@ deliberately:
     failure mode UniqueLabelsGenerator exists to prevent);
   * every transform is applied through Pipeline.apply via `|` / `>>`
     plumbing, exactly as the adapters compose them;
-  * CoGroupByKey produces (key, {tag: [values]}), CombinePerKey takes a
-    callable over the iterable of values, side inputs arrive as extra args.
+  * CoGroupByKey produces (key, {tag: [values]}) with per-tag LISTS
+    (matching real Beam, which materializes them), CombinePerKey takes a
+    callable over the iterable of values, side inputs arrive as extra
+    args;
+  * GroupByKey/CombinePerKey values are handed to user code as LAZY
+    REITERABLES (_GroupedIterable), not lists — re-iteration is allowed
+    (Beam guarantees it) but len()/indexing/mutation raise TypeError, the
+    exact bug class a DirectRunner list hides and a real shuffle exposes;
+  * windowing is rejected loudly (WindowInto / window.* raise
+    NotImplementedError): execution is eager in one global window, and a
+    pipeline that needs windows must not silently get global semantics.
 
 Execution is eager over Python lists — a DirectRunner without the runner —
 with one worker-boundary fidelity guarantee: every user closure is shipped
@@ -38,6 +47,74 @@ def _ship(obj):
     """Simulate the driver->worker serialization boundary (closures AND
     side-input values both cross it on a real runner)."""
     return _cloudpickle.loads(_cloudpickle.dumps(obj))
+
+
+class _GroupedIterable:
+    """The lazy reiterable a real runner hands to per-key consumers.
+
+    Iterable — and RE-iterable, as Beam's GroupByKey contract guarantees —
+    but deliberately not a list: len(), indexing, slicing, and mutation
+    raise TypeError so adapter code that assumes materialized lists fails
+    here the way it would on a real shuffle. `iterations` counts fresh
+    passes so tests can assert single-pass consumption where an adapter
+    promises it.
+    """
+
+    __slots__ = ("_values", "iterations")
+
+    def __init__(self, values):
+        self._values = tuple(values)
+        self.iterations = 0
+
+    def __iter__(self):
+        self.iterations += 1
+        return iter(self._values)
+
+    def __len__(self):
+        raise TypeError(
+            "grouped values are a lazy iterable, not a list: len() is "
+            "unavailable on a real runner — iterate (or materialize "
+            "explicitly) instead")
+
+    def __getitem__(self, _):
+        raise TypeError(
+            "grouped values are a lazy iterable, not a list: indexing is "
+            "unavailable on a real runner — iterate instead")
+
+    def __eq__(self, other):  # tests compare materialized results
+        return NotImplemented
+
+    def __repr__(self):
+        return f"_GroupedIterable(<{len(self._values)} values>)"
+
+
+class WindowInto(PTransform):
+    """Rejecting stub: the fake runner executes eagerly in one global
+    window; silently dropping window semantics would corrupt any pipeline
+    that actually needs them."""
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "windowing is not supported by the fake Beam runner (eager "
+            "execution in a single global window)")
+
+
+class _RejectedWindowFn:
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "windowing is not supported by the fake Beam runner (eager "
+            "execution in a single global window)")
+
+
+class _WindowModule:
+    FixedWindows = _RejectedWindowFn
+    SlidingWindows = _RejectedWindowFn
+    Sessions = _RejectedWindowFn
+    GlobalWindows = _RejectedWindowFn
+
+
+window = _WindowModule()
 
 
 class _PipelineResult:
@@ -180,7 +257,7 @@ class GroupByKey(PTransform):
             grouped = {}
             for k, v in _data(pcoll):
                 grouped.setdefault(k, []).append(v)
-            return list(grouped.items())
+            return [(k, _GroupedIterable(vs)) for k, vs in grouped.items()]
 
         return _out(pcoll, thunk)
 
@@ -227,6 +304,9 @@ class CoGroupByKey(PTransform):
                 for k, v in _data(pcoll):
                     joined.setdefault(k,
                                       {t: [] for t in tagged})[tag].append(v)
+            # Real Beam's CoGroupByKey materializes per-tag LISTS (unlike
+            # GroupByKey's lazy iterables), so list semantics are the
+            # faithful model here.
             return list(joined.items())
 
         pipeline = next(iter(tagged.values())).pipeline
@@ -273,7 +353,8 @@ class CombinePerKey(PTransform):
             grouped = {}
             for k, v in _data(pcoll):
                 grouped.setdefault(k, []).append(v)
-            return [(k, fn(vs)) for k, vs in grouped.items()]
+            return [(k, fn(_GroupedIterable(vs)))
+                    for k, vs in grouped.items()]
 
         return _out(pcoll, thunk)
 

@@ -369,6 +369,55 @@ def test_worker_serialization_boundary():
           result == [1, 2, 3] and driver_side == [])
 
 
+def test_grouped_values_are_lazy_reiterables():
+    """GroupByKey/CombinePerKey values must behave like a real shuffle's
+    lazy iterables: re-iterable, but len()/indexing raise TypeError (the
+    bug class a DirectRunner list hides)."""
+    pipeline = beam.Pipeline()
+    pcol = pcol_of(pipeline, [("a", 1), ("a", 2), ("b", 3)])
+    grouped = pcol | "gbk strict" >> beam.GroupByKey()
+    items = dict(grouped._data)
+    vs = items["a"]
+    check("grouped values are re-iterable",
+          sorted(vs) == [1, 2] and sorted(vs) == [1, 2])
+    for op, fn in (("len", lambda: len(vs)), ("index", lambda: vs[0]),
+                   ("bool", lambda: bool(vs))):
+        try:
+            fn()
+            check(f"grouped values reject {op}()", False)
+        except TypeError:
+            check(f"grouped values reject {op}()", True)
+    combined = pcol | "combine strict" >> beam.CombinePerKey(
+        lambda values: sum(values))
+    check("CombinePerKey fn receives an iterable (sum works)",
+          dict(combined._data) == {"a": 3, "b": 3})
+
+    pipeline2 = beam.Pipeline()
+    pcol2 = pcol_of(pipeline2, [("a", 1)])
+    try:
+        _ = pcol2 | "combine list op" >> beam.CombinePerKey(
+            lambda values: values[0])
+        list(_._data)
+        check("CombinePerKey fn indexing grouped values rejected", False)
+    except TypeError:
+        check("CombinePerKey fn indexing grouped values rejected", True)
+
+
+def test_windowing_rejected():
+    """The eager fake must refuse windowed pipelines rather than silently
+    run them in one global window."""
+    try:
+        beam.WindowInto(object())
+        check("WindowInto rejected", False)
+    except NotImplementedError:
+        check("WindowInto rejected", True)
+    try:
+        beam.window.FixedWindows(60)
+        check("window.FixedWindows rejected", False)
+    except NotImplementedError:
+        check("window.FixedWindows rejected", True)
+
+
 if __name__ == "__main__":
     test_backend_ops_match_local()
     test_duplicate_labels_raise()
@@ -379,4 +428,6 @@ if __name__ == "__main__":
     test_private_contribution_bounds_on_beam()
     test_utility_analysis_on_beam()
     test_worker_serialization_boundary()
+    test_grouped_values_are_lazy_reiterables()
+    test_windowing_rejected()
     print("BEAM_CHECKS_PASSED")
