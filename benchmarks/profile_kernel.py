@@ -146,3 +146,62 @@ print(f"chunked_cumsum: {t_cs*1e3:.1f} ms", flush=True)
 t_sc, _ = timed(scans_cost, values_, pk_)
 print(f"scan bundle (boundary+ranks+next+cumsum): {t_sc*1e3:.1f} ms",
       flush=True)
+
+
+def time_packed_variants():
+    """Key-packing experiment: (pid,h0)->i64 and (h1,pk)->i64 give a
+    LOSSLESS 3-key sort with ordering identical to the 5-key original
+    (all fields non-negative < 2^32, lexicographic order preserved by
+    the shifts). TPU emulates int64 as register pairs, so comparator
+    work per element is similar — the question the measurement answers
+    is whether fewer lax.sort operands beat the packing overhead.
+
+    Flips jax_enable_x64 globally (int64 is silently downcast without
+    it); runs LAST in this script so earlier measurements keep the
+    kernel's real f32/i32 dtypes."""
+    jax.config.update("jax_enable_x64", True)
+    try:
+
+        @jax.jit
+        def packed3(pid, pk, values, valid, k):
+            _, key_linf, key_l0 = jax.random.split(k, 3)
+            pk_sent = jnp.where(valid, pk, P).astype(jnp.int32)
+            pid_sent = jnp.where(valid, pid, jnp.iinfo(jnp.int32).max)
+            h0, h1 = executor._pair_hash(pid_sent, pk_sent, key_l0)
+            rand = jax.random.uniform(key_linf, (n,), dtype=jnp.float32)
+            # uint64, not int64: the high field spans the full uint32
+            # range, and (h >= 2^31) << 32 would wrap a signed int64
+            # negative — inverting the order vs the real sort's unsigned
+            # uint32 comparisons.
+            k1 = ((pid_sent.astype(jnp.uint32).astype(jnp.uint64) << 32)
+                  | h0.astype(jnp.uint32).astype(jnp.uint64))
+            k2 = ((h1.astype(jnp.uint32).astype(jnp.uint64) << 32)
+                  | pk_sent.astype(jnp.uint32).astype(jnp.uint64))
+            out = jax.lax.sort((k1, k2, rand, values, valid), num_keys=3)
+            return out[0][0] + out[3][-1]
+
+        @jax.jit
+        def packed4(pid, pk, values, valid, k):
+            # Half-packed: only (h0,h1) -> one i64 hash key.
+            _, key_linf, key_l0 = jax.random.split(k, 3)
+            pk_sent = jnp.where(valid, pk, P).astype(jnp.int32)
+            pid_sent = jnp.where(valid, pid, jnp.iinfo(jnp.int32).max)
+            h0, h1 = executor._pair_hash(pid_sent, pk_sent, key_l0)
+            rand = jax.random.uniform(key_linf, (n,), dtype=jnp.float32)
+            h64 = ((h0.astype(jnp.uint32).astype(jnp.uint64) << 32)
+                   | h1.astype(jnp.uint32).astype(jnp.uint64))
+            out = jax.lax.sort((pid_sent, h64, pk_sent, rand, values, valid),
+                               num_keys=4)
+            return out[0][0] + out[4][-1]
+
+        for name, fn in (("3 keys (pid|h0, h1|pk, rand) i64-packed",
+                          packed3),
+                         ("4 keys (pid, h0|h1 i64, pk, rand)", packed4)):
+            t, _ = timed(fn, pid_, pk_, values_, valid_,
+                         jax.random.fold_in(key, 1))
+            print(f"sort {name}: {t*1e3:.0f} ms", flush=True)
+    finally:
+        jax.config.update("jax_enable_x64", False)
+
+
+time_packed_variants()

@@ -475,6 +475,67 @@ class TestShardedBlockedLargeP:
             assert abs(outputs["percentile_50"][j] -
                        true_median) < 3 * leaf + 0.05
 
+    def test_vector_sum_engine_meshed_blocked(self):
+        # VECTOR_SUM through the meshed blocked route (per-dim scalar
+        # columns ride the pass-1 payload sort; the [C]-block reduce keeps
+        # vector_size).
+        mesh = make_mesh(n_devices=8)
+        rows = [("u%d" % (i % 50), "pk%d" % (i % 3),
+                 np.array([float(i % 5), 1.0])) for i in range(300)]
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.VECTOR_SUM],
+                                     max_partitions_contributed=3,
+                                     max_contributions_per_partition=100,
+                                     vector_norm_kind=pdp.NormKind.Linf,
+                                     vector_max_norm=1000.0,
+                                     vector_size=2)
+        public = ["pk0", "pk1", "pk2"]
+        expected = _aggregate(pdp.LocalBackend(seed=0), rows, params, public)
+        actual = _aggregate(
+            pdp.TPUBackend(mesh=mesh, noise_seed=4,
+                           large_partition_threshold=1), rows, params,
+            public)
+        for pk in public:
+            np.testing.assert_allclose(actual[pk].vector_sum,
+                                       expected[pk].vector_sum, atol=0.1)
+
+    def test_secure_blocked_sharded(self):
+        # Secure snapped release through the MESHED blocked path: outputs
+        # on the secure grid, equal to the single-device blocked secure
+        # outputs' grid, matching the raw aggregate to grid resolution.
+        import dataclasses as dc
+        import jax
+        import jax.numpy as jnp
+        from pipelinedp_tpu import executor
+        from pipelinedp_tpu.ops import secure_noise
+        from pipelinedp_tpu.parallel import large_p
+        mesh = make_mesh(n_devices=4)
+        P = 300
+        cfg, stds, (min_v, max_v, min_s, max_s,
+                    mid), params, compound = self._spec(P, private=False,
+                                                        l0=P, linf=64,
+                                                        eps=1e6, full=True)
+        cfg = dc.replace(cfg, secure=True)
+        sens = executor.compute_noise_sensitivities(compound, params)
+        thr_hi, thr_lo, gran = secure_noise.build_tables(
+            np.asarray(stds), pdp.NoiseKind.LAPLACE, sensitivities=sens)
+        tables = (jnp.asarray(thr_hi), jnp.asarray(thr_lo),
+                  jnp.asarray(gran))
+        rng = np.random.default_rng(6)
+        n = 10_000
+        pid = rng.integers(0, 300, n).astype(np.int32)
+        pk = rng.integers(0, P, n).astype(np.int32)
+        values = rng.uniform(0, 5, n)
+        valid = np.ones(n, bool)
+        kept, outputs = large_p.aggregate_blocked_sharded(
+            mesh, pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+            np.asarray(stds), jax.random.PRNGKey(3), cfg,
+            block_partitions=128, secure_tables=tables)
+        expected = np.bincount(pk, minlength=P)
+        np.testing.assert_allclose(outputs["count"], expected, atol=0.5)
+        g = float(gran[0])
+        ratios = outputs["count"] / g
+        np.testing.assert_allclose(ratios, np.round(ratios), atol=1e-3)
+
     def test_select_partitions_blocked_sharded_matches_single(self):
         # Mesh + blocked standalone selection: kept set must equal the
         # single-device blocked path's at huge eps (deterministic
