@@ -16,6 +16,7 @@ parse_file_chunks streams the same parse in bounded-memory chunks for the
 overlapped ingest pipeline (pipelinedp_tpu.ingest).
 """
 
+import re
 from dataclasses import dataclass
 from typing import Iterator, Optional, Tuple
 
@@ -76,33 +77,119 @@ def parse_file_columns(filename: str) -> Columns:
     return cols
 
 
-def parse_file_chunks(filename: str,
-                      chunk_bytes: int = 1 << 24) -> Iterator[Columns]:
+_HEADER_RE = re.compile(rb"(?m)^\d+:\r?$")
+
+
+def _next_header_offset(filename: str, pos: int,
+                        limit: Optional[int]) -> Optional[int]:
+    """Byte offset of the first 'movie_id:' header line starting at or
+    after `pos` (snapped forward to a line start) and before `limit`
+    (None = end of file). None if no such header exists.
+
+    Chunked binary scan with one regex search per chunk — a single movie
+    section spanning many shards would otherwise cost per-line Python
+    readline loops on exactly the multi-million-row files this path is
+    for.
+    """
+    with open(filename, "rb") as f:
+        if pos > 0:
+            f.seek(pos - 1)
+            if f.read(1) != b"\n":
+                # Snap forward to the next line start, chunked.
+                while True:
+                    chunk = f.read(1 << 16)
+                    if not chunk:
+                        return None
+                    i = chunk.find(b"\n")
+                    if i != -1:
+                        f.seek(f.tell() - (len(chunk) - i - 1))
+                        break
+        carry = b""
+        carry_off = f.tell()
+        while True:
+            buf = f.read(1 << 20)
+            if not buf:
+                # Last line may lack a trailing newline.
+                if carry and _HEADER_RE.fullmatch(carry.rstrip(b"\r")):
+                    if limit is None or carry_off < limit:
+                        return carry_off
+                return None
+            buf = carry + buf
+            cut = buf.rfind(b"\n")
+            if cut == -1:
+                carry = buf
+                continue
+            m = _HEADER_RE.search(buf[:cut + 1])
+            if m:
+                off = carry_off + m.start()
+                if limit is not None and off >= limit:
+                    return None
+                return off
+            carry = buf[cut + 1:]
+            carry_off += cut + 1
+            if limit is not None and carry_off >= limit:
+                return None
+
+
+def parse_file_chunks(
+        filename: str,
+        chunk_bytes: int = 1 << 24,
+        byte_range: Optional[Tuple[int, int]] = None) -> Iterator[Columns]:
     """Streams (user_ids, movie_ids, ratings) column chunks from a
     Netflix-format file in bounded memory.
 
     Chunks split at line boundaries; the current movie header carries
     across chunks, so concatenating all chunks equals parse_file_columns.
+
+    byte_range=(lo, hi) parses one HOST SHARD for multi-process ingest
+    (ingest.encode_shard): the shard owns every movie section whose
+    header line STARTS in [lo, hi) — it skips leading rating lines
+    (they belong to the previous shard's last section) and reads past
+    `hi` to the end of its own last section. Concatenating the shards
+    of a contiguous cover of the file equals the whole-file parse, with
+    every line parsed exactly once.
     """
+    start_off, end_off = 0, None
+    if byte_range is not None:
+        lo, hi = byte_range
+        start_off = _next_header_offset(filename, lo, hi)
+        if start_off is None:
+            return  # no section starts in this shard
+        end_off = _next_header_offset(filename, hi, None)
     last_movie: Optional[int] = None
-    carry = ""
-    with open(filename) as f:
+    carry = b""
+    # Binary reads throughout: the range offsets come from the binary
+    # header probe, and text-mode universal-newline translation would
+    # make len(buf) undercount CRLF files against those byte offsets.
+    with open(filename, "rb") as f:
+        f.seek(start_off)
+        remaining = None if end_off is None else end_off - start_off
         while True:
-            buf = f.read(chunk_bytes)
+            to_read = (chunk_bytes if remaining is None else min(
+                chunk_bytes, remaining))
+            if to_read <= 0:
+                break
+            buf = f.read(to_read)
             if not buf:
                 break
+            if remaining is not None:
+                remaining -= len(buf)
             buf = carry + buf
-            cut = buf.rfind("\n")
+            cut = buf.rfind(b"\n")
             if cut == -1:
                 carry = buf
                 continue
             carry = buf[cut + 1:]
-            cols, last_movie = _parse_lines(np.array(buf[:cut].split("\n")),
+            # Decoding after the cut at a newline keeps multi-byte UTF-8
+            # sequences intact (no continuation byte equals \n).
+            text = buf[:cut].decode().replace("\r", "")
+            cols, last_movie = _parse_lines(np.array(text.split("\n")),
                                             last_movie, filename)
             if cols is not None:
                 yield cols
     if carry:
-        cols, last_movie = _parse_lines(np.array([carry]), last_movie,
+        text = carry.decode().replace("\r", "")
+        cols, last_movie = _parse_lines(np.array([text]), last_movie,
                                         filename)
         if cols is not None:
             yield cols

@@ -366,7 +366,13 @@ def aggregate_blocked_sharded(mesh,
     no collectives), and each partition block costs exactly one [C]-sized
     psum over ICI before replicated selection/noise. Dense [P] state never
     exists on any device, host traffic stays O(kept), and per-device HBM
-    holds O(rows/D + C).
+    holds O(rows/D + C) — the mesh extends the single-device row capacity
+    D-fold before any host staging is needed.
+
+    Device-resident (streamed-ingest) columns are accepted but staged
+    through the host once: the pid-balanced reshard
+    (sharded.shard_rows_by_pid) is a host-side permutation. Keeping the
+    reshard on-device (all_to_all over ICI) is the on-pod upgrade path.
 
     Returns (kept_partition_ids int64[M], {metric: f[M]}) — identical
     contract to aggregate_blocked.

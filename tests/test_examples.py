@@ -26,6 +26,10 @@ EXAMPLES = [
     ["examples/restaurant_visits/run_private_api.py", "--rows", "1000"],
     ["examples/restaurant_visits/run_parameter_tuning.py", "--rows", "1000"],
     ["examples/codelab/codelab.py"],
+    [
+        "examples/movie_view_ratings/run_multihost_ingest.py",
+        "--generate_rows", "5000", "--hosts", "3"
+    ],
 ]
 
 

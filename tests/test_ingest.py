@@ -198,6 +198,72 @@ class TestNetflixChunkedParse:
         np.testing.assert_array_equal(
             np.concatenate([c[2] for c in chunks]), ratings)
 
+    @pytest.mark.parametrize("n_shards", [1, 2, 3, 7])
+    def test_byte_range_shards_cover_file_exactly(self, tmp_path, n_shards):
+        # Host-shard semantics: a shard owns every movie section whose
+        # header starts in its byte range; concatenating a contiguous
+        # cover equals the whole-file parse, each line exactly once.
+        path = str(tmp_path / "views.txt")
+        netflix_format.generate_file(path, 5000, n_users=80, n_movies=60,
+                                     seed=9)
+        users, movies, ratings = netflix_format.parse_file_columns(path)
+        size = os.path.getsize(path)
+        per = -(-size // n_shards)
+        got_u, got_m, got_r = [], [], []
+        for h in range(n_shards):
+            for u, m, r in netflix_format.parse_file_chunks(
+                    path, chunk_bytes=997,
+                    byte_range=(h * per, min((h + 1) * per, size))):
+                got_u.append(u)
+                got_m.append(m)
+                got_r.append(r)
+        np.testing.assert_array_equal(np.concatenate(got_u), users)
+        np.testing.assert_array_equal(np.concatenate(got_m), movies)
+        np.testing.assert_array_equal(np.concatenate(got_r), ratings)
+
+    def test_byte_range_shards_crlf_file(self, tmp_path):
+        # CRLF files: the binary byte accounting of the sharded reader
+        # must line up with the binary header-probe offsets (text-mode
+        # newline translation would undercount by one byte per line).
+        path = str(tmp_path / "views_crlf.txt")
+        lf = str(tmp_path / "views_lf.txt")
+        netflix_format.generate_file(lf, 3000, n_users=50, n_movies=40,
+                                     seed=3)
+        with open(lf, "rb") as f:
+            data = f.read()
+        with open(path, "wb") as f:
+            f.write(data.replace(b"\n", b"\r\n"))
+        users, movies, ratings = netflix_format.parse_file_columns(lf)
+        size = os.path.getsize(path)
+        per = -(-size // 3)
+        got_u, got_m = [], []
+        for h in range(3):
+            for u, m, _ in netflix_format.parse_file_chunks(
+                    path, chunk_bytes=997,
+                    byte_range=(h * per, min((h + 1) * per, size))):
+                got_u.append(u)
+                got_m.append(m)
+        np.testing.assert_array_equal(np.concatenate(got_u), users)
+        np.testing.assert_array_equal(np.concatenate(got_m), movies)
+
+    def test_byte_range_shard_without_headers_is_empty(self, tmp_path):
+        # A byte range holding only rating lines of an earlier section
+        # yields nothing (and must not raise the no-header error).
+        path = str(tmp_path / "views.txt")
+        with open(path, "w") as f:
+            f.write("7:\n" + "".join(f"{u},3,2020-01-01\n"
+                                     for u in range(200)))
+        mid = os.path.getsize(path) // 2
+        out = list(
+            netflix_format.parse_file_chunks(path, byte_range=(mid,
+                                                               mid + 10)))
+        assert out == []
+        # And the owning shard (containing the header) reads to EOF.
+        total = sum(
+            len(u) for u, _, _ in netflix_format.parse_file_chunks(
+                path, byte_range=(0, mid)))
+        assert total == 200
+
     def test_generated_file_roundtrip(self, tmp_path):
         path = str(tmp_path / "views.txt")
         netflix_format.generate_file(path, 500, n_users=20, n_movies=10,

@@ -475,6 +475,36 @@ class TestShardedBlockedLargeP:
             assert abs(outputs["percentile_50"][j] -
                        true_median) < 3 * leaf + 0.05
 
+    def test_streamed_ingest_through_meshed_blocked(self):
+        # Device-resident EncodedData (streamed ingest) through the
+        # meshed blocked engine route: columns are staged through the
+        # host for the pid reshard and the result must match the
+        # row-input LocalBackend path.
+        from pipelinedp_tpu import ingest
+        rows = ROWS
+        chunks = [(np.array([r[0] for r in rows[i:i + 300]], object),
+                   np.array([r[1] for r in rows[i:i + 300]], object),
+                   np.array([r[2] for r in rows[i:i + 300]]))
+                  for i in range(0, len(rows), 300)]
+        encoded = ingest.stream_encode_columns(iter(chunks))
+        mesh = make_mesh(n_devices=8)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT,
+                                              pdp.Metrics.SUM],
+                                     max_partitions_contributed=7,
+                                     max_contributions_per_partition=30,
+                                     min_value=0.0,
+                                     max_value=5.0)
+        expected = _aggregate(pdp.LocalBackend(seed=0), rows, params)
+        actual = _aggregate(
+            pdp.TPUBackend(mesh=mesh, noise_seed=0,
+                           large_partition_threshold=4), encoded, params)
+        assert set(actual) == set(expected)
+        for pk in expected:
+            assert actual[pk].count == pytest.approx(expected[pk].count,
+                                                     abs=0.05)
+            assert actual[pk].sum == pytest.approx(expected[pk].sum,
+                                                   abs=0.05)
+
     def test_vector_sum_engine_meshed_blocked(self):
         # VECTOR_SUM through the meshed blocked route (per-dim scalar
         # columns ride the pass-1 payload sort; the [C]-block reduce keeps
