@@ -215,6 +215,42 @@ def _bench_large_p(jax, on_tpu):
     }
 
 
+def _bench_select_partitions(jax, on_tpu):
+    """Standalone DP partition selection at P = 10^7 via the O(kept)
+    blocked route (parallel/large_p.select_partitions_blocked): neither a
+    dense count vector nor a bool[P] keep vector exists on device or
+    host."""
+    from benchmarks import _common
+    from pipelinedp_tpu.ops import selection_ops
+    from pipelinedp_tpu.parallel import large_p
+
+    P = 10_000_000
+    n = 2**22 if on_tpu else 2**18
+    params, _, _, _ = _common.build_spec(P)
+    selection = selection_ops.selection_params_from_host(
+        params.partition_selection_strategy, 1.0, 1e-6,
+        params.max_partitions_contributed, None)
+    pid, pk, _, valid = _common.zipfish_data(n, P)
+
+    def run(seed):
+        return large_p.select_partitions_blocked(
+            pid, pk, valid, jax.random.PRNGKey(seed),
+            params.max_partitions_contributed, P, selection,
+            block_partitions=1 << 20)
+
+    run(8)  # warm the pass-1 + block kernels
+    start = time.perf_counter()
+    kept = run(9)
+    elapsed = time.perf_counter() - start
+    return {
+        "select_partitions_p": P,
+        "select_partitions_rows": n,
+        "select_partitions_sec": round(elapsed, 3),
+        "select_partitions_rows_per_sec": round(n / elapsed),
+        "select_partitions_kept": int(len(kept)),
+    }
+
+
 def _bench_end_to_end(on_tpu):
     """File -> DP result on the Netflix-format path: chunked parse ->
     incremental factorize -> overlapped upload (pipelinedp_tpu.ingest) ->
@@ -431,6 +467,9 @@ def main():
     # --- 10^7-partition blocked aggregation (bounded memory). ---
     large_p_detail = _bench_large_p(jax, on_tpu)
 
+    # --- 10^7-partition standalone selection, O(kept) transfers. ---
+    select_detail = _bench_select_partitions(jax, on_tpu)
+
     # Noise-distribution fidelity: KS statistic of 1M device noise draws
     # vs the CPU reference distribution at the same calibrated stddev
     # (BASELINE.json metric "noise-dist KS-stat vs CPU ref").
@@ -465,6 +504,7 @@ def main():
                 **ingest_detail,
                 **e2e_detail,
                 **large_p_detail,
+                **select_detail,
                 **({"device_fallback": fallback} if fallback else {}),
             },
         }))
