@@ -221,15 +221,12 @@ def _bench_select_partitions(jax, on_tpu):
     dense count vector nor a bool[P] keep vector exists on device or
     host."""
     from benchmarks import _common
-    from pipelinedp_tpu.ops import selection_ops
     from pipelinedp_tpu.parallel import large_p
 
     P = 10_000_000
     n = 2**22 if on_tpu else 2**18
     params, _, _, _ = _common.build_spec(P)
-    selection = selection_ops.selection_params_from_host(
-        params.partition_selection_strategy, 1.0, 1e-6,
-        params.max_partitions_contributed, None)
+    selection = _common.build_selection(params)
     pid, pk, _, valid = _common.zipfish_data(n, P)
 
     def run(seed):

@@ -51,6 +51,15 @@ def build_spec(n_partitions, metrics=None, l0=4, linf=8, eps=1.0):
     return params, cfg, stds, executor.kernel_scalars(params)
 
 
+def build_selection(params, eps=1.0, delta=1e-6):
+    """Standalone-selection spec (whole budget on selection) shared by
+    bench.py and bench_large_p.py so their kept counts stay comparable."""
+    from pipelinedp_tpu.ops import selection_ops
+    return selection_ops.selection_params_from_host(
+        params.partition_selection_strategy, eps, delta,
+        params.max_partitions_contributed, None)
+
+
 def zipfish_data(n, n_partitions, n_users=1_000_000, power=6.0, seed=5):
     """Host columnar data with exponentially-tilted partition popularity.
 

@@ -39,12 +39,8 @@ print(f"timed kept: {len(kept)}  {t1-t0:.3f}s  "
       f"{n/(t1-t0)/1e3:.0f}K rows/s", flush=True)
 
 # --- Standalone selection at the same P: O(kept) host transfer. -----------
-from pipelinedp_tpu.ops import selection_ops  # noqa: E402
-
 params, _, _, _ = _common.build_spec(P)
-selection = selection_ops.selection_params_from_host(
-    params.partition_selection_strategy, 1.0, 1e-6,
-    params.max_partitions_contributed, None)
+selection = _common.build_selection(params)
 
 
 def run_select(seed):

@@ -23,8 +23,17 @@ VOCAB = [f"pk{i}" for i in range(6)]
 MAX_PARTITIONS = 8
 
 
-def run_tpu(rows, params, public):
-    backend = pdp.TPUBackend(noise_seed=7, max_partitions=MAX_PARTITIONS)
+# Backend variants the properties run against: the dense fused kernel
+# and the blocked partition-axis route (threshold below the partition
+# count). Same assertions, so the two paths cannot silently diverge in
+# what is verified.
+BACKEND_VARIANTS = [{}, {"large_partition_threshold": 4}]
+BACKEND_IDS = ["dense", "blocked"]
+
+
+def run_tpu(rows, params, public, backend_kwargs=None):
+    backend = pdp.TPUBackend(noise_seed=7, max_partitions=MAX_PARTITIONS,
+                             **(backend_kwargs or {}))
     accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
                                            total_delta=1e-5)
     engine = pdp.DPEngine(accountant, backend)
@@ -76,9 +85,11 @@ def unbounded_dataset(draw):
     return l0, linf, rows
 
 
-@settings(max_examples=25, deadline=None)
+@pytest.mark.parametrize("backend_kwargs", BACKEND_VARIANTS,
+                         ids=BACKEND_IDS)
+@settings(max_examples=20, deadline=None)
 @given(bounded_dataset())
-def test_bounded_data_matches_brute_force(data):
+def test_bounded_data_matches_brute_force(backend_kwargs, data):
     l0, linf, rows = data
     min_v, max_v = -5.0, 5.0
     params = pdp.AggregateParams(
@@ -89,7 +100,8 @@ def test_bounded_data_matches_brute_force(data):
         max_contributions_per_partition=linf,
         min_value=min_v,
         max_value=max_v)
-    result = run_tpu(rows, params, public=VOCAB)
+    result = run_tpu(rows, params, public=VOCAB,
+                     backend_kwargs=backend_kwargs)
 
     assert set(result) == set(VOCAB)
     for pk in VOCAB:
@@ -102,9 +114,11 @@ def test_bounded_data_matches_brute_force(data):
         assert result[pk].privacy_id_count == pytest.approx(users, abs=0.01)
 
 
-@settings(max_examples=25, deadline=None)
+@pytest.mark.parametrize("backend_kwargs", BACKEND_VARIANTS,
+                         ids=BACKEND_IDS)
+@settings(max_examples=20, deadline=None)
 @given(unbounded_dataset())
-def test_unbounded_data_respects_caps(data):
+def test_unbounded_data_respects_caps(backend_kwargs, data):
     l0, linf, rows = data
     params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
                                  noise_kind=pdp.NoiseKind.LAPLACE,
@@ -112,7 +126,8 @@ def test_unbounded_data_respects_caps(data):
                                  max_contributions_per_partition=linf,
                                  min_value=0.0,
                                  max_value=9.0)
-    result = run_tpu(rows, params, public=VOCAB)
+    result = run_tpu(rows, params, public=VOCAB,
+                     backend_kwargs=backend_kwargs)
 
     n_users = len({u for u, _, _ in rows})
     total_count = sum(result[pk].count for pk in VOCAB)
