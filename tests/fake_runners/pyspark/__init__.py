@@ -3,9 +3,9 @@
 pyspark cannot be installed in this environment; this module implements the
 RDD/SparkContext surface pipelinedp_tpu's SparkRDDBackend and private_spark
 adapters use, executing eagerly over Python lists — local[1] without the
-JVM. groupByKey values are one-shot iterables (like Spark's ResultIterable
-consumers must list() them), join has inner-join semantics, and union
-concatenates.
+JVM. groupByKey values are re-iterable ResultIterables (mirroring
+pyspark.resultiterable — list-backed, so len() works, unlike Beam's lazy
+iterables), join has inner-join semantics, and union concatenates.
 
 Worker-boundary fidelity: every closure handed to a transformation is
 shipped through cloudpickle (PySpark's own closure serializer) when the
