@@ -475,6 +475,41 @@ class TestShardedBlockedLargeP:
             assert abs(outputs["percentile_50"][j] -
                        true_median) < 3 * leaf + 0.05
 
+    def test_exact_parity_when_l0_not_binding(self):
+        # Whole-path equivalence at probabilistic eps: when L0 sampling
+        # never binds (the only per-shard randomness), per-partition
+        # counts are identical across paths, so the shared per-block
+        # selection keys must give the EXACT same kept set, counts and
+        # sums — even where individual keep decisions are coin flips.
+        # (Multi-block with skipped empty blocks; the same property was
+        # hand-verified at P=10^7 — scale does not change it.)
+        import jax
+        from pipelinedp_tpu.parallel import large_p
+        mesh = make_mesh(n_devices=8)
+        P = 100_000
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = self._spec(
+            P, l0=64, linf=8, eps=30)
+        stds = np.zeros_like(np.asarray(stds))
+        rng = np.random.default_rng(1)
+        n = 50_000
+        pid = rng.integers(0, 10_000, n).astype(np.int64)
+        pk = (np.power(rng.random(n), 6.0) * P).astype(np.int32)
+        valid = np.ones(n, bool)
+        values = rng.uniform(0, 5, n)
+        key = jax.random.PRNGKey(2)
+        kept, outputs = large_p.aggregate_blocked_sharded(
+            mesh, pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+            stds, key, cfg, block_partitions=1 << 14)
+        ref_kept, ref_out = large_p.aggregate_blocked(
+            pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, stds,
+            key, cfg, block_partitions=1 << 14)
+        assert len(kept) > 0
+        assert np.array_equal(kept, ref_kept)
+        np.testing.assert_allclose(outputs["count"], ref_out["count"],
+                                   atol=1e-3)
+        np.testing.assert_allclose(outputs["sum"], ref_out["sum"],
+                                   rtol=1e-4)
+
     def test_streamed_ingest_through_meshed_blocked(self):
         # Device-resident EncodedData (streamed ingest) through the
         # meshed blocked engine route: columns are staged through the
