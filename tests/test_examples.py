@@ -30,6 +30,7 @@ EXAMPLES = [
         "examples/movie_view_ratings/run_multihost_ingest.py",
         "--generate_rows", "5000", "--hosts", "3"
     ],
+    ["examples/experimental/custom_combiners.py", "--generate_rows", "5000"],
 ]
 
 
@@ -49,7 +50,14 @@ FRAMEWORK_EXAMPLES = [
         "examples/movie_view_ratings/run_on_spark.py", "--generate_rows",
         "5000"
     ],
+    ["examples/experimental/beam_combine_fn.py", "--generate_rows", "5000"],
 ]
+
+# Success marker each framework script prints (default: the shared
+# count+sum line of the movie_view_ratings scripts).
+FRAMEWORK_MARKERS = {
+    "examples/experimental/beam_combine_fn.py": "movies; first 3:",
+}
 
 
 @pytest.mark.parametrize("cmd", FRAMEWORK_EXAMPLES, ids=lambda c: c[0])
@@ -60,7 +68,8 @@ def test_framework_example_runs(cmd):
     proc = subprocess.run([sys.executable] + cmd, cwd=REPO, env=env,
                           capture_output=True, text=True, timeout=300)
     assert proc.returncode == 0, proc.stderr[-2000:]
-    assert "computed DP count+sum" in proc.stdout
+    marker = FRAMEWORK_MARKERS.get(cmd[0], "computed DP count+sum")
+    assert marker in proc.stdout
 
 
 def _accelerator_platform():
