@@ -178,6 +178,32 @@ class TestAggregatePublicPartitions:
         total = result["A"].count + result["B"].count
         assert total == pytest.approx(4, abs=0.05)
 
+    def test_max_contributions_total_bound_blocked_routes(self):
+        # The total per-user bound must hold through the blocked large-P
+        # route too (single-device and meshed): _bound_compact_trace runs
+        # the same bounded_row_columns total-bound pass.
+        from pipelinedp_tpu.parallel import make_mesh
+        rows = [("u1", "A", 1.0)] * 6 + [("u1", "B", 1.0)] * 6
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                     max_contributions=4)
+        for backend in (
+                pdp.TPUBackend(noise_seed=1, large_partition_threshold=1),
+                pdp.TPUBackend(noise_seed=1, large_partition_threshold=1,
+                               mesh=make_mesh()),
+        ):
+            accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                                   total_delta=1e-5)
+            engine = pdp.DPEngine(accountant, backend)
+            extractors = pdp.DataExtractors(
+                privacy_id_extractor=lambda r: r[0],
+                partition_extractor=lambda r: r[1],
+                value_extractor=lambda r: r[2])
+            result = engine.aggregate(rows, params, extractors, ["A", "B"])
+            accountant.compute_budgets()
+            result = dict(result)
+            total = result["A"].count + result["B"].count
+            assert total == pytest.approx(4, abs=0.05)
+
     @pytest.mark.parametrize("backend_name", BACKENDS)
     def test_contribution_bounds_already_enforced(self, backend_name):
         rows = [("A", 1.0), ("A", 2.0), ("B", 3.0)]

@@ -475,6 +475,28 @@ class TestShardedBlockedLargeP:
             assert abs(outputs["percentile_50"][j] -
                        true_median) < 3 * leaf + 0.05
 
+    def test_mean_variance_engine_meshed_blocked(self):
+        # MEAN/VARIANCE children (count+sum+sum-of-squares columns) through
+        # the meshed blocked route vs LocalBackend at huge eps.
+        mesh = make_mesh(n_devices=8)
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.MEAN,
+                                              pdp.Metrics.VARIANCE],
+                                     max_partitions_contributed=7,
+                                     max_contributions_per_partition=30,
+                                     min_value=0.0,
+                                     max_value=5.0)
+        public = ["pk%d" % i for i in range(7)]
+        expected = _aggregate(pdp.LocalBackend(seed=0), ROWS, params, public)
+        actual = _aggregate(
+            pdp.TPUBackend(mesh=mesh, noise_seed=3,
+                           large_partition_threshold=4), ROWS, params,
+            public)
+        for pk in expected:
+            assert actual[pk].mean == pytest.approx(expected[pk].mean,
+                                                    abs=0.01)
+            assert actual[pk].variance == pytest.approx(
+                expected[pk].variance, abs=0.05)
+
     def test_exact_parity_when_l0_not_binding(self):
         # Whole-path equivalence at probabilistic eps: when L0 sampling
         # never binds (the only per-shard randomness), per-partition
