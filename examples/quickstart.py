@@ -1,0 +1,69 @@
+"""Quickstart: DP visit counts per weekday with the core DPEngine API.
+
+Runnable counterpart of the reference's examples/quickstart.ipynb: a week
+of simulated restaurant visits (visitor id, day, money spent), DP count of
+visits per day via the core API, printed side by side with the raw counts
+so the noise and the partition-selection behavior are visible.
+
+    python examples/quickstart.py [--rows 5000] [--epsilon 1.0] [--local]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import pipelinedp_tpu as pdp
+from examples import synthetic_data
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rows", type=int, default=5_000)
+    parser.add_argument("--epsilon", type=float, default=1.0)
+    parser.add_argument("--local", action="store_true",
+                        help="reference-style pure-Python backend (no jax "
+                        "device init)")
+    args = parser.parse_args()
+
+    visits = synthetic_data.generate_restaurant_visits(args.rows)
+
+    # The backend: TPUBackend lowers the whole aggregation to one fused
+    # device program; --local runs the reference-style Python path.
+    backend = pdp.LocalBackend() if args.local else pdp.TPUBackend()
+    budget_accountant = pdp.NaiveBudgetAccountant(
+        total_epsilon=args.epsilon, total_delta=1e-6)
+    dp_engine = pdp.DPEngine(budget_accountant, backend)
+
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=3,  # a visitor counts in <= 3 days
+        max_contributions_per_partition=2)  # <= 2 visits per day
+    data_extractors = pdp.DataExtractors(
+        privacy_id_extractor=lambda v: v.user_id,
+        partition_extractor=lambda v: v.day,
+        value_extractor=lambda v: 0)
+
+    dp_result = dp_engine.aggregate(visits, params, data_extractors)
+    budget_accountant.compute_budgets()  # ALWAYS before reading results
+    dp_counts = {day: m.count for day, m in dp_result}
+
+    raw_counts = {}
+    for v in visits:
+        raw_counts[v.day] = raw_counts.get(v.day, 0) + 1
+
+    print(f"{'day':>4} {'raw':>7} {'dp':>9}")
+    for day in sorted(raw_counts):
+        dp = f"{dp_counts[day]:9.1f}" if day in dp_counts else "  dropped"
+        print(f"{day:>4} {raw_counts[day]:>7} {dp}")
+    print("(dp < raw mostly reflects contribution bounding: each visitor "
+          "counts in at most "
+          f"{params.max_partitions_contributed} days x "
+          f"{params.max_contributions_per_partition} visits)")
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,7 @@ EXAMPLES = [
         "--generate_rows", "5000", "--hosts", "3"
     ],
     ["examples/experimental/custom_combiners.py", "--generate_rows", "5000"],
+    ["examples/quickstart.py", "--rows", "2000"],
 ]
 
 
