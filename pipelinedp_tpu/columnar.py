@@ -68,14 +68,39 @@ def _as_key_array(x) -> np.ndarray:
     return arr
 
 
+_NAN_KEY = object()  # canonical dict key for NaN (NaN != NaN breaks lookup)
+
+
+def _canonical_key(key):
+    """NaN keys canonicalize to one sentinel: every float('nan') object is
+    distinct under ==, so a raw dict would give each its own code."""
+    try:
+        if key != key:  # NaN is the only self-unequal value
+            return _NAN_KEY
+    except Exception:  # exotic __ne__ — ordinary key
+        pass
+    return key
+
+
+def _object_array_has_nan(raw: np.ndarray) -> bool:
+    for key in raw:
+        try:
+            if key != key:
+                return True
+        except Exception:
+            pass
+    return False
+
+
 def factorize(raw: np.ndarray) -> Tuple[np.ndarray, Sequence[Any]]:
     """First-occurrence-order integer encoding of a key column (C speed).
 
     Returns (codes int32[n], vocabulary array). None/NaN are ordinary keys
     (use_na_sentinel=False) — a None partition key forms a partition, same
-    as any dict-based grouping would. Falls back to np.unique (sorted
-    vocabulary order — equally valid, ids are internal), and to a Python
-    dict loop for key types neither library can handle.
+    as any dict-based grouping would; all NaN keys share ONE code on every
+    path. Falls back to np.unique (sorted vocabulary order — equally
+    valid, ids are internal), and to a Python dict loop for key types
+    neither library can handle.
     """
     if _pd is not None:
         codes, uniques = _pd.factorize(raw, use_na_sentinel=False)
@@ -92,13 +117,28 @@ def factorize(raw: np.ndarray) -> Tuple[np.ndarray, Sequence[Any]]:
             return codes, raw[first_rows]
     try:
         uniques, inverse = np.unique(raw, return_inverse=True)
+        if raw.dtype.hasobject and _object_array_has_nan(uniques):
+            # np.unique's sort-adjacency dedup breaks when NaN sits among
+            # object keys (NaN comparisons scramble the sort, so equal
+            # regular keys can land non-adjacent and get TWO codes). Any
+            # NaN in raw survives into uniques (it never equals its sort
+            # neighbor), so scanning the small uniques array suffices.
+            raise TypeError("NaN among object keys")
         return inverse.astype(np.int32), uniques
-    except TypeError:  # unorderable mixed-type keys
+    except TypeError:  # unorderable mixed-type keys (or object NaN)
         vocab: dict = {}
+        first_keys = []
         codes = np.empty(len(raw), dtype=np.int32)
         for i, key in enumerate(raw):
-            codes[i] = vocab.setdefault(key, len(vocab))
-        return codes, np.fromiter(vocab, dtype=object, count=len(vocab))
+            canon = _canonical_key(key)
+            code = vocab.setdefault(canon, len(vocab))
+            if code == len(first_keys):
+                first_keys.append(key)  # original object, incl. real NaN
+            codes[i] = code
+        out = np.empty(len(first_keys), dtype=object)
+        for j, key in enumerate(first_keys):
+            out[j] = key  # per-element: composite keys stay one object
+        return codes, out
 
 
 def encode_with_vocab(raw: np.ndarray, vocab: Sequence[Any]) -> np.ndarray:

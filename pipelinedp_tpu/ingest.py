@@ -35,19 +35,10 @@ try:
 except ImportError:  # pragma: no cover - pandas is in the standard image
     _pd = None
 
-_NAN_KEY = object()  # canonical dict key for NaN (NaN != NaN breaks lookup)
-
-
-def _dict_key(key):
-    """Canonicalizes NaN to a shared sentinel for the dict fallback: every
-    float('nan') object is distinct under ==, so raw NaN keys would each
-    get their own code."""
-    try:
-        if key != key:  # NaN is the only self-unequal value
-            return _NAN_KEY
-    except Exception:  # exotic __ne__ — treat as an ordinary key
-        pass
-    return key
+# Shared NaN canonicalization (columnar.factorize's dict fallback uses the
+# same sentinel, so spilled state and chunk factorization agree).
+_NAN_KEY = columnar._NAN_KEY
+_dict_key = columnar._canonical_key
 
 
 def _kind_group(dtype) -> str:
@@ -72,8 +63,8 @@ class ChunkedVocabEncoder:
     (C speed) followed by a vectorized remap of the chunk's uniques
     against a sorted copy of the vocabulary (searchsorted + insert,
     O(V + new·log new)). Only key types numpy cannot order fall back to
-    a per-unique dict loop, which — like columnar.factorize's own
-    last-resort branch — treats each NaN object individually.
+    a per-unique dict loop, which canonicalizes NaN through the same
+    shared sentinel columnar.factorize's last-resort branch uses.
     """
 
     def __init__(self):
@@ -171,8 +162,8 @@ class ChunkedVocabEncoder:
             remap[reg_idx[found]] = self._sorted_codes[pos_c[found]]
         # New codes in first-occurrence order of the chunk (uniques are
         # already ordered that way) = the order a global factorize would
-        # meet them. Duplicate NaN uniques (possible only from
-        # factorize's last-resort branch) alias to one representative.
+        # meet them. Duplicate NaN uniques (factorize now unifies NaN on
+        # every branch, so this is defensive) alias to one representative.
         assign_new = ~known
         nan_is_new = bool(len(nan_idx)) and self._nan_code is None
         if nan_is_new:

@@ -594,3 +594,45 @@ class TestMultiHostIngest:
             return {k: round(v.count, 2) for k, v in dict(result).items()}
 
         assert agg(merged) == agg(rows)
+
+
+class TestChunkedEncoderProperty:
+    """Hypothesis: the no-pandas fallback encoder matches a global pandas
+    factorize for ANY chunking over mixed key types (strings, ints,
+    floats, NaN, tuples) — the contract every round-5 edge fix defends."""
+
+    KEY_POOL = [
+        "a", "bb", "ccc", "hello", "zz9", 1, 2, 37, 1.5, 2.5, 2.0,
+        float("nan"), ("t", 1), ("t", 2)
+    ]
+
+    def test_random_chunkings_match_global_factorize(self):
+        from hypothesis import given, settings, strategies as st
+
+        @settings(max_examples=60, deadline=None)
+        @given(
+            st.lists(st.sampled_from(self.KEY_POOL), min_size=1,
+                     max_size=60), st.integers(1, 7))
+        def run(keys, chunk):
+            arr = columnar._as_key_array(keys)
+            expected, expected_vocab = columnar.factorize(arr)  # pandas
+            saved = ingest._pd, columnar._pd
+            ingest._pd = columnar._pd = None
+            try:
+                enc = ingest.ChunkedVocabEncoder()
+                got = np.concatenate([
+                    enc.encode(keys[i:i + chunk])
+                    for i in range(0, len(keys), chunk)
+                ])
+                vocab = enc.vocabulary
+            finally:
+                ingest._pd, columnar._pd = saved
+            np.testing.assert_array_equal(got, expected)
+            assert len(vocab) == len(expected_vocab)
+            for a, b in zip(vocab, expected_vocab):
+                if isinstance(a, float) and np.isnan(a):
+                    assert isinstance(b, float) and np.isnan(b)
+                else:
+                    assert a == b, (a, b)
+
+        run()

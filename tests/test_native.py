@@ -256,6 +256,18 @@ class TestVocabEncode:
         # first-occurrence order preserved (native path, not sorted unique)
         assert vocab[codes[0]] == arr[0]
 
+    def test_factorize_object_array_with_nan(self, monkeypatch):
+        # np.unique's sort-adjacency dedup breaks when NaN sits among
+        # object keys (equal regular keys can land non-adjacent and get
+        # TWO codes); factorize must detect this and take the dict path,
+        # with all NaN keys sharing one code.
+        from pipelinedp_tpu import columnar
+        monkeypatch.setattr(columnar, "_pd", None)
+        arr = columnar._as_key_array([1, float("nan"), 1, np.nan, 2])
+        codes, vocab = columnar.factorize(arr)
+        np.testing.assert_array_equal(codes, [0, 1, 0, 1, 2])
+        assert vocab[0] == 1 and np.isnan(vocab[1]) and vocab[2] == 2
+
     def test_negative_zero_unified(self):
         from pipelinedp_tpu import native
         if not native.available():
