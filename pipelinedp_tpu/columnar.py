@@ -83,13 +83,7 @@ def _canonical_key(key):
 
 
 def _object_array_has_nan(raw: np.ndarray) -> bool:
-    for key in raw:
-        try:
-            if key != key:
-                return True
-        except Exception:
-            pass
-    return False
+    return any(_canonical_key(key) is _NAN_KEY for key in raw)
 
 
 def factorize(raw: np.ndarray) -> Tuple[np.ndarray, Sequence[Any]]:

@@ -280,6 +280,24 @@ class TestSecureEngineEndToEnd:
             assert got[pk].percentile_50 == pytest.approx(
                 expected[pk].percentile_50, abs=0.2)
 
+    def test_secure_percentile_blocked_routes(self):
+        # Secure snapped PERCENTILE through the blocked large-P route,
+        # single-device and meshed (per-block quantile trees + secure
+        # tables through _block_trace).
+        from pipelinedp_tpu.parallel import make_mesh
+        expected = self._run_percentile(pdp.LocalBackend(seed=0))
+        for backend in (
+                pdp.TPUBackend(noise_seed=0, secure_noise=True,
+                               large_partition_threshold=2),
+                pdp.TPUBackend(mesh=make_mesh(n_devices=4), noise_seed=0,
+                               secure_noise=True,
+                               large_partition_threshold=2),
+        ):
+            got = self._run_percentile(backend)
+            for pk in expected:
+                assert got[pk].percentile_50 == pytest.approx(
+                    expected[pk].percentile_50, abs=0.2)
+
     def test_secure_percentile_noise_is_calibrated(self):
         # At a real budget the released median must be unbiased around the
         # non-secure release (same per-level std; only the sampler differs).
