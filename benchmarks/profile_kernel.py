@@ -14,7 +14,6 @@ _common.path_setup()
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
 
 from pipelinedp_tpu import executor  # noqa: E402
 
@@ -66,14 +65,17 @@ def sort_only(pid, pk, values, valid, k):
     return spid[0] + spk[-1]
 
 
+_sync = _common.sync_fetch  # one-element host fetch; see its docstring
+
+
 def timed(fn, *args, reps=3):
     out = fn(*args)
-    jax.block_until_ready(out)
+    _sync(out)
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
         out = fn(*args)
-        jax.block_until_ready(out)
+        _sync(out)
         ts.append(time.perf_counter() - t0)
     return min(ts), out
 
@@ -120,7 +122,15 @@ def scans_cost(values, pk):
 
 
 data = make(key)
-jax.block_until_ready(data)
+_sync(data)
+
+# Null baseline: dispatch + scalar-fetch round trip with no real compute.
+# Subtract this mentally from every number below; over the tunnel it is
+# dominated by RTT and can swamp sub-100 ms phases.
+_null = jax.jit(lambda x: x[0] + 1.0)
+t_null, _ = timed(_null, data[2])
+print(f"null dispatch+fetch round trip: {t_null*1e3:.1f} ms", flush=True)
+
 t_bound, bound = timed(phase_bound, *data, jax.random.fold_in(key, 1))
 t_reduce, dense = timed(phase_reduce, *bound)
 t_final, _ = timed(phase_finalize, dense, jax.random.fold_in(key, 2))

@@ -775,7 +775,12 @@ def aggregate_blocked(pid,
         if leaf_all is not None:
             leaf_all = jnp.asarray(leaf_all)
     if profiling:
-        jax.block_until_ready(spk_all)
+        # Not block_until_ready: it is a no-op on some remote platforms
+        # (the tunneled axon TPU), which would shift pass-1 tail cost
+        # into the block_offsets bucket. A one-element host fetch proves
+        # the stream and all its producers finished.
+        if spk_all.size:
+            np.asarray(spk_all[-1])
         phase_times["p1_bound_compact"] = time.perf_counter() - t0
 
     # --- Pass 2: bin by partition block, finalize each block. -------------
