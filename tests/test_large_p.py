@@ -61,9 +61,13 @@ class TestBlockedAggregation:
         valid = np.ones(n, dtype=bool)
         return pid, pk, values, valid
 
-    def test_matches_dense_kernel_public_noise_free(self):
+    @pytest.mark.parametrize("block_partitions", [128, 32])
+    def test_matches_dense_kernel_public_noise_free(self, block_partitions):
         # Public (no selection), zero noise, loose bounds -> blocked result
         # must EXACTLY match the dense kernel and the raw aggregate.
+        # block_partitions=32 -> 32 blocks >> the 8-block dispatch window,
+        # so _StagedDrain must flush older block groups mid-loop (bounding
+        # staged HBM residency) without disturbing per-target append order.
         P = 1000
         cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P,
                                                             private=False,
@@ -84,7 +88,7 @@ class TestBlockedAggregation:
                                                   stds,
                                                   key,
                                                   cfg,
-                                                  block_partitions=128,
+                                                  block_partitions=block_partitions,
                                                   row_chunk=4096)
         assert list(kept) == list(range(P))
         expected_count = np.bincount(pk, minlength=P)
@@ -501,6 +505,17 @@ class TestBlockedSelection:
                                                  block_partitions=64)
         np.testing.assert_array_equal(kept, np.nonzero(dense_keep)[0])
         assert kept.dtype == np.int64
+        # 19 blocks >> the 8-block window: the staged-drain flush path
+        # must leave the kept set and ascending order unchanged.
+        kept_small = large_p.select_partitions_blocked(pid,
+                                                       pk,
+                                                       valid,
+                                                       key,
+                                                       l0,
+                                                       P,
+                                                       sel,
+                                                       block_partitions=16)
+        np.testing.assert_array_equal(kept_small, np.nonzero(dense_keep)[0])
 
     def test_single_block_and_empty(self):
         P, l0 = 50, 10
