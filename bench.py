@@ -269,6 +269,103 @@ def _bench_select_partitions(jax, on_tpu):
     }
 
 
+def _device_zipfish(jax, jnp, n, n_partitions, n_users):
+    """Device-side synthetic rows: exponentially-tilted partition
+    popularity, uniform users — benchmarks/_common.zipfish_data's
+    on-device twin, generated in HBM so device benchmarks never pay a
+    host upload. Returns a jitted key -> (pid, pk, values, valid)."""
+
+    @jax.jit
+    def make(k):
+        kp, ku, kv = jax.random.split(k, 3)
+        u = jax.random.uniform(kp, (n,))
+        pk = (jnp.power(u, 3.0) * n_partitions).astype(jnp.int32)
+        pid = jax.random.randint(ku, (n,), 0, n_users, dtype=jnp.int32)
+        values = jax.random.uniform(kv, (n,), minval=0.0, maxval=5.0)
+        return pid, pk, values, jnp.ones((n,), bool)
+
+    return make
+
+
+def _bench_baseline_configs(jax, jnp, on_tpu):
+    """BASELINE.md configs 1-3, measured (the reference publishes no
+    numbers — BASELINE.json `published: {}` — so these are the reference
+    points its table lists as 'TBD (measure)').
+
+    Config 1: movie_view_ratings-shaped COUNT on LocalBackend, the
+    reference's own host execution model
+    (/root/reference/examples/movie_view_ratings/run_without_frameworks.py:1).
+    Config 2: SUM+MEAN, Gaussian mechanism, public partitions.
+    Config 3: CompoundCombiner COUNT+SUM+PRIVACY_ID_COUNT, private
+    selection (/root/reference/pipeline_dp/combiners.py CompoundCombiner).
+    """
+    import pipelinedp_tpu as pdp
+    from benchmarks import _common
+    from pipelinedp_tpu import executor
+    detail = {}
+
+    # --- Config 1: LocalBackend COUNT (the CPU ground-truth engine). ----
+    n1 = 200_000 if on_tpu else 50_000
+    rng = np.random.default_rng(0)
+    rows = list(
+        zip(rng.integers(0, 10_000, n1).tolist(),
+            rng.integers(0, 500, n1).tolist()))
+    acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0, total_delta=1e-6)
+    engine = pdp.DPEngine(acc, pdp.LocalBackend())
+    params1 = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
+                                  noise_kind=pdp.NoiseKind.LAPLACE,
+                                  max_partitions_contributed=4,
+                                  max_contributions_per_partition=8)
+    extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                    partition_extractor=lambda r: r[1],
+                                    value_extractor=lambda r: 1.0)
+    start = time.perf_counter()
+    result = engine.aggregate(rows, params1, extractors)
+    acc.compute_budgets()
+    kept1 = sum(1 for _ in result)
+    elapsed = time.perf_counter() - start
+    detail["config1_local_count_rows"] = n1
+    detail["config1_local_count_rows_per_sec"] = round(n1 / elapsed)
+    detail["config1_local_count_kept"] = kept1
+
+    # --- Configs 2 and 3: device kernel variants on shared data. --------
+    P = 4096
+    n = 2**24 if on_tpu else 2**18
+    key = jax.random.PRNGKey(0)
+    data = _device_zipfish(jax, jnp, n, P, 1_000_000)(key)
+    _ = float(data[0][0])  # sync (block_until_ready no-ops over the tunnel)
+
+    def timed_kernel(metrics, noise_kind, private, tag):
+        _, cfg, stds, (min_v, max_v, min_s, max_s, mid) = \
+            _common.build_spec(P, metrics=metrics, noise_kind=noise_kind,
+                               private=private)
+
+        def step(k):
+            return executor.aggregate_kernel(*data, min_v, max_v, min_s,
+                                             max_s, mid, jnp.asarray(stds),
+                                             k, cfg)
+
+        outputs, _, _ = step(jax.random.fold_in(key, 1))
+        first = next(iter(outputs))
+        _ = float(outputs[first][0])  # warm + sync
+        start = time.perf_counter()
+        outputs, keep, _ = step(jax.random.fold_in(key, 2))
+        _ = float(outputs[first][0])
+        elapsed = time.perf_counter() - start
+        detail[f"{tag}_rows"] = n
+        detail[f"{tag}_rows_per_sec"] = round(n / elapsed)
+        detail[f"{tag}_outputs"] = sorted(outputs)
+
+    timed_kernel([pdp.Metrics.SUM, pdp.Metrics.MEAN],
+                 pdp.NoiseKind.GAUSSIAN, False,
+                 "config2_gaussian_public_sum_mean")
+    timed_kernel([pdp.Metrics.COUNT, pdp.Metrics.SUM,
+                  pdp.Metrics.PRIVACY_ID_COUNT],
+                 pdp.NoiseKind.LAPLACE, True,
+                 "config3_compound_private")
+    return detail
+
+
 def _bench_end_to_end(on_tpu):
     """File -> DP result on the Netflix-format path: chunked parse ->
     incremental factorize -> overlapped upload (pipelinedp_tpu.ingest) ->
@@ -433,18 +530,8 @@ def main():
 
     # --- Synthetic data: zipf-ish partition popularity, uniform users. ---
     key = jax.random.PRNGKey(0)
-
-    def make_chunk(k):
-        kp, ku, kv = jax.random.split(k, 3)
-        # Exponentially-tilted partition popularity.
-        u = jax.random.uniform(kp, (chunk,))
-        pk = (jnp.power(u, 3.0) * args.partitions).astype(jnp.int32)
-        pid = jax.random.randint(ku, (chunk,), 0, args.users, dtype=jnp.int32)
-        values = jax.random.uniform(kv, (chunk,), minval=0.0, maxval=5.0)
-        valid = jnp.ones((chunk,), dtype=bool)
-        return pid, pk, values, valid
-
-    make_chunk = jax.jit(make_chunk)
+    make_chunk = _device_zipfish(jax, jnp, chunk, args.partitions,
+                                 args.users)
 
     def step(k):
         pid, pk, values, valid = make_chunk(jax.random.fold_in(k, 1))
@@ -485,6 +572,10 @@ def main():
     # --- 10^7-partition standalone selection, O(kept) transfers. ---
     select_detail = _bench_select_partitions(jax, on_tpu)
 
+    # --- BASELINE configs 1-3 (LocalBackend ref, Gaussian+public,
+    # compound combiner). ---
+    baseline_detail = _bench_baseline_configs(jax, jnp, on_tpu)
+
     # Noise-distribution fidelity: KS statistic of 1M device noise draws
     # vs the CPU reference distribution at the same calibrated stddev
     # (BASELINE.json metric "noise-dist KS-stat vs CPU ref").
@@ -520,6 +611,7 @@ def main():
                 **e2e_detail,
                 **large_p_detail,
                 **select_detail,
+                **baseline_detail,
                 **({"device_fallback": fallback} if fallback else {}),
             },
         }))

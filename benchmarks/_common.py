@@ -54,9 +54,12 @@ def sync_fetch(out, all_leaves=False):
                 return
 
 
-def build_spec(n_partitions, metrics=None, l0=4, linf=8, eps=1.0):
-    """The standard bench aggregation spec: COUNT+SUM, Laplace, eps=1,
-    private truncated-geometric selection (BASELINE configs 1/3 shape).
+def build_spec(n_partitions, metrics=None, l0=4, linf=8, eps=1.0,
+               noise_kind=None, private=True):
+    """The standard bench aggregation spec — defaults to COUNT+SUM,
+    Laplace, eps=1, private truncated-geometric selection (BASELINE
+    configs 1/3 shape); `metrics`/`noise_kind`/`private` cover the other
+    BASELINE config shapes (Gaussian + public partitions, compound).
 
     Returns (params, cfg, stds ndarray, (min_v, max_v, min_s, max_s, mid)).
     """
@@ -67,7 +70,7 @@ def build_spec(n_partitions, metrics=None, l0=4, linf=8, eps=1.0):
 
     params = pdp.AggregateParams(
         metrics=metrics or [pdp.Metrics.COUNT, pdp.Metrics.SUM],
-        noise_kind=pdp.NoiseKind.LAPLACE,
+        noise_kind=noise_kind or pdp.NoiseKind.LAPLACE,
         max_partitions_contributed=l0,
         max_contributions_per_partition=linf,
         min_value=0.0,
@@ -75,13 +78,16 @@ def build_spec(n_partitions, metrics=None, l0=4, linf=8, eps=1.0):
     accountant = pdp.NaiveBudgetAccountant(total_epsilon=eps,
                                            total_delta=1e-6)
     compound = combiners.create_compound_combiner(params, accountant)
-    budget = accountant.request_budget(MechanismType.GENERIC)
+    selection = None
+    if private:
+        budget = accountant.request_budget(MechanismType.GENERIC)
     accountant.compute_budgets()
-    selection = selection_ops.selection_params_from_host(
-        params.partition_selection_strategy, budget.eps, budget.delta,
-        params.max_partitions_contributed, None)
+    if private:
+        selection = selection_ops.selection_params_from_host(
+            params.partition_selection_strategy, budget.eps, budget.delta,
+            params.max_partitions_contributed, None)
     cfg = executor.make_kernel_config(params, compound, n_partitions,
-                                      private_selection=True,
+                                      private_selection=private,
                                       selection_params=selection)
     stds = np.asarray(executor.compute_noise_stds(compound, params))
     return params, cfg, stds, executor.kernel_scalars(params)
