@@ -987,6 +987,33 @@ class TestLargePartitionRouting:
             assert result[pk].percentile_50 == pytest.approx(
                 expected[pk].percentile_50, abs=0.05)
 
+    def test_vector_sum_routes_through_blocked_path(self):
+        params = pdp.AggregateParams(metrics=[pdp.Metrics.VECTOR_SUM],
+                                     max_partitions_contributed=2,
+                                     max_contributions_per_partition=3,
+                                     vector_norm_kind=pdp.NormKind.Linf,
+                                     vector_max_norm=5.0,
+                                     vector_size=3)
+        rows = [(u, "pk_%d" % (u % 11), np.array([1.0, 2.0, -1.0]))
+                for u in range(220)]
+        extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                        partition_extractor=lambda r: r[1],
+                                        value_extractor=lambda r: r[2])
+        expected, _ = run_aggregate("local", rows, params,
+                                    extractors=extractors)
+        backend = pdp.TPUBackend(noise_seed=3, large_partition_threshold=8)
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-5)
+        engine = pdp.DPEngine(accountant, backend)
+        result = engine.aggregate(rows, params, extractors)
+        accountant.compute_budgets()
+        result = dict(result)
+        assert set(result) == set(expected)
+        for pk in expected:
+            np.testing.assert_allclose(np.asarray(result[pk].vector_sum),
+                                       np.asarray(expected[pk].vector_sum),
+                                       atol=0.05)
+
     def test_private_selection_match_local(self):
         params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT],
                                      max_partitions_contributed=1,
