@@ -504,7 +504,11 @@ def main():
         # CPU fallback: shrink the workload so the diagnostic line appears
         # in seconds, not hours.
         args.rows = min(args.rows, 4_000_000)
-    chunk = args.chunk or (2**25 if on_tpu else 2**20)  # 33.5M rows on TPU
+    # 16.8M rows/chunk on TPU: the measured optimum of the round-5 sweep
+    # (134M rows: 2^23 53.3M, 2^24 60.4M, 2^25 58.3-59.6M, 2^26 55.7M
+    # rec/s) — the bounding sort's O(n log n) comparator passes beat
+    # per-chunk dispatch overhead above 2^24.
+    chunk = args.chunk or (2**24 if on_tpu else 2**20)
     chunk = min(chunk, args.rows)
 
     # --- Aggregation spec: SUM+COUNT, eps=1, private partition selection. ---
