@@ -40,6 +40,8 @@ EOF
     timeout 2400 python benchmarks/profile_large_p.py >> /tmp/tpu_results.txt 2>&1
     echo "=== kernel profile ===" >> /tmp/tpu_results.txt
     timeout 2400 python benchmarks/profile_kernel.py >> /tmp/tpu_results.txt 2>&1
+    echo "=== block-partitions sweep ===" >> /tmp/tpu_results.txt
+    timeout 2400 python benchmarks/sweep_block_partitions.py >> /tmp/tpu_results.txt 2>&1
     echo "DONE" >> /tmp/tpu_results.txt
     exit 0
   fi
