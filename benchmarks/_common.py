@@ -33,6 +33,27 @@ def enable_compile_cache():
         pass
 
 
+def null_roundtrip(reps=3):
+    """Min-of-`reps` timing of one dispatch + scalar-fetch round trip
+    with no real compute — the RTT baseline to subtract from (or divide
+    into) every wall-clock number over the tunneled chip. Min-of-N, not
+    one sample: a single cold probe over the jittery remote link can
+    read several times steady-state."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    null = jax.jit(lambda x: x + 1.0)
+    x = jnp.float32(0.0)
+    sync_fetch(null(x))  # compile outside the timed samples
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sync_fetch(null(x))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def sync_fetch(out, all_leaves=False):
     """Force completion of a jax computation with a host fetch.
 

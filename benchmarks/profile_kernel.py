@@ -124,12 +124,12 @@ def scans_cost(values, pk):
 data = make(key)
 _sync(data)
 
-# Null baseline: dispatch + scalar-fetch round trip with no real compute.
-# Subtract this mentally from every number below; over the tunnel it is
-# dominated by RTT and can swamp sub-100 ms phases.
-_null = jax.jit(lambda x: x[0] + 1.0)
-t_null, _ = timed(_null, data[2])
-print(f"null dispatch+fetch round trip: {t_null*1e3:.1f} ms", flush=True)
+# Null baseline: dispatch + scalar-fetch round trip with no real compute
+# (shared helper, min-of-3). Subtract this mentally from every number
+# below; over the tunnel it is dominated by RTT and can swamp sub-100 ms
+# phases.
+print(f"null dispatch+fetch round trip: "
+      f"{_common.null_roundtrip() * 1e3:.1f} ms", flush=True)
 
 t_bound, bound = timed(phase_bound, *data, jax.random.fold_in(key, 1))
 t_reduce, dense = timed(phase_reduce, *bound)

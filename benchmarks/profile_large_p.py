@@ -12,6 +12,7 @@ import _common
 
 _common.path_setup()
 
+
 import jax  # noqa: E402
 
 from pipelinedp_tpu.parallel import large_p  # noqa: E402
@@ -21,6 +22,12 @@ n = int(os.environ.get("BENCH_ROWS", 2**22))
 
 _, cfg, stds, (min_v, max_v, min_s, max_s, mid) = _common.build_spec(P)
 pid, pk, values, valid = _common.zipfish_data(n, P)
+
+# Null dispatch + scalar-fetch round trip (shared helper, min-of-3):
+# divide the per-block sync/drain phases below by this to count round
+# trips rather than seconds.
+print(f"null dispatch+fetch round trip: "
+      f"{_common.null_roundtrip() * 1e3:.1f} ms", flush=True)
 
 
 def run(seed, phase_times=None):
