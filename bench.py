@@ -116,6 +116,43 @@ def acquire_device(max_wait_sec=480.0):
     return dev, f"tpu-init-failed: {last_msg[:160]}"
 
 
+def _builder_receipt_summary():
+    """Headline of the newest committed BENCH_*_builder.json, for embedding
+    in CPU-fallback receipts: a tunnel-dropped driver run then still
+    surfaces the latest device-verified evidence (clearly labeled as the
+    committed builder receipt, NOT this run's measurement)."""
+    import glob
+    import os
+    import subprocess
+    repo = os.path.dirname(os.path.abspath(__file__))
+    candidates = sorted(glob.glob(os.path.join(repo,
+                                               "BENCH_*_builder.json")))
+    if not candidates:
+        return None
+    path = candidates[-1]  # BENCH_rNN_ sorts by round
+    try:
+        with open(path) as f:
+            receipt = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    committed_at = None
+    try:
+        r = subprocess.run(
+            ["git", "-C", repo, "log", "-1", "--format=%cI", "--", path],
+            capture_output=True, text=True, timeout=30)
+        committed_at = r.stdout.strip() or None
+    except Exception:  # noqa: BLE001 - timestamp is best-effort
+        pass
+    return {
+        "file": os.path.basename(path),
+        "value": receipt.get("value"),
+        "unit": receipt.get("unit"),
+        "vs_baseline": receipt.get("vs_baseline"),
+        "device": receipt.get("detail", {}).get("device"),
+        "committed_at": committed_at,
+    }
+
+
 def _bench_eps_sweep(jax, jnp, on_tpu):
     """BASELINE config 5: 64-parameter-config utility-analysis ε-sweep,
     vmapped over the config axis in one jit-compiled program
@@ -224,7 +261,14 @@ def _bench_large_p(jax, on_tpu):
     start = time.perf_counter()
     kept_dev, _ = run_dev(9)
     dev_elapsed = time.perf_counter() - start
-    assert len(kept_dev) == len(kept)
+    # Both kept counts land in the receipt; a mismatch is surfaced loudly
+    # but must not abort the whole run (an assert here once cost an entire
+    # receipt over one discrepancy — every other benchmark's numbers died
+    # with it).
+    if len(kept_dev) != len(kept):
+        _log(f"WARNING: large_p kept-count mismatch — host-staged "
+             f"{len(kept)} vs device-resident {len(kept_dev)}; recording "
+             f"both (same key/seed, so this deserves a look)")
     return {
         "large_p_partitions": P,
         "large_p_rows": n,
@@ -233,7 +277,44 @@ def _bench_large_p(jax, on_tpu):
         "large_p_device_resident_sec": round(dev_elapsed, 3),
         "large_p_device_resident_rows_per_sec": round(n / dev_elapsed),
         "large_p_kept": int(len(kept)),
+        "large_p_kept_device_resident": int(len(kept_dev)),
+        **({"large_p_kept_mismatch": True}
+           if len(kept_dev) != len(kept) else {}),
     }
+
+
+def _bench_meshed_reshard(on_tpu):
+    """Host-staged vs collective (all_to_all) reshard on the 8-device CPU
+    mesh (benchmarks/bench_reshard.py in a subprocess: the virtual-device
+    mesh needs XLA_FLAGS set before backend init, which this process has
+    already done). A single attached chip cannot exchange with itself, so
+    the CPU mesh is the only multi-device fabric available either way;
+    see benchmarks/README.md for what the CPU numbers do and do not
+    bound."""
+    import os
+    import subprocess
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "benchmarks", "bench_reshard.py")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # let the script set its own device count
+    rows = 2**20 if on_tpu else 2**18
+    try:
+        r = subprocess.run([sys.executable, script, "--rows", str(rows)],
+                           capture_output=True, text=True, env=env,
+                           timeout=600)
+    except subprocess.TimeoutExpired:
+        return {"meshed_reshard_error": "timed out after 600s"}
+    if r.returncode != 0 or not r.stdout.strip():
+        tail = (r.stderr or "").strip().splitlines()
+        return {
+            "meshed_reshard_error":
+                (tail[-1][:200] if tail else f"rc={r.returncode}")
+        }
+    try:
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except json.JSONDecodeError:
+        return {"meshed_reshard_error": "unparseable output"}
 
 
 def _bench_select_partitions(jax, on_tpu):
@@ -576,6 +657,9 @@ def main():
     # --- 10^7-partition standalone selection, O(kept) transfers. ---
     select_detail = _bench_select_partitions(jax, on_tpu)
 
+    # --- Meshed reshard: host-staged vs collective on the CPU mesh. ---
+    reshard_detail = _bench_meshed_reshard(on_tpu)
+
     # --- BASELINE configs 1-3 (LocalBackend ref, Gaussian+public,
     # compound combiner). ---
     baseline_detail = _bench_baseline_configs(jax, jnp, on_tpu)
@@ -593,6 +677,7 @@ def main():
         scipy_stats.kstest(draws,
                            scipy_stats.laplace(scale=sum_std /
                                                np.sqrt(2.0)).cdf).statistic)
+    builder_receipt = _builder_receipt_summary() if fallback else None
     print(
         json.dumps({
             "metric": "DP SUM+COUNT records/sec/chip (eps=1, private "
@@ -615,8 +700,13 @@ def main():
                 **e2e_detail,
                 **large_p_detail,
                 **select_detail,
+                **reshard_detail,
                 **baseline_detail,
                 **({"device_fallback": fallback} if fallback else {}),
+                # CPU-fallback runs carry the newest committed device
+                # evidence so a tunnel-dropped driver round still shows it.
+                **({"builder_receipt": builder_receipt}
+                   if builder_receipt else {}),
             },
         }))
 
@@ -659,6 +749,9 @@ def _main_with_device_failover():
                 payload = json.loads(line)
                 payload.setdefault("detail", {})["device_fallback"] = (
                     f"device died mid-run: {type(e).__name__}; CPU rerun")
+                receipt = _builder_receipt_summary()
+                if receipt:
+                    payload["detail"].setdefault("builder_receipt", receipt)
                 print(json.dumps(payload))
                 return 0
             except json.JSONDecodeError:

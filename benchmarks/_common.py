@@ -65,14 +65,23 @@ def sync_fetch(out, all_leaves=False):
     independent transfers (e.g. a list of device_put uploads) that must
     each be awaited. (pipelinedp_tpu/parallel/large_p.py keeps its own
     inline one-element fetch in the profiling hook — product code does
-    not import the benchmark harness.)"""
+    not import the benchmark harness.)
+
+    When every leaf is zero-size there is nothing to fetch; fall back to
+    jax.block_until_ready so an empty-output timing is at least synced on
+    platforms with a working wait, instead of silently becoming the
+    dispatch-only measurement this helper exists to prevent."""
     import jax
+    fetched = False
     for leaf in jax.tree_util.tree_leaves(out):
         if getattr(leaf, "size", 0):
             np.asarray(leaf.ravel()[-1] if getattr(leaf, "ndim", 0)
                        else leaf)
+            fetched = True
             if not all_leaves:
                 return
+    if not fetched:
+        jax.block_until_ready(out)
 
 
 def build_spec(n_partitions, metrics=None, l0=4, linf=8, eps=1.0,

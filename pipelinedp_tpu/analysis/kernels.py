@@ -423,10 +423,10 @@ def sharded_sweep(mesh,
                             return_per_partition=return_per_partition,
                             psum_axis=SHARD_AXIS)
 
-    fn = jax.shard_map(per_shard,
-                       mesh=mesh,
-                       in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                                 P(SHARD_AXIS), P()),
-                       out_specs=P(),
-                       check_vma=False)
+    from pipelinedp_tpu.parallel.mesh import shard_map
+    fn = shard_map(per_shard,
+                   mesh=mesh,
+                   in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                             P(SHARD_AXIS), P()),
+                   out_specs=P())
     return fn(*row_args, cfg)

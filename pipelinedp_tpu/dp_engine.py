@@ -11,6 +11,15 @@ used), aggregate() lowers the whole graph to the fused columnar executor
 preserved: the device program runs when the returned collection is first
 iterated, which must happen after BudgetAccountant.compute_budgets() (noise
 scales enter the compiled program as traced inputs).
+
+Routing within the TPU path is owned by the backend's knobs, not this
+module: TPUBackend(mesh=...) sends the program through the meshed kernels
+(parallel/sharded.py, or parallel/large_p.py above
+large_partition_threshold), and TPUBackend(reshard=...) picks how each
+privacy id's rows are co-located on one shard — device-resident
+streamed-ingest columns take the on-device all_to_all reshard
+(parallel/reshard.py) and never revisit the host between ingest and
+dispatch; host rows take the exact load-balanced host permutation.
 """
 
 import functools

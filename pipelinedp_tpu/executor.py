@@ -1033,7 +1033,8 @@ def lazy_select_partitions(backend, col, params, data_extractors,
                 kept_ids = large_p.select_partitions_blocked_sharded(
                     backend.mesh, encoded.pid, encoded.pk, encoded.valid,
                     key, params.max_partitions_contributed, n_partitions,
-                    selection)
+                    selection,
+                    reshard=getattr(backend, "reshard", "auto"))
             else:
                 kept_ids = large_p.select_partitions_blocked(
                     encoded.pid, encoded.pk, encoded.valid, key,
@@ -1049,7 +1050,8 @@ def lazy_select_partitions(backend, col, params, data_extractors,
             from pipelinedp_tpu.parallel import sharded
             keep = sharded.sharded_select_partitions(
                 backend.mesh, encoded.pid, encoded.pk, encoded.valid, key,
-                params.max_partitions_contributed, n_partitions, selection)
+                params.max_partitions_contributed, n_partitions, selection,
+                reshard=getattr(backend, "reshard", "auto"))
         else:
             # Selection never reads values; a zero-width column keeps
             # pad_rows from copying the real one. A COPY of the container —
@@ -1272,7 +1274,8 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
                     backend.mesh, encoded.pid, encoded.pk, encoded.values,
                     encoded.valid, min_v, max_v, min_s, max_s, mid,
                     np.asarray(stds), key, cfg,
-                    secure_tables=secure_tables)
+                    secure_tables=secure_tables,
+                    reshard=getattr(backend, "reshard", "auto"))
             else:
                 kept_ids, blocked_outputs = large_p.aggregate_blocked(
                     encoded.pid, encoded.pk, encoded.values, encoded.valid,
@@ -1287,7 +1290,8 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
             from pipelinedp_tpu.parallel import sharded
             outputs, keep, _ = sharded.sharded_aggregate_arrays(
                 backend.mesh, pid, pk, values, valid, min_v, max_v, min_s,
-                max_s, mid, stds, key, cfg, secure_tables)
+                max_s, mid, stds, key, cfg, secure_tables,
+                reshard=getattr(backend, "reshard", "auto"))
         else:
             outputs, keep, _ = aggregate_kernel(
                 jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(values),

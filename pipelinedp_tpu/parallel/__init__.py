@@ -1,6 +1,11 @@
-"""Multi-chip execution: mesh construction and sharded aggregation."""
+"""Multi-chip execution: mesh construction, row resharding (host-staged
+or on-device all_to_all), and sharded aggregation."""
 
 from pipelinedp_tpu.parallel.mesh import make_mesh
+from pipelinedp_tpu.parallel.reshard import (
+    device_reshard_rows_by_pid,
+    stage_rows_to_mesh,
+)
 from pipelinedp_tpu.parallel.sharded import (
     shard_rows_by_pid,
     sharded_aggregate_arrays,

@@ -31,6 +31,13 @@ Two row-staging regimes, switched on whether the rows fit one device chunk:
     boundaries are bounded+compacted on device, the compacted survivors
     staged back to host, merged, and re-uploaded per block — preserving
     the O(row_chunk + C) device-memory bound at any n.
+
+The meshed variants (aggregate_blocked_sharded /
+select_partitions_blocked_sharded) scale both passes D-way: rows shard by
+privacy id — device-resident inputs through the on-device all_to_all
+reshard (parallel/reshard.py; rows never touch the host), host inputs
+through the exact LPT permutation — and each block costs one [C]-sized
+psum over ICI.
 """
 
 import dataclasses
@@ -43,18 +50,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from pipelinedp_tpu import executor
+# Canonical shape arithmetic lives with the mesh helpers; re-exported here
+# because the blocked path made the name public first.
+from pipelinedp_tpu.parallel.mesh import host_fetch, round_capacity
 
-
-def round_capacity(x: int, min_cap: int = 8) -> int:
-    """Round up keeping 4 significant bits (<= 1/16 ~ 6.25% slack, 12.5%
-    worst-case just above a power of two).
-
-    Bounds the number of distinct padded shapes (so the jit cache stays
-    small) without the up-to-2x waste of next-power-of-two padding.
-    """
-    x = max(int(x), min_cap)
-    step = 1 << max((x - 1).bit_length() - 4, 3)
-    return -(-x // step) * step
+# One shared depth for the async block pipeline: _dispatch_blocks keeps at
+# most this many block kernels in flight, and _StagedDrain keeps at most
+# this many blocks' O(kept) result buffers staged. The residency reasoning
+# (in-flight outputs + staged drains both bounded by the same window, so
+# HBM holds O(depth * C), never O(P)) only holds while these agree —
+# derive both from here, never tune one alone.
+PIPELINE_DEPTH = 8
 
 
 def _bound_compact_trace(pid, pk, values, valid, min_v, max_v, min_s, max_s,
@@ -179,7 +185,8 @@ def _chunk_ends(pid_sorted: np.ndarray, row_chunk: int) -> np.ndarray:
     return np.asarray(ends)
 
 
-def _dispatch_blocks(block_iter, consume, max_in_flight: int = 8) -> int:
+def _dispatch_blocks(block_iter, consume,
+                     max_in_flight: int = PIPELINE_DEPTH) -> int:
     """Bounded-window async block dispatch shared by every blocked driver.
 
     jax execution is async, so the device pipelines upcoming block kernels
@@ -240,7 +247,7 @@ class _StagedDrain:
     ago, so draining them rarely blocks and still overlaps the
     in-flight compute."""
 
-    def __init__(self, max_staged_blocks: int = 8):
+    def __init__(self, max_staged_blocks: int = PIPELINE_DEPTH):
         self._staged = []
         self._block_sizes = []
         self._open = 0  # entries staged since the last end_block()
@@ -344,7 +351,7 @@ def _sharded_bound_compact(pid, pk, values, valid, min_v, max_v, min_s,
     host downloads one [S, n_blocks+1] offsets table instead of any rows.
     """
     from jax.sharding import PartitionSpec
-    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
+    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS, shard_map
     SP = PartitionSpec
 
     def per_shard(pid_s, pk_s, values_s, valid_s, key_r, boundaries_r):
@@ -359,14 +366,13 @@ def _sharded_bound_compact(pid, pk, values, valid, min_v, max_v, min_s,
             leaf_s = jnp.zeros(0, jnp.int32)
         return spk_sorted, pair_s, cols_s, leaf_s, starts
 
-    fn = jax.shard_map(per_shard,
-                       mesh=mesh,
-                       in_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
-                                 SP(SHARD_AXIS), SP(SHARD_AXIS), SP(), SP()),
-                       out_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
-                                  SP(SHARD_AXIS), SP(SHARD_AXIS),
-                                  SP(SHARD_AXIS)),
-                       check_vma=False)
+    fn = shard_map(per_shard,
+                   mesh=mesh,
+                   in_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
+                             SP(SHARD_AXIS), SP(SHARD_AXIS), SP(), SP()),
+                   out_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
+                              SP(SHARD_AXIS), SP(SHARD_AXIS),
+                              SP(SHARD_AXIS)))
     return fn(pid, pk, values, valid, rows_key, boundaries)
 
 
@@ -386,7 +392,7 @@ def _sharded_block_kernel(spk_all, pair_all, cols_all, leaf_all, lo_r, len_r,
     device holds identical O(kept)-transferable results.
     """
     from jax.sharding import PartitionSpec
-    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
+    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS, shard_map
     SP = PartitionSpec
 
     def per_shard(spk_s, pair_s, cols_s, leaf_s, lo_all, len_all, stds_r,
@@ -397,13 +403,12 @@ def _sharded_block_kernel(spk_all, pair_all, cols_all, leaf_all, lo_r, len_r,
                             min_v, max_v, mid, stds_r, key_r, cfg, cap,
                             tables_r, psum_axis=SHARD_AXIS)
 
-    fn = jax.shard_map(per_shard,
-                       mesh=mesh,
-                       in_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
-                                 SP(SHARD_AXIS), SP(SHARD_AXIS), SP(), SP(),
-                                 SP(), SP(), SP()),
-                       out_specs=(SP(), SP(), SP()),
-                       check_vma=False)
+    fn = shard_map(per_shard,
+                   mesh=mesh,
+                   in_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
+                             SP(SHARD_AXIS), SP(SHARD_AXIS), SP(), SP(),
+                             SP(), SP(), SP()),
+                   out_specs=(SP(), SP(), SP()))
     return fn(spk_all, pair_all, cols_all, leaf_all, lo_r, len_r, stds, key,
               secure_tables)
 
@@ -423,7 +428,8 @@ def aggregate_blocked_sharded(mesh,
                               cfg: executor.KernelConfig,
                               *,
                               block_partitions: int = 1 << 20,
-                              secure_tables=None
+                              secure_tables=None,
+                              reshard: str = "auto"
                               ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
     """aggregate_blocked over a device mesh: the huge-P counterpart of
     sharded.sharded_aggregate_arrays.
@@ -436,30 +442,27 @@ def aggregate_blocked_sharded(mesh,
     psum over ICI before replicated selection/noise. Dense [P] state never
     exists on any device, host traffic stays O(kept), and per-device HBM
     holds O(rows/D + C) — the mesh extends the single-device row capacity
-    D-fold before any host staging is needed.
+    D-fold with no host staging anywhere on the device-resident path.
 
-    Device-resident (streamed-ingest) columns are accepted but staged
-    through the host once: the pid-balanced reshard
-    (sharded.shard_rows_by_pid) is a host-side permutation. Keeping the
-    reshard on-device (all_to_all over ICI) is the on-pod upgrade path.
+    Device-resident (streamed-ingest) columns reshard entirely on device:
+    pid-hash bucketize -> one padded jax.lax.all_to_all over the mesh axis
+    -> shard-local compaction (reshard.device_reshard_rows_by_pid); only a
+    [D, D] count table and the [D, n_blocks+1] block-offset table ever
+    cross to the host. Host-numpy inputs — which pay one upload regardless
+    — take the exact load-balanced host permutation
+    (sharded.shard_rows_by_pid), also reachable as the reshard="host"
+    escape hatch. See stage_rows_to_mesh for the padding model.
 
     Returns (kept_partition_ids int64[M], {metric: f[M]}) — identical
     contract to aggregate_blocked.
     """
-    from jax.sharding import NamedSharding, PartitionSpec
-    from pipelinedp_tpu.parallel import sharded
-    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
+    from pipelinedp_tpu.parallel.reshard import stage_rows_to_mesh
 
     P = cfg.n_partitions
     n_shards = mesh.devices.size
-    values = np.asarray(values, dtype=np.dtype(executor._ftype()))
-    pid, pk, values, valid = sharded.shard_rows_by_pid(
-        np.asarray(pid), np.asarray(pk), values, np.asarray(valid), n_shards)
-    sharding = NamedSharding(mesh, PartitionSpec(SHARD_AXIS))
-    pid = jax.device_put(jnp.asarray(pid), sharding)
-    pk = jax.device_put(jnp.asarray(pk), sharding)
-    values = jax.device_put(jnp.asarray(values), sharding)
-    valid = jax.device_put(jnp.asarray(valid), sharding)
+    pid, pk, values, valid = stage_rows_to_mesh(
+        mesh, pid, pk, values, valid, reshard,
+        values_dtype=np.dtype(executor._ftype()))
 
     rows_key, final_key = jax.random.split(rng_key, 2)
     stds = jnp.asarray(stds)
@@ -476,8 +479,9 @@ def aggregate_blocked_sharded(mesh,
         pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, rows_key,
         jnp.asarray(boundaries), cfg, mesh)
     # The one per-aggregation host download that scales with n_blocks, not
-    # rows: each shard's block offsets.
-    starts = np.asarray(starts).reshape(n_shards, n_blocks + 1)
+    # rows: each shard's block offsets (host_fetch = sanctioned under the
+    # transfer guard).
+    starts = host_fetch(starts).reshape(n_shards, n_blocks + 1)
 
     output_names = [name for e in cfg.plan for name in e.outputs]
     kept_ids = []
@@ -569,7 +573,7 @@ def _sharded_select_compact(pid, pk, valid, rows_key, boundaries, l0: int,
     searchsorts its own stream against the block boundaries.
     """
     from jax.sharding import PartitionSpec
-    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
+    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS, shard_map
     SP = PartitionSpec
 
     def per_shard(pid_s, pk_s, valid_s, key_r, boundaries_r):
@@ -581,12 +585,11 @@ def _sharded_select_compact(pid, pk, valid, rows_key, boundaries, l0: int,
                                   side="left").astype(jnp.int32)
         return spk_sorted, starts
 
-    fn = jax.shard_map(per_shard,
-                       mesh=mesh,
-                       in_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
-                                 SP(SHARD_AXIS), SP(), SP()),
-                       out_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS)),
-                       check_vma=False)
+    fn = shard_map(per_shard,
+                   mesh=mesh,
+                   in_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
+                             SP(SHARD_AXIS), SP(), SP()),
+                   out_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS)))
     return fn(pid, pk, valid, rows_key, boundaries)
 
 
@@ -597,7 +600,7 @@ def _sharded_selection_block(spk_all, lo_r, len_r, base, c_actual, key,
     """Selection pass 2 over the mesh: shard-local block counts + one [C]
     psum + replicated decisions/compaction (see _selection_block_trace)."""
     from jax.sharding import PartitionSpec
-    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
+    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS, shard_map
     SP = PartitionSpec
 
     def per_shard(spk_s, lo_all, len_all, key_r):
@@ -607,11 +610,10 @@ def _sharded_selection_block(spk_all, lo_r, len_r, base, c_actual, key,
                                       key_r, selection, cap,
                                       psum_axis=SHARD_AXIS)
 
-    fn = jax.shard_map(per_shard,
-                       mesh=mesh,
-                       in_specs=(SP(SHARD_AXIS), SP(), SP(), SP()),
-                       out_specs=(SP(), SP()),
-                       check_vma=False)
+    fn = shard_map(per_shard,
+                   mesh=mesh,
+                   in_specs=(SP(SHARD_AXIS), SP(), SP(), SP()),
+                   out_specs=(SP(), SP()))
     return fn(spk_all, lo_r, len_r, key)
 
 
@@ -624,37 +626,37 @@ def select_partitions_blocked_sharded(mesh,
                                       n_partitions: int,
                                       selection,
                                       *,
-                                      block_partitions: int = 1 << 20
+                                      block_partitions: int = 1 << 20,
+                                      reshard: str = "auto"
                                       ) -> np.ndarray:
     """select_partitions_blocked over a device mesh.
 
-    Rows shard by privacy id (pass 1 — pair dedupe, L0 sampling and the
-    compaction sort — runs D-way parallel with no collectives); each
-    partition block costs one int32[C] psum over ICI before replicated
-    decisions. Neither dense [P] counts nor a bool[P] keep vector ever
-    exists on any device, and host traffic stays O(rows/D + kept).
+    Rows shard by privacy id (device-resident inputs via the on-device
+    all_to_all reshard, host inputs via the exact LPT permutation — see
+    stage_rows_to_mesh); pass 1 — pair dedupe, L0 sampling and the
+    compaction sort — runs D-way parallel with no further collectives;
+    each partition block costs one int32[C] psum over ICI before
+    replicated decisions. Neither dense [P] counts nor a bool[P] keep
+    vector ever exists on any device, and host traffic stays
+    O(rows/D + kept) for host inputs, O(D^2 + n_blocks + kept) for
+    device-resident ones.
 
     Returns kept_partition_ids int64[M], ascending — identical contract
     to select_partitions_blocked.
     """
-    from jax.sharding import NamedSharding, PartitionSpec
-    from pipelinedp_tpu.parallel import sharded
-    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
+    from pipelinedp_tpu.parallel.reshard import stage_rows_to_mesh
 
     P = n_partitions
     n_shards = mesh.devices.size
     key_l0, key_sel = jax.random.split(rng_key)
-    # Zero-width values column: selection never reads values.
-    dummy_values = np.zeros((len(pid), 0), np.float32)
-    pid, pk, _, valid = sharded.shard_rows_by_pid(np.asarray(pid),
-                                                  np.asarray(pk),
-                                                  dummy_values,
-                                                  np.asarray(valid),
-                                                  n_shards)
-    sharding = NamedSharding(mesh, PartitionSpec(SHARD_AXIS))
-    pid = jax.device_put(jnp.asarray(pid), sharding)
-    pk = jax.device_put(jnp.asarray(pk), sharding)
-    valid = jax.device_put(jnp.asarray(valid), sharding)
+    # Zero-width values column: selection never reads values, and a real
+    # one would cost an O(rows) gather (or exchange) in the reshard.
+    if isinstance(pid, jax.Array):
+        dummy_values = jnp.zeros((pid.shape[0], 0), jnp.float32)
+    else:
+        dummy_values = np.zeros((len(pid), 0), np.float32)
+    pid, pk, _, valid = stage_rows_to_mesh(mesh, pid, pk, dummy_values,
+                                           valid, reshard)
 
     C = min(block_partitions, P)
     n_blocks = -(-P // C)
@@ -664,7 +666,7 @@ def select_partitions_blocked_sharded(mesh,
     spk_all, starts = _sharded_select_compact(pid, pk, valid, key_l0,
                                               jnp.asarray(boundaries), l0, P,
                                               mesh)
-    starts = np.asarray(starts).reshape(n_shards, n_blocks + 1)
+    starts = host_fetch(starts).reshape(n_shards, n_blocks + 1)
 
     kept_ids = []
 
@@ -737,7 +739,7 @@ def select_partitions_blocked(pid,
     boundaries = np.minimum(
         np.arange(n_blocks + 1, dtype=np.int64) * C,
         np.iinfo(np.int32).max).astype(np.int32)
-    block_starts = np.asarray(
+    block_starts = host_fetch(
         jnp.searchsorted(spk_sorted, jnp.asarray(boundaries), side="left"))
 
     kept_ids = []
@@ -860,9 +862,15 @@ def aggregate_blocked(pid,
         # Not block_until_ready: it is a no-op on some remote platforms
         # (the tunneled axon TPU), which would shift pass-1 tail cost
         # into the block_offsets bucket. A one-element host fetch proves
-        # the stream and all its producers finished.
+        # the stream and all its producers finished. Zero-size streams
+        # have no element to fetch; block_until_ready is the only sync
+        # left (where it no-ops, an empty pass 1 is also dispatch-only —
+        # but the timing is no longer SILENTLY dispatch-only on platforms
+        # with a working wait).
         if spk_all.size:
-            np.asarray(spk_all[-1])
+            host_fetch(spk_all[-1])
+        else:
+            jax.block_until_ready(spk_all)
         phase_times["p1_bound_compact"] = time.perf_counter() - t0
 
     # --- Pass 2: bin by partition block, finalize each block. -------------
@@ -879,7 +887,7 @@ def aggregate_blocked(pid,
     boundaries = np.minimum(
         np.arange(n_blocks + 1, dtype=np.int64) * C,
         np.iinfo(np.int32).max).astype(np.int32)
-    block_starts = np.asarray(
+    block_starts = host_fetch(
         jnp.searchsorted(spk_all, jnp.asarray(boundaries), side="left"))
     if profiling:
         phase_times["block_offsets"] = time.perf_counter() - t1

@@ -4,13 +4,29 @@ The framework's parallelism model (SURVEY.md §2.5): rows are data-sharded by
 privacy-unit hash over a 1-D mesh axis "shards"; per-partition partial
 accumulators are combined with lax.psum over ICI. DCN-reachable multi-host
 meshes work the same way — jax.devices() spans all hosts under jax.distributed.
+
+This module also owns the shape/padding arithmetic shared by every meshed
+stage (round_capacity, per-shard capacities) and the two seams the
+collective-reshard transfer discipline rests on:
+
+  * shard_map: version-portable wrapper (jax.shard_map on new jax,
+    jax.experimental.shard_map on older releases) used by every meshed
+    kernel in the package.
+  * host_fetch: the ONE sanctioned device->host fetch for small control
+    tables (O(D^2) reshard counts, O(n_blocks) block offsets — never
+    O(rows)). Routing all control-plane fetches through it lets the
+    transfer-guard test (tests/test_reshard.py) forbid every other
+    device->host materialization and so prove device-resident rows never
+    stage through the host.
 """
 
+import threading
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec
 
 SHARD_AXIS = "shards"
 
@@ -23,3 +39,62 @@ def make_mesh(devices: Optional[Sequence] = None,
         if n_devices is not None:
             devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def shard_map(f, mesh: Mesh, in_specs, out_specs):
+    """Version-portable shard_map with replication checking off.
+
+    jax >= 0.6 exposes jax.shard_map (check_vma); older releases only have
+    jax.experimental.shard_map.shard_map (check_rep). Every meshed kernel
+    in the package goes through this wrapper so the whole multi-chip path
+    works on both.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=False)
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """The leading-axis row split every meshed kernel consumes."""
+    return NamedSharding(mesh, PartitionSpec(SHARD_AXIS))
+
+
+def round_capacity(x: int, min_cap: int = 8) -> int:
+    """Round up keeping 4 significant bits (<= 1/16 ~ 6.25% slack, 12.5%
+    worst-case just above a power of two).
+
+    Bounds the number of distinct padded shapes (so the jit cache stays
+    small) without the up-to-2x waste of next-power-of-two padding.
+    """
+    x = max(int(x), min_cap)
+    step = 1 << max((x - 1).bit_length() - 4, 3)
+    return -(-x // step) * step
+
+
+def rows_per_shard(n: int, n_shards: int) -> int:
+    """Padded per-shard capacity for an even leading-axis split of n rows:
+    ceil(n / n_shards) rounded to a bounded-shape capacity."""
+    return round_capacity(-(-max(int(n), 1) // n_shards))
+
+
+# Thread-local marker read by reshard.forbid_row_fetches so the guard can
+# tell a sanctioned control-table fetch from a smuggled row download.
+_sanctioned_fetch = threading.local()
+
+
+def host_fetch(arr) -> np.ndarray:
+    """Sanctioned small device->host fetch for meshed control tables.
+
+    Only O(D^2) / O(n_blocks) tables may cross here — never row data. The
+    transfer-guard test forbids all other device->host materialization on
+    the device-resident path, so any new fetch added outside this helper
+    fails that test instead of silently re-introducing host staging.
+    """
+    _sanctioned_fetch.active = True
+    try:
+        return np.asarray(arr)
+    finally:
+        _sanctioned_fetch.active = False

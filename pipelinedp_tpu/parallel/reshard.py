@@ -1,0 +1,274 @@
+"""Device-native pid reshard: all_to_all over ICI instead of host staging.
+
+Every meshed aggregation needs each privacy unit's rows co-located on one
+shard (contribution bounding is global per id). The original implementation
+(sharded.shard_rows_by_pid) permutes all rows ON THE HOST and re-uploads —
+an O(rows) host round trip that forfeits the mesh's D-fold row-capacity
+claim the moment the inputs are already device-resident (streamed ingest).
+This module keeps the rows in HBM end to end:
+
+  1. **Bucketize** (per shard, on device): dest(row) = mix(pid) mod D — a
+     salted murmur-style hash, identical on every shard, so all rows of a
+     privacy id map to one destination no matter where they start.
+  2. **Count exchange** (the one host fetch): a tiny [D, D] send-count
+     table crosses to the host (mesh.host_fetch) to fix the static padded
+     bucket capacity; O(D^2) ints, never rows.
+  3. **Padded all_to_all**: each shard packs its rows into [D, cap_send]
+     invalid-padded buckets and ONE jax.lax.all_to_all per column moves
+     them over the SHARD_AXIS mesh axis (ICI on a pod).
+  4. **Compaction**: each shard sorts its received rows valid-first and
+     slices to the host-known output capacity, restoring the dense
+     leading-axis layout every meshed kernel consumes.
+
+Load balance, re-derived for the hash-bucketed layout: shard_rows_by_pid
+balanced ROW counts exactly (greedy-LPT heavy ids + serpentine tail), so
+its per-shard capacity was max-load-optimal up to round_capacity slack.
+Hash bucketing balances UNIQUE IDS in expectation instead: with U ids of
+weights w_1..w_U (sum n), a shard's expected load is n/D and the deviation
+is driven by the heaviest ids (Var = sum w_i^2 * (D-1)/D^2) — near-uniform
+workloads land within a few percent of n/D, while a single id holding a
+large fraction of all rows makes its shard irreducibly hot (the same
+irreducible case greedy-LPT had). Padding waste is bounded and asserted:
+the output capacity is round_capacity(max shard load) (<= 12.5% slack over
+the measured max), and a >2x max/mean skew logs a warning naming the
+hash-balance assumption that broke.
+
+The host path (sharded.shard_rows_by_pid) remains for host-numpy inputs —
+where one upload is unavoidable and the exact LPT balance is free — and as
+the reshard="host" escape hatch on every meshed entry point.
+"""
+
+import contextlib
+import functools
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from pipelinedp_tpu.parallel import mesh as mesh_lib
+from pipelinedp_tpu.parallel.mesh import (SHARD_AXIS, host_fetch,
+                                          round_capacity, row_sharding,
+                                          rows_per_shard, shard_map)
+
+# Fetches at or below this many elements are control-plane sized; the
+# transfer-guard treats anything larger as row data.
+_CONTROL_TABLE_ELEMENTS = 1 << 12
+
+
+def _dest_shard(pid, n_shards: int, salt: int):
+    """Destination shard of each row: murmur-mixed pid hash mod D.
+
+    A pure function of pid (identical on every shard), so co-location
+    needs no coordination. int64 pids fold to uint32 first — collisions
+    only merge ids onto one shard, never split one id across shards.
+    """
+    from pipelinedp_tpu.executor import _hash_mix
+    h = _hash_mix(pid.astype(jnp.uint32) * jnp.uint32(0x9E3779B9) ^
+                  jnp.uint32(salt))
+    return (h % jnp.uint32(n_shards)).astype(jnp.int32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n_shards", "salt", "mesh"))
+def _send_count_kernel(pid, valid, n_shards: int, salt: int, mesh: Mesh):
+    """[D, D] send-count table: row s holds shard s's per-destination
+    bucket sizes. The only data the host sees before the exchange."""
+
+    def per_shard(pid_s, valid_s):
+        dest = _dest_shard(pid_s, n_shards, salt)
+        idx = jnp.where(valid_s, dest, n_shards)
+        counts = jnp.zeros((n_shards + 1,), jnp.int32).at[idx].add(1)
+        return counts[None, :n_shards]
+
+    fn = shard_map(per_shard, mesh=mesh,
+                   in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
+                   out_specs=P(SHARD_AXIS, None))
+    return fn(pid, valid)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cap_send", "out_cap", "n_shards",
+                                    "salt", "mesh"))
+def _exchange_kernel(pid, pk, values, valid, cap_send: int, out_cap: int,
+                     n_shards: int, salt: int, mesh: Mesh):
+    """Pack -> all_to_all -> compact, one jit program, zero host traffic.
+
+    Each shard sorts its rows by destination, gathers them into invalid-
+    padded [D, cap_send] buckets, exchanges bucket d with shard d over the
+    mesh axis, then sorts the received [D * cap_send] rows valid-first and
+    slices to the host-known out_cap — the dense leading-axis layout the
+    meshed kernels consume.
+    """
+
+    def per_shard(pid_s, pk_s, values_s, valid_s):
+        n_local = pid_s.shape[0]
+        dest = jnp.where(valid_s, _dest_shard(pid_s, n_shards, salt),
+                         n_shards)
+        order = jnp.argsort(dest, stable=True)
+        starts = jnp.searchsorted(dest[order],
+                                  jnp.arange(n_shards + 1, dtype=jnp.int32))
+        j = jnp.arange(cap_send, dtype=jnp.int32)
+        slot = starts[:-1, None] + j[None, :]  # [D, cap_send] row ranks
+        slot_valid = slot < starts[1:, None]
+        take = order[jnp.minimum(slot, n_local - 1)]
+
+        def exchange(col, fill):
+            bucket = jnp.where(
+                slot_valid.reshape(slot_valid.shape + (1,) *
+                                   (col.ndim - 1)), col[take],
+                jnp.asarray(fill, col.dtype))
+            return jax.lax.all_to_all(bucket, SHARD_AXIS, 0, 0, tiled=True)
+
+        r_valid = jax.lax.all_to_all(slot_valid, SHARD_AXIS, 0, 0,
+                                     tiled=True)
+        r_pid = exchange(pid_s, 0)
+        r_pk = exchange(pk_s, -1)
+        r_val = exchange(values_s, 0)
+
+        def flat(x):
+            return x.reshape((n_shards * cap_send,) + x.shape[2:])
+
+        fvalid = flat(r_valid)
+        keep_first = jnp.argsort(~fvalid, stable=True)[:out_cap]
+        return (flat(r_pid)[keep_first], flat(r_pk)[keep_first],
+                flat(r_val)[keep_first], fvalid[keep_first])
+
+    fn = shard_map(per_shard, mesh=mesh, in_specs=(P(SHARD_AXIS),) * 4,
+                   out_specs=(P(SHARD_AXIS),) * 4)
+    return fn(pid, pk, values, valid)
+
+
+def _pad_and_shard(mesh: Mesh, per_shard_cap: int, pid, pk, values, valid):
+    """Pads device columns to n_shards * per_shard_cap (invalid-marked) and
+    lays them out as an even leading-axis split over the mesh — all on
+    device (device_put between device layouts is a device-to-device copy,
+    ICI on a pod)."""
+    n_shards = mesh.devices.size
+    pad = n_shards * per_shard_cap - pid.shape[0]
+
+    def padded(col, fill):
+        widths = ((0, pad),) + ((0, 0),) * (col.ndim - 1)
+        return jnp.pad(col, widths, constant_values=fill)
+
+    sharding = row_sharding(mesh)
+    return (jax.device_put(padded(pid, 0), sharding),
+            jax.device_put(padded(pk, -1), sharding),
+            jax.device_put(padded(values, 0), sharding),
+            jax.device_put(padded(valid, False), sharding))
+
+
+def device_reshard_rows_by_pid(mesh: Mesh, pid, pk, values, valid,
+                               salt: int = 0):
+    """Device-native counterpart of sharded.shard_rows_by_pid.
+
+    Takes device-resident row columns (any one-device or mesh layout),
+    returns (pid, pk, values, valid) of length n_shards * out_cap laid out
+    as an even leading-axis split over `mesh`, every privacy id's rows on
+    exactly one shard, invalid-padded. Rows never visit the host; the only
+    device->host traffic is the [D, D] count table (mesh.host_fetch).
+    """
+    n_shards = mesh.devices.size
+    n = pid.shape[0]
+    if n_shards == 1:
+        cap = round_capacity(n)
+        return _pad_and_shard(mesh, cap, pid, pk, values, valid)
+    per_in = rows_per_shard(n, n_shards)
+    pid, pk, values, valid = _pad_and_shard(mesh, per_in, pid, pk, values,
+                                            valid)
+    counts = host_fetch(
+        _send_count_kernel(pid, valid, n_shards, salt, mesh))
+    recv = counts.sum(axis=0)
+    max_recv = int(recv.max())
+    cap_send = round_capacity(int(counts.max()))
+    out_cap = round_capacity(max_recv)
+    # Padding-waste bound: round_capacity guarantees <= 12.5% slack over
+    # the measured max shard load (+ the 8-row floor). Asserted so a
+    # future capacity-rounding change cannot silently break the memory
+    # story this reshard is sold on.
+    assert out_cap <= max(-(-9 * max_recv) // 8, 8), (out_cap, max_recv)
+    total = int(recv.sum())
+    if total and max_recv * n_shards > 2 * total:
+        logging.warning(
+            "device reshard: hash-bucketed max shard load %d > 2x mean "
+            "(%.0f) — a few privacy ids dominate the row mass, so the "
+            "hash balance assumption (load ~ n/D) does not hold for this "
+            "input; the hot shard bounds the padded capacity.", max_recv,
+            total / n_shards)
+    return _exchange_kernel(pid, pk, values, valid, cap_send, out_cap,
+                            n_shards, salt, mesh)
+
+
+def stage_rows_to_mesh(mesh: Mesh, pid, pk, values, valid,
+                       reshard: str = "auto",
+                       values_dtype: Optional[np.dtype] = None):
+    """Shared input staging of every meshed entry point: rows in (host or
+    device), pid-co-located mesh-sharded rows out.
+
+    reshard:
+      * "auto" (default) — device-resident inputs take the collective
+        reshard (rows never touch the host); host inputs take the exact
+        LPT host permutation (they pay one upload either way).
+      * "host" — force the host permutation (escape hatch: exact row
+        balance, or a platform without all_to_all).
+      * "device" — force the collective (host inputs are uploaded once,
+        unbalanced, then exchanged on device).
+    """
+    if reshard not in ("auto", "host", "device"):
+        raise ValueError(f"reshard must be auto|host|device, got {reshard}")
+    device_resident = isinstance(pid, jax.Array)
+    use_device = (reshard == "device" or
+                  (reshard == "auto" and device_resident))
+    if use_device:
+        if values_dtype is not None:
+            values = values.astype(values_dtype)
+        if not device_resident:
+            pid, pk, values, valid = (jnp.asarray(pid), jnp.asarray(pk),
+                                      jnp.asarray(values),
+                                      jnp.asarray(valid))
+        return device_reshard_rows_by_pid(mesh, pid, pk, values, valid)
+    from pipelinedp_tpu.parallel import sharded
+    values = np.asarray(values)
+    if values_dtype is not None:
+        values = values.astype(values_dtype, copy=False)
+    pid, pk, values, valid = sharded.shard_rows_by_pid(
+        np.asarray(pid), np.asarray(pk), values, np.asarray(valid),
+        mesh.devices.size)
+    sharding = row_sharding(mesh)
+    return (jax.device_put(jnp.asarray(pid), sharding),
+            jax.device_put(jnp.asarray(pk), sharding),
+            jax.device_put(jnp.asarray(values), sharding),
+            jax.device_put(jnp.asarray(valid), sharding))
+
+
+@contextlib.contextmanager
+def forbid_row_fetches(max_elements: int = _CONTROL_TABLE_ELEMENTS):
+    """Transfer guard proving rows never leave the device in its scope.
+
+    jax.transfer_guard cannot catch device->host reads on the CPU backend
+    (arrays are host-backed, the "transfer" is zero-copy), so the guard
+    instruments the actual host-materialization entry point instead:
+    np.asarray of a jax.Array larger than a control table raises unless
+    it runs inside mesh.host_fetch. Used by the transfer-guard tests and
+    the multi-chip dryrun to prove the device-resident path performs zero
+    O(rows) host transfers before dispatch.
+    """
+    real_asarray = np.asarray
+
+    def guarded(a, *args, **kwargs):
+        if (isinstance(a, jax.Array) and a.size > max_elements and
+                not getattr(mesh_lib._sanctioned_fetch, "active", False)):
+            raise AssertionError(
+                f"O(rows) device->host fetch of shape {a.shape} inside a "
+                f"forbid_row_fetches scope — the device-resident path must "
+                f"not stage rows through the host")
+        return real_asarray(a, *args, **kwargs)
+
+    np.asarray = guarded
+    try:
+        yield
+    finally:
+        np.asarray = real_asarray

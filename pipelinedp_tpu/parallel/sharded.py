@@ -1,12 +1,18 @@
 """Multi-chip sharded DP aggregation (shard_map + psum over ICI).
 
 Strategy (SURVEY.md §2.5 "TPU-native equivalent"): the reference's three
-keyed shuffles become zero cross-device shuffles —
+keyed shuffles become one on-device exchange —
 
-  1. Rows are sharded by privacy-unit id at ingest (load-balanced: heavy
-     ids greedy-LPT, tail serpentine — see shard_rows_by_pid), so all of a
-     privacy unit's rows live on one shard and contribution bounding (the
-     by-pid "shuffle") is shard-local.
+  1. Rows are sharded by privacy-unit id, so all of a privacy unit's rows
+     live on one shard and contribution bounding (the by-pid "shuffle") is
+     shard-local. Device-resident inputs (streamed ingest) are resharded
+     entirely on device: pid-hash bucketize -> padded jax.lax.all_to_all
+     over the mesh axis -> shard-local compaction
+     (parallel/reshard.device_reshard_rows_by_pid); only a [D, D] count
+     table ever crosses to the host. Host-numpy inputs — which pay one
+     upload regardless — take the exact load-balanced host permutation
+     (heavy ids greedy-LPT, tail serpentine: shard_rows_by_pid), also
+     reachable as the reshard="host" escape hatch.
   2. Each shard computes dense per-partition partial columns
      (executor.partial_columns) — the by-partition "shuffle" is a local
      segment-sum into the dense [0, P) layout.
@@ -14,8 +20,9 @@ keyed shuffles become zero cross-device shuffles —
      and noise then run replicated (same PRNG key on every shard, so every
      shard holds identical results with no broadcast step).
 
-The collective cost is exactly one psum of (~6 x P) floats per aggregation,
-riding ICI — compared to the reference's full data shuffle over the network.
+The collective cost is one all_to_all of the row payload (device-resident
+inputs only) plus one psum of (~6 x P) floats per aggregation, riding ICI —
+compared to the reference's full data shuffle over the network.
 """
 
 from functools import partial
@@ -24,12 +31,13 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
 from pipelinedp_tpu import executor
 from pipelinedp_tpu.ops import selection_ops
-from pipelinedp_tpu.parallel.mesh import SHARD_AXIS
+from pipelinedp_tpu.parallel.mesh import SHARD_AXIS, round_capacity, shard_map
+from pipelinedp_tpu.parallel.reshard import stage_rows_to_mesh
 
 
 def shard_rows_by_pid(pid: np.ndarray, pk: np.ndarray, values: np.ndarray,
@@ -50,7 +58,6 @@ def shard_rows_by_pid(pid: np.ndarray, pk: np.ndarray, values: np.ndarray,
     leading-axis split.
     """
     import heapq
-    from pipelinedp_tpu.parallel.large_p import round_capacity
     _, inverse, ucounts = np.unique(pid, return_inverse=True,
                                     return_counts=True)
     heavy_first = np.argsort(-ucounts, kind="stable")
@@ -117,12 +124,11 @@ def _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
                                           secure_tables=tables_r))
         return outputs, keep, row_count
 
-    fn = jax.shard_map(per_shard,
-                       mesh=mesh,
-                       in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                                 P(SHARD_AXIS), P(), P(), P()),
-                       out_specs=P(),
-                       check_vma=False)
+    fn = shard_map(per_shard,
+                   mesh=mesh,
+                   in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                             P(SHARD_AXIS), P(), P(), P()),
+                   out_specs=P())
     return fn(pid, pk, values, valid, stds, rng_key, secure_tables)
 
 
@@ -146,58 +152,54 @@ def _sharded_select_kernel(pid, pk, valid, rng_key, l0: int,
         return selection_ops.sample_keep_decisions(key_sel, counts,
                                                    selection)
 
-    fn = jax.shard_map(per_shard,
-                       mesh=mesh,
-                       in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                                 P()),
-                       out_specs=P(),
-                       check_vma=False)
+    fn = shard_map(per_shard,
+                   mesh=mesh,
+                   in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                             P()),
+                   out_specs=P())
     return fn(pid, pk, valid, rng_key)
 
 
 def sharded_select_partitions(mesh: Mesh, pid, pk, valid, rng_key, l0: int,
                               n_partitions: int,
-                              selection: selection_ops.SelectionParams):
+                              selection: selection_ops.SelectionParams,
+                              reshard: str = "auto"):
     """Standalone partition selection over the mesh: shard rows by privacy
-    id, count shard-locally (executor.select_partition_counts), psum the
-    int32[P] count vector over ICI, select replicated.
+    id (on-device all_to_all for device-resident inputs, host LPT
+    permutation otherwise — see stage_rows_to_mesh), count shard-locally
+    (executor.select_partition_counts), psum the int32[P] count vector
+    over ICI, select replicated.
 
     Returns keep: bool[n_partitions], replicated across the mesh.
     """
-    n_shards = mesh.devices.size
     # Zero-width values column: selection never reads values, and a real
-    # column would cost an O(rows) gather/scatter in shard_rows_by_pid.
-    dummy_values = np.zeros((len(pid), 0), np.float32)
-    pid, pk, _, valid = shard_rows_by_pid(np.asarray(pid), np.asarray(pk),
-                                          dummy_values, np.asarray(valid),
-                                          n_shards)
-    sharding = NamedSharding(mesh, P(SHARD_AXIS))
-    pid = jax.device_put(jnp.asarray(pid), sharding)
-    pk = jax.device_put(jnp.asarray(pk), sharding)
-    valid = jax.device_put(jnp.asarray(valid), sharding)
+    # column would cost an O(rows) gather/scatter (or exchange) in the
+    # reshard.
+    if isinstance(pid, jax.Array):
+        dummy_values = jnp.zeros((pid.shape[0], 0), jnp.float32)
+    else:
+        dummy_values = np.zeros((len(pid), 0), np.float32)
+    pid, pk, _, valid = stage_rows_to_mesh(mesh, pid, pk, dummy_values,
+                                           valid, reshard)
     return _sharded_select_kernel(pid, pk, valid, rng_key, l0, n_partitions,
                                   selection, mesh)
 
 
 def sharded_aggregate_arrays(mesh: Mesh, pid, pk, values, valid, min_v, max_v,
                              min_s, max_s, mid, stds, rng_key,
-                             cfg: executor.KernelConfig, secure_tables=None):
+                             cfg: executor.KernelConfig, secure_tables=None,
+                             reshard: str = "auto"):
     """Shards rows by pid over `mesh` and runs the two-phase fused program.
 
-    Accepts host numpy arrays (any length); returns the same
-    (outputs, keep, row_count) triple as executor.aggregate_kernel, with
-    results replicated across the mesh.
+    Accepts host numpy arrays or device-resident jax arrays (any length);
+    device-resident columns reshard over ICI without touching the host
+    (stage_rows_to_mesh). Returns the same (outputs, keep, row_count)
+    triple as executor.aggregate_kernel, with results replicated across
+    the mesh.
     """
-    n_shards = mesh.devices.size
-    pid, pk, values, valid = shard_rows_by_pid(np.asarray(pid),
-                                               np.asarray(pk),
-                                               np.asarray(values),
-                                               np.asarray(valid), n_shards)
-    sharding = NamedSharding(mesh, P(SHARD_AXIS))
-    pid = jax.device_put(jnp.asarray(pid), sharding)
-    pk = jax.device_put(jnp.asarray(pk), sharding)
-    values = jax.device_put(jnp.asarray(values), sharding)
-    valid = jax.device_put(jnp.asarray(valid), sharding)
+    pid, pk, values, valid = stage_rows_to_mesh(
+        mesh, pid, pk, values, valid, reshard,
+        values_dtype=np.dtype(executor._ftype()))
     return _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s,
                            mid, jnp.asarray(stds), rng_key, cfg, mesh,
                            secure_tables)

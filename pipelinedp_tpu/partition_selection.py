@@ -184,9 +184,14 @@ class LaplaceThresholdingPartitionSelector(PartitionSelector):
         return self._threshold
 
     def _probability_of_keep_shifted(self, n: np.ndarray) -> np.ndarray:
-        # P(n + Lap(b) >= t) — Laplace survival function.
+        # P(n + Lap(b) >= t) — Laplace survival function. np.where
+        # evaluates BOTH branches, so each exp sees only the half-line it
+        # is selected on (clipped z): exp of a large positive z in the
+        # dead branch would overflow-warn even though its value is never
+        # used.
         z = (np.asarray(n, dtype=np.float64) - self._threshold) / self._b
-        return np.where(z >= 0, 1.0 - 0.5 * np.exp(-z), 0.5 * np.exp(z))
+        return np.where(z >= 0, 1.0 - 0.5 * np.exp(-np.maximum(z, 0.0)),
+                        0.5 * np.exp(np.minimum(z, 0.0)))
 
 
 class GaussianThresholdingPartitionSelector(PartitionSelector):

@@ -316,6 +316,14 @@ class TPUBackend(LocalBackend):
             parallel/mesh.make_mesh). When set, rows are sharded by privacy
             id across the mesh and partials combined with lax.psum
             (parallel/sharded.py). When None, single-device jit.
+        reshard: how meshed paths co-locate each privacy id's rows on one
+            shard (parallel/reshard.stage_rows_to_mesh). "auto" (default):
+            device-resident columns (streamed ingest) reshard on device —
+            pid-hash bucketize + one padded jax.lax.all_to_all over ICI,
+            rows never touching the host — while host-numpy inputs take
+            the exact load-balanced host permutation they'd pay an upload
+            for anyway. "host"/"device" force one path (escape hatches:
+            exact row balance, or a platform without all_to_all).
         max_partitions: optional static result width. When set, the kernel
             compiles for this many partitions regardless of how many appear
             in the data — reuse it across datasets to avoid recompiles.
@@ -342,13 +350,18 @@ class TPUBackend(LocalBackend):
                  max_partitions: Optional[int] = None,
                  noise_seed: Optional[int] = None,
                  secure_noise: bool = False,
-                 large_partition_threshold: Optional[int] = 1 << 21):
+                 large_partition_threshold: Optional[int] = 1 << 21,
+                 reshard: str = "auto"):
         super().__init__(seed=noise_seed)
+        if reshard not in ("auto", "host", "device"):
+            raise ValueError(
+                f"reshard must be auto|host|device, got {reshard!r}")
         self.mesh = mesh
         self.max_partitions = max_partitions
         self.noise_seed = noise_seed
         self.secure_noise = secure_noise
         self.large_partition_threshold = large_partition_threshold
+        self.reshard = reshard
 
     @property
     def is_tpu(self) -> bool:

@@ -607,6 +607,9 @@ class TestChunkedEncoderProperty:
     ]
 
     def test_random_chunkings_match_global_factorize(self):
+        pytest.importorskip(
+            "hypothesis",
+            reason="property test needs hypothesis (absent in some images)")
         from hypothesis import given, settings, strategies as st
 
         @settings(max_examples=60, deadline=None)

@@ -160,6 +160,32 @@ class TestPartitionSelectionParity:
         kept = native.sample_keep(probs)
         assert kept.mean() == pytest.approx(0.25, abs=0.01)
 
+    @pytest.mark.parametrize("strategy", [
+        PartitionSelectionStrategy.TRUNCATED_GEOMETRIC,
+        PartitionSelectionStrategy.LAPLACE_THRESHOLDING,
+        PartitionSelectionStrategy.GAUSSIAN_THRESHOLDING,
+    ])
+    def test_probability_of_keep_warning_clean(self, strategy):
+        # The privacy path must be warning-clean even at extreme counts:
+        # np.where evaluates both branches, so an unclamped exp in the
+        # dead branch overflows at large n (the Laplace survival function
+        # regression this test pins). Escalate every warning to an error.
+        import warnings
+        selector = partition_selection.create_partition_selection_strategy(
+            strategy, 1.0, 1e-8, 2, None)
+        counts = np.concatenate([
+            self.COUNTS,
+            np.array([10**9, 10**12, 10**15], dtype=np.int64)
+        ])
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            probs = selector.probability_of_keep_vec(counts)
+            scalar = [selector.probability_of_keep(int(c))
+                      for c in (0, 1, 10**9)]
+        assert np.all((probs >= 0.0) & (probs <= 1.0))
+        assert probs[-1] == pytest.approx(1.0)
+        assert scalar[0] == 0.0 and scalar[-1] == pytest.approx(1.0)
+
 
 class TestSecureNoiseMechanismIntegration:
 

@@ -10,9 +10,13 @@ partitions, value == clipping bound, etc.).
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
 
-import pipelinedp_tpu as pdp
+hypothesis = pytest.importorskip(
+    "hypothesis",
+    reason="property tests need hypothesis (absent in some images)")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+import pipelinedp_tpu as pdp  # noqa: E402
 
 HUGE_EPS = 1e7
 VOCAB = [f"pk{i}" for i in range(6)]

@@ -534,9 +534,9 @@ class TestShardedBlockedLargeP:
 
     def test_streamed_ingest_through_meshed_blocked(self):
         # Device-resident EncodedData (streamed ingest) through the
-        # meshed blocked engine route: columns are staged through the
-        # host for the pid reshard and the result must match the
-        # row-input LocalBackend path.
+        # meshed blocked engine route: columns reshard on device (the
+        # collective all_to_all path, tests/test_reshard.py) and the
+        # result must match the row-input LocalBackend path.
         from pipelinedp_tpu import ingest
         rows = ROWS
         chunks = [(np.array([r[0] for r in rows[i:i + 300]], object),
