@@ -677,6 +677,17 @@ def main():
         scipy_stats.kstest(draws,
                            scipy_stats.laplace(scale=sum_std /
                                                np.sqrt(2.0)).cdf).statistic)
+    # Fault-tolerance counters accumulated across every benchmark above:
+    # a healthy run records zeros; nonzero retries/fallbacks/degradations
+    # in a receipt flag the run as having survived adversity (and explain
+    # any throughput dip) instead of silently hiding it.
+    from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+    fault_counters = {
+        name: rt_telemetry.counters.get(name, 0)
+        for name in ("block_retries", "block_oom_degradations",
+                     "reshard_host_fallbacks", "journal_replays",
+                     "host_fetch_retries")
+    }
     builder_receipt = _builder_receipt_summary() if fallback else None
     print(
         json.dumps({
@@ -702,6 +713,7 @@ def main():
                 **select_detail,
                 **reshard_detail,
                 **baseline_detail,
+                "runtime_fault_counters": fault_counters,
                 **({"device_fallback": fallback} if fallback else {}),
                 # CPU-fallback runs carry the newest committed device
                 # evidence so a tunnel-dropped driver round still shows it.

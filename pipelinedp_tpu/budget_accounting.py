@@ -19,6 +19,7 @@ FFT library; here the privacy-loss-distribution math is built in-repo).
 
 import abc
 import collections
+import contextlib
 import logging
 import math
 from dataclasses import dataclass
@@ -156,6 +157,40 @@ class BudgetAccountant(abc.ABC):
         """A `with` scope whose mechanisms consume `weight` of the parent
         budget; mechanism weights are normalized on scope exit."""
         return BudgetAccountantScope(self, weight)
+
+    @property
+    def mechanism_count(self) -> int:
+        """Number of mechanisms registered in the ledger.
+
+        The re-execution invariant of the fault-tolerant runtime is stated
+        in terms of this count: mechanisms register at graph-build time
+        only, so retried/resumed/degraded execution must leave it
+        unchanged — composition accounting is only sound if a retry never
+        multiplies registrations (a re-registration would double-spend
+        epsilon for the same release).
+        """
+        return len(self._mechanisms)
+
+    @contextlib.contextmanager
+    def no_new_mechanisms(self, context: str = "execution"):
+        """Scope asserting that no mechanism registers inside it.
+
+        The runtime wraps device execution — including every retry,
+        journal resume and OOM re-plan — in this guard: a registration
+        there means some code path re-requested budget for a release that
+        was already accounted, i.e. a silent epsilon double-spend. The
+        guard turns that privacy bug into a loud failure.
+        """
+        before = len(self._mechanisms)
+        yield
+        grew = len(self._mechanisms) - before
+        if grew:
+            raise AssertionError(
+                f"{grew} mechanism(s) registered with the BudgetAccountant "
+                f"during {context}. Mechanisms must register at graph-build "
+                f"time only; a registration during execution (e.g. from a "
+                f"retried or re-planned block) would double-spend the "
+                f"privacy budget.")
 
     def _compute_budget_for_aggregation(self, weight: float) -> Budget:
         """Returns the naive-composition budget of one aggregation (used for

@@ -19,6 +19,7 @@ that already hold raw columns (e.g. file readers) should use
 users can feed integer/float arrays straight to executor.aggregate_arrays.
 """
 
+import logging
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence, Tuple
 
@@ -135,6 +136,50 @@ def factorize(raw: np.ndarray) -> Tuple[np.ndarray, Sequence[Any]]:
         return codes, out
 
 
+def nonfinite_value_rows(values: np.ndarray,
+                         policy: str = "error",
+                         where: str = "values") -> Optional[np.ndarray]:
+    """Validates the VALUE column against NaN/Inf at ingest.
+
+    A NaN or Inf in the value column survives jnp.clip (clip propagates
+    non-finite inputs) and silently poisons every sum, mean and variance
+    its partition releases — so non-finite values must be dealt with at
+    the ingest boundary, explicitly:
+
+      * policy="error" (default): raise ValueError naming the count.
+      * policy="drop": return the offending row mask (the caller marks
+        those rows invalid) and log one warning with the count.
+
+    Returns None when every value is finite (or the dtype cannot hold a
+    non-finite value); otherwise the bool row mask of offending rows.
+    Vector-valued rows are offending when ANY coordinate is non-finite.
+    """
+    if policy not in ("error", "drop"):
+        raise ValueError(f"nonfinite policy must be error|drop, "
+                         f"got {policy!r}")
+    values = np.asarray(values)
+    if values.dtype.kind not in "fc":
+        return None  # integer/bool values are always finite
+    finite = np.isfinite(values)
+    if values.ndim > 1:
+        finite = finite.all(axis=tuple(range(1, values.ndim)))
+    n_bad = int(finite.size - finite.sum())
+    if n_bad == 0:
+        return None
+    if policy == "error":
+        raise ValueError(
+            f"{n_bad} non-finite entr{'y' if n_bad == 1 else 'ies'} "
+            f"(NaN/Inf) in the {where} column: a non-finite value survives "
+            f"clipping and silently poisons its partition's aggregates. "
+            f"Fix the input, or pass nonfinite='drop' to drop those rows "
+            f"with a warning.")
+    logging.warning(
+        "dropping %d row(s) with non-finite %s (nonfinite='drop'): "
+        "NaN/Inf would survive clipping and poison the affected "
+        "partitions' aggregates.", n_bad, where)
+    return ~finite
+
+
 def encode_with_vocab(raw: np.ndarray, vocab: Sequence[Any]) -> np.ndarray:
     """Integer-encodes a key column against a FIXED vocabulary; -1 = absent."""
     if _pd is not None:
@@ -149,12 +194,15 @@ def encode_columns(
         pid_raw: Sequence[Any],
         pk_raw: Sequence[Any],
         values: Sequence[float],
-        public_partitions: Optional[Sequence[Any]] = None) -> EncodedData:
+        public_partitions: Optional[Sequence[Any]] = None,
+        nonfinite: str = "error") -> EncodedData:
     """Vectorized encoding of raw key/value COLUMNS (no per-row Python).
 
     This is the bulk-ingest entry point: file readers hand over whole
     columns (numpy arrays of keys/values) and every vocabulary assignment
-    runs as one hash-factorization pass.
+    runs as one hash-factorization pass. Non-finite VALUES are rejected
+    here (nonfinite="error", the default) or dropped with a warning
+    (nonfinite="drop") — see nonfinite_value_rows.
     """
     pid_raw = _as_key_array(pid_raw)
     pk_raw = _as_key_array(pk_raw)
@@ -164,9 +212,20 @@ def encode_columns(
         pk = encode_with_vocab(pk_raw, partition_vocab)
     else:
         pk, partition_vocab = factorize(pk_raw)
+    values = np.asarray(values, dtype=np.float64)
+    bad = nonfinite_value_rows(values, nonfinite)
+    if bad is not None:
+        # Dropped rows are marked invalid the same way rows outside the
+        # public partitions are: pk = -1 (EncodedData.valid reads pk >= 0).
+        pk = np.where(bad, np.int32(-1), pk).astype(np.int32)
+        # Zero out the dropped rows' values too: invalid rows never reach
+        # a reduction, but a NaN payload must not survive into any
+        # downstream array arithmetic either.
+        mask = bad if values.ndim == 1 else bad[:, None]
+        values = np.where(mask, 0.0, values)
     return EncodedData(pid=pid,
                        pk=pk,
-                       values=np.asarray(values, dtype=np.float64),
+                       values=values,
                        partition_vocab=partition_vocab,
                        n_privacy_ids=len(pid_vocab),
                        public_encoded=public_partitions is not None)

@@ -473,10 +473,42 @@ class DPEngine:
                              "combiners")
 
     def _annotate(self, col, params, budget: budget_accounting.Budget):
-        return self._backend.annotate(col,
-                                      "annotation",
-                                      params=params,
-                                      budget=budget)
+        col = self._backend.annotate(col,
+                                     "annotation",
+                                     params=params,
+                                     budget=budget)
+        return self._guard_lazy_execution(col)
+
+    def _guard_lazy_execution(self, col):
+        """Wraps a lazily-executed result so that iterating it cannot grow
+        the budget ledger.
+
+        Every mechanism must register at graph-build time (inside
+        aggregate()/select_partitions()); the deferred execution — which
+        under the fault-tolerant runtime includes block retries, journal
+        resume and OOM re-planning — must never call request_budget, or
+        the composition accounting double-spends epsilon for a release
+        that already happened. Local-family backends return lazy Python
+        generators, so the check brackets the actual execution; Beam/Spark
+        collections execute out of process and are returned untouched.
+        """
+        if not isinstance(self._backend, pipeline_backend.LocalBackend):
+            return col
+        accountant = self._budget_accountant
+
+        def guarded():
+            before = accountant.mechanism_count
+            yield from col
+            grew = accountant.mechanism_count - before
+            if grew:
+                raise AssertionError(
+                    f"{grew} mechanism(s) registered with the "
+                    f"BudgetAccountant while iterating an aggregation "
+                    f"result: mechanisms must register at graph-build "
+                    f"time, never during (possibly retried) execution — "
+                    f"this would double-spend the privacy budget.")
+
+        return guarded()
 
 
 def _check_col(col):

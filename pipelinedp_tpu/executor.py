@@ -34,6 +34,8 @@ the engine wraps execution in a lazy generator that runs on first iteration.
 
 import dataclasses
 import functools
+import hashlib
+import logging
 import math
 from dataclasses import dataclass
 from typing import Any, List, Optional, Sequence, Tuple
@@ -980,6 +982,41 @@ def select_partitions_kernel(pid, pk, valid, rng_key, l0: int,
     return selection_ops.sample_keep_decisions(key_sel, counts, selection)
 
 
+def blocked_job_id(kind: str, static_config, noise_seed) -> str:
+    """Default journal job id: a digest of the static kernel configuration
+    and the noise seed, stable across processes (sha1 of reprs, not
+    Python's salted hash) so a crashed run and its resume agree on the
+    key space. Callers with several identical aggregations per pipeline
+    must pass distinct TPUBackend(job_id=...) values instead."""
+    digest = hashlib.sha1(
+        repr((static_config, noise_seed)).encode()).hexdigest()[:12]
+    return f"{kind}-{digest}"
+
+
+def _blocked_runtime_kwargs(backend, kind: str, static_config) -> dict:
+    """The failure-semantics kwargs (retry/journal/job_id, plus the
+    block_partitions failure-domain size when set) threaded from
+    TPUBackend into the blocked drivers."""
+    journal = getattr(backend, "journal", None)
+    job_id = getattr(backend, "job_id", None)
+    noise_seed = getattr(backend, "noise_seed", None)
+    if journal is not None and noise_seed is None:
+        logging.warning(
+            "journaled blocked execution without a fixed noise_seed: a "
+            "resumed run derives a fresh base key, so only journaled "
+            "blocks keep their original results — set "
+            "TPUBackend(noise_seed=...) for a deterministic resume.")
+    if journal is not None and job_id is None:
+        job_id = blocked_job_id(kind, static_config, noise_seed)
+    kwargs = dict(retry=getattr(backend, "retry", None),
+                  journal=journal,
+                  job_id=job_id)
+    block_partitions = getattr(backend, "block_partitions", None)
+    if block_partitions is not None:
+        kwargs["block_partitions"] = block_partitions
+    return kwargs
+
+
 def resolve_n_partitions(backend, n_partitions: int) -> int:
     """Honors TPUBackend(max_partitions=...): a fixed static result width
     lets one compiled program be reused across datasets."""
@@ -1029,17 +1066,23 @@ def lazy_select_partitions(backend, col, params, data_extractors,
             # the blocked path itself runs sharded (pid-sharded pass 1,
             # one int32[C] psum per block).
             from pipelinedp_tpu.parallel import large_p
-            if backend.mesh is not None:
-                kept_ids = large_p.select_partitions_blocked_sharded(
-                    backend.mesh, encoded.pid, encoded.pk, encoded.valid,
-                    key, params.max_partitions_contributed, n_partitions,
-                    selection,
-                    reshard=getattr(backend, "reshard", "auto"))
-            else:
-                kept_ids = large_p.select_partitions_blocked(
-                    encoded.pid, encoded.pk, encoded.valid, key,
-                    params.max_partitions_contributed, n_partitions,
-                    selection)
+            runtime_kwargs = _blocked_runtime_kwargs(
+                backend, "select",
+                (n_partitions, params.max_partitions_contributed, selection))
+            with budget_accountant.no_new_mechanisms(
+                    "blocked partition selection execution"):
+                if backend.mesh is not None:
+                    kept_ids = large_p.select_partitions_blocked_sharded(
+                        backend.mesh, encoded.pid, encoded.pk, encoded.valid,
+                        key, params.max_partitions_contributed, n_partitions,
+                        selection,
+                        reshard=getattr(backend, "reshard", "auto"),
+                        **runtime_kwargs)
+                else:
+                    kept_ids = large_p.select_partitions_blocked(
+                        encoded.pid, encoded.pk, encoded.valid, key,
+                        params.max_partitions_contributed, n_partitions,
+                        selection, **runtime_kwargs)
             vocab = encoded.partition_vocab
             n_real = len(vocab)
             for idx in kept_ids:
@@ -1048,10 +1091,14 @@ def lazy_select_partitions(backend, col, params, data_extractors,
             return
         if backend.mesh is not None:
             from pipelinedp_tpu.parallel import sharded
-            keep = sharded.sharded_select_partitions(
-                backend.mesh, encoded.pid, encoded.pk, encoded.valid, key,
-                params.max_partitions_contributed, n_partitions, selection,
-                reshard=getattr(backend, "reshard", "auto"))
+            with budget_accountant.no_new_mechanisms(
+                    "sharded partition selection execution"):
+                keep = sharded.sharded_select_partitions(
+                    backend.mesh, encoded.pid, encoded.pk, encoded.valid,
+                    key, params.max_partitions_contributed, n_partitions,
+                    selection,
+                    reshard=getattr(backend, "reshard", "auto"),
+                    retry=getattr(backend, "retry", None))
         else:
             # Selection never reads values; a zero-width column keeps
             # pad_rows from copying the real one. A COPY of the container —
@@ -1269,34 +1316,48 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
             # blocked path itself runs over the mesh (pid-sharded pass 1,
             # one [C] psum per block).
             from pipelinedp_tpu.parallel import large_p
-            if backend.mesh is not None:
-                kept_ids, blocked_outputs = large_p.aggregate_blocked_sharded(
-                    backend.mesh, encoded.pid, encoded.pk, encoded.values,
-                    encoded.valid, min_v, max_v, min_s, max_s, mid,
-                    np.asarray(stds), key, cfg,
-                    secure_tables=secure_tables,
-                    reshard=getattr(backend, "reshard", "auto"))
-            else:
-                kept_ids, blocked_outputs = large_p.aggregate_blocked(
-                    encoded.pid, encoded.pk, encoded.values, encoded.valid,
-                    min_v, max_v, min_s, max_s, mid, np.asarray(stds), key,
-                    cfg, secure_tables=secure_tables)
+            runtime_kwargs = _blocked_runtime_kwargs(backend, "aggregate",
+                                                     cfg)
+            # Execution — retries, journal resume and OOM re-planning
+            # included — must never touch the epsilon ledger: mechanisms
+            # registered at graph-build time above, and a registration
+            # here would double-spend the budget.
+            with budget_accountant.no_new_mechanisms(
+                    "blocked aggregation execution"):
+                if backend.mesh is not None:
+                    kept_ids, blocked_outputs = \
+                        large_p.aggregate_blocked_sharded(
+                            backend.mesh, encoded.pid, encoded.pk,
+                            encoded.values, encoded.valid, min_v, max_v,
+                            min_s, max_s, mid, np.asarray(stds), key, cfg,
+                            secure_tables=secure_tables,
+                            reshard=getattr(backend, "reshard", "auto"),
+                            **runtime_kwargs)
+                else:
+                    kept_ids, blocked_outputs = large_p.aggregate_blocked(
+                        encoded.pid, encoded.pk, encoded.values,
+                        encoded.valid, min_v, max_v, min_s, max_s, mid,
+                        np.asarray(stds), key, cfg,
+                        secure_tables=secure_tables, **runtime_kwargs)
             yield from decode_blocked_results(kept_ids, blocked_outputs,
                                               encoded.partition_vocab,
                                               compound)
             return
         pid, pk, values, valid = pad_rows(encoded)
-        if backend.mesh is not None:
-            from pipelinedp_tpu.parallel import sharded
-            outputs, keep, _ = sharded.sharded_aggregate_arrays(
-                backend.mesh, pid, pk, values, valid, min_v, max_v, min_s,
-                max_s, mid, stds, key, cfg, secure_tables,
-                reshard=getattr(backend, "reshard", "auto"))
-        else:
-            outputs, keep, _ = aggregate_kernel(
-                jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(values),
-                jnp.asarray(valid), min_v, max_v, min_s, max_s, mid,
-                jnp.asarray(stds), key, cfg, secure_tables)
+        with budget_accountant.no_new_mechanisms(
+                "fused aggregation execution"):
+            if backend.mesh is not None:
+                from pipelinedp_tpu.parallel import sharded
+                outputs, keep, _ = sharded.sharded_aggregate_arrays(
+                    backend.mesh, pid, pk, values, valid, min_v, max_v,
+                    min_s, max_s, mid, stds, key, cfg, secure_tables,
+                    reshard=getattr(backend, "reshard", "auto"),
+                    retry=getattr(backend, "retry", None))
+            else:
+                outputs, keep, _ = aggregate_kernel(
+                    jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(values),
+                    jnp.asarray(valid), min_v, max_v, min_s, max_s, mid,
+                    jnp.asarray(stds), key, cfg, secure_tables)
         yield from decode_results(outputs, keep, encoded.partition_vocab,
                                   compound)
 

@@ -255,10 +255,16 @@ class ChunkedVocabEncoder:
 def stream_encode_columns(
         chunks: Iterable[Tuple[Sequence[Any], Sequence[Any],
                                Sequence[float]]],
-        public_partitions: Optional[Sequence[Any]] = None
+        public_partitions: Optional[Sequence[Any]] = None,
+        nonfinite: str = "error"
 ) -> columnar.EncodedData:
     """Encodes and uploads (pid_raw, pk_raw, values) column chunks,
     overlapping each chunk's device copy with the next chunk's parsing.
+
+    Non-finite VALUES are rejected per chunk (nonfinite="error", the
+    default) or dropped with a warning (nonfinite="drop") — a NaN/Inf
+    survives jnp.clip and would silently poison its partition's sums
+    (columnar.nonfinite_value_rows).
 
     Returns a device-resident EncodedData (jax-array columns, values in
     the kernel compute dtype — float32 normally, at half the f64 upload
@@ -283,12 +289,17 @@ def stream_encode_columns(
                 columnar._as_key_array(pk_raw), partition_vocab)
         else:
             pk = pk_enc.encode(pk_raw)
+        values = np.asarray(values, dtype=value_dtype)
+        bad = columnar.nonfinite_value_rows(values, nonfinite)
+        if bad is not None:
+            pk = np.where(bad, np.int32(-1), pk).astype(np.int32)
+            mask = bad if values.ndim == 1 else bad[:, None]
+            values = np.where(mask, 0.0, values).astype(value_dtype)
         # jnp.asarray dispatches the host->device copy asynchronously; the
         # loop continues into the next chunk's parse while it lands.
         dev_pid.append(jnp.asarray(pid))
         dev_pk.append(jnp.asarray(pk))
-        dev_vals.append(
-            jnp.asarray(np.asarray(values, dtype=value_dtype)))
+        dev_vals.append(jnp.asarray(values))
     if not dev_pid:
         empty = jnp.zeros(0, jnp.int32)
         dev_pid, dev_pk = [empty], [empty]
@@ -334,11 +345,14 @@ class ShardEncoding:
 def encode_shard(
         chunks: Iterable[Tuple[Sequence[Any], Sequence[Any],
                                Sequence[float]]],
-        public_partitions: Optional[Sequence[Any]] = None) -> ShardEncoding:
+        public_partitions: Optional[Sequence[Any]] = None,
+        nonfinite: str = "error") -> ShardEncoding:
     """Host-local chunked encoding of one input shard (no device work).
 
     The multi-host counterpart of stream_encode_columns' parse+factorize
-    stage: runs in each ingest process over its own chunk iterator.
+    stage: runs in each ingest process over its own chunk iterator. The
+    same per-chunk non-finite value policy applies (each ingest worker
+    rejects/drops at its own boundary, so poisoned rows never travel).
     """
     pid_enc = ChunkedVocabEncoder()
     pk_enc = ChunkedVocabEncoder()
@@ -354,7 +368,13 @@ def encode_shard(
                                            partition_vocab))
         else:
             pks.append(pk_enc.encode(pk_raw))
-        vals.append(np.asarray(values, dtype=np.float64))
+        values = np.asarray(values, dtype=np.float64)
+        bad = columnar.nonfinite_value_rows(values, nonfinite)
+        if bad is not None:
+            pks[-1] = np.where(bad, np.int32(-1), pks[-1]).astype(np.int32)
+            mask = bad if values.ndim == 1 else bad[:, None]
+            values = np.where(mask, 0.0, values)
+        vals.append(values)
     empty = np.zeros(0, np.int32)
     return ShardEncoding(
         pid=np.concatenate(pids) if pids else empty,

@@ -38,10 +38,20 @@ privacy id — device-resident inputs through the on-device all_to_all
 reshard (parallel/reshard.py; rows never touch the host), host inputs
 through the exact LPT permutation — and each block costs one [C]-sized
 psum over ICI.
+
+Failure semantics (pipelinedp_tpu/runtime, README "Failure semantics"):
+every driver takes retry= (transient dispatch/sync failures re-dispatch
+under the SAME fold_in(final_key, b) key — bit-identical noise, no second
+release), journal=/job_id= (consumed blocks' drained results recorded for
+resume; replayed blocks never re-dispatch), and degrades on OOM by
+halving the partition block capacity and re-planning the remaining range
+(run_with_degradation; re-planned blocks draw fresh keys — nothing was
+released for them).
 """
 
 import dataclasses
 import functools
+import logging
 import time
 from typing import Dict, Optional, Tuple
 
@@ -53,6 +63,10 @@ from pipelinedp_tpu import executor
 # Canonical shape arithmetic lives with the mesh helpers; re-exported here
 # because the blocked path made the name public first.
 from pipelinedp_tpu.parallel.mesh import host_fetch, round_capacity
+from pipelinedp_tpu.runtime import faults as rt_faults
+from pipelinedp_tpu.runtime import journal as rt_journal
+from pipelinedp_tpu.runtime import retry as rt_retry
+from pipelinedp_tpu.runtime import telemetry as rt_telemetry
 
 # One shared depth for the async block pipeline: _dispatch_blocks keeps at
 # most this many block kernels in flight, and _StagedDrain keeps at most
@@ -61,6 +75,23 @@ from pipelinedp_tpu.parallel.mesh import host_fetch, round_capacity
 # HBM holds O(depth * C), never O(P)) only holds while these agree —
 # derive both from here, never tune one alone.
 PIPELINE_DEPTH = 8
+
+# Key lane for OOM-re-planned block generations: block keys must be a pure
+# function of (final_key, plan generation, block index) so that a RETRIED
+# block redraws bit-identical noise while a RE-PLANNED block (different
+# partition geometry after a capacity halving) can never collide with a
+# key an earlier-generation block already consumed.
+_REPLAN_KEY_LANE = 0x7265706C  # 'repl'
+
+
+def _block_noise_key(final_key, generation: int, block: int):
+    if generation == 0:
+        # Generation 0 preserves the historical fold_in(final_key, b)
+        # derivation: fault-free runs (and retries within them) are
+        # bit-compatible with pre-runtime releases.
+        return jax.random.fold_in(final_key, block)
+    return jax.random.fold_in(
+        jax.random.fold_in(final_key, _REPLAN_KEY_LANE + generation), block)
 
 
 def _bound_compact_trace(pid, pk, values, valid, min_v, max_v, min_s, max_s,
@@ -185,8 +216,27 @@ def _chunk_ends(pid_sorted: np.ndarray, row_chunk: int) -> np.ndarray:
     return np.asarray(ends)
 
 
+class _Replay:
+    """A block whose results come from the journal instead of a dispatch."""
+
+    __slots__ = ("record",)
+
+    def __init__(self, record: rt_journal.BlockRecord):
+        self.record = record
+
+
+def _sync_scalars(result) -> None:
+    """Forces the 0-d leaves (the n_kept gates) to host — the sync point
+    where asynchronously-dispatched block failures surface."""
+    for leaf in jax.tree_util.tree_leaves(result):
+        if getattr(leaf, "ndim", None) == 0:
+            np.asarray(leaf)
+
+
 def _dispatch_blocks(block_iter, consume,
-                     max_in_flight: int = PIPELINE_DEPTH) -> int:
+                     max_in_flight: int = PIPELINE_DEPTH,
+                     retry_policy: Optional[rt_retry.RetryPolicy] = None
+                     ) -> int:
     """Bounded-window async block dispatch shared by every blocked driver.
 
     jax execution is async, so the device pipelines upcoming block kernels
@@ -196,34 +246,122 @@ def _dispatch_blocks(block_iter, consume,
     HBM, and an unbounded pipeline over P/C blocks would hold O(P)
     results — the exact footprint this module exists to avoid.
 
-    `block_iter` yields (block_index, dispatched_result) pairs;
+    `block_iter` yields (block_index, entry) pairs where entry is either a
+    _Replay (journaled results, consumed with no device work) or a
+    zero-arg dispatch closure. The closure is re-invokable: it derives its
+    own fold_in key, so re-dispatching it for a retry redraws bit-identical
+    noise. Transient failures — at dispatch or at the consume-side sync —
+    are retried with bounded backoff; OOM-classified failures surface as
+    BlockOOMError AFTER all earlier in-flight blocks are drained, so the
+    caller can re-plan from exactly the failed block.
     `consume(block_index, result)` syncs and drains one block. Returns
-    the number of blocks dispatched.
+    the number of blocks dispatched (replays excluded).
     """
+    policy = retry_policy or rt_retry.DEFAULT_POLICY
     pending = []
     n_dispatched = 0
-    for item in block_iter:
-        n_dispatched += 1
+
+    def start(b, make):
+        result = rt_retry.retry_call(make, policy, block=b)
         # Start the host copy of each scalar output (the n_kept gates) at
         # dispatch time: by the time consume() syncs on it, the value has
         # already crossed the link — int(n_kept) would otherwise pay one
         # blocking round trip per block on a remote-attached chip.
-        for leaf in jax.tree_util.tree_leaves(item[1]):
+        for leaf in jax.tree_util.tree_leaves(result):
             if getattr(leaf, "ndim", None) == 0:
                 _copy_to_host_async(leaf)
-        pending.append(item)
+        return result
+
+    def consume_one(b, entry, make):
+        if make is None:  # journal replay
+            consume(b, entry)
+            return
+        result = entry
+        attempt = 0
+        while True:
+            try:
+                rt_faults.maybe_fail("consume", b)
+                _sync_scalars(result)
+                break
+            except Exception as e:  # noqa: BLE001 - classified below
+                if (not rt_retry.is_transient(e) or
+                        attempt >= policy.max_retries):
+                    raise
+                delay = policy.delay(attempt)
+                attempt += 1
+                rt_telemetry.record("block_retries")
+                logging.warning(
+                    "block %d failed at its sync point (%s); re-dispatching "
+                    "under the same block key (retry %d/%d in %.2fs) — "
+                    "noise is bit-identical, no second release", b,
+                    type(e).__name__, attempt, policy.max_retries, delay)
+                time.sleep(delay)
+                result = start(b, make)
+        consume(b, result)
+
+    def consume_or_oom(b, entry, make):
+        try:
+            consume_one(b, entry, make)
+        except Exception as err:
+            if make is not None and rt_retry.is_oom(err):
+                raise rt_retry.BlockOOMError(b, err) from err
+            raise
+
+    for b, entry in block_iter:
+        if isinstance(entry, _Replay):
+            pending.append((b, entry, None))
+        else:
+            n_dispatched += 1
+            try:
+                result = start(b, entry)
+            except Exception as err:
+                # Drain the earlier in-flight blocks first: their results
+                # (and journal records) must survive the abort so a
+                # degradation or resume continues from this block, not
+                # from zero. A secondary drain failure must not mask the
+                # original error.
+                try:
+                    while pending:
+                        consume_one(*pending.pop(0))
+                except Exception:  # noqa: BLE001 - original error wins
+                    logging.exception(
+                        "draining in-flight blocks after a dispatch "
+                        "failure itself failed; earlier results may be "
+                        "incomplete")
+                if rt_retry.is_oom(err):
+                    raise rt_retry.BlockOOMError(b, err) from err
+                raise
+            pending.append((b, result, entry))
         if len(pending) >= max_in_flight:
-            consume(*pending.pop(0))
-    for entry in pending:
-        consume(*entry)
+            consume_or_oom(*pending.pop(0))
+    while pending:
+        consume_or_oom(*pending.pop(0))
     return n_dispatched
 
 
+# Platforms without async device->host copies warn once, not per block.
+_async_copy_unsupported = False
+
+
 def _copy_to_host_async(arr) -> None:
+    """Starts an async host copy where the platform supports it.
+
+    Only the unsupported-platform signatures (missing or unimplemented
+    method) are swallowed — a real runtime failure here is the same
+    failure consume()'s sync would hit and must stay visible there, not
+    vanish into a blanket except.
+    """
+    global _async_copy_unsupported
+    if _async_copy_unsupported:
+        return
     try:
         arr.copy_to_host_async()
-    except Exception:  # noqa: BLE001 - platforms without async copies
-        pass
+    except (AttributeError, NotImplementedError) as e:
+        _async_copy_unsupported = True
+        logging.warning(
+            "copy_to_host_async is unsupported on this platform (%s: %s); "
+            "device->host drains will block at materialization instead of "
+            "overlapping. Warning once.", type(e).__name__, e)
 
 
 class _StagedDrain:
@@ -413,6 +551,35 @@ def _sharded_block_kernel(spk_all, pair_all, cols_all, leaf_all, lo_r, len_r,
               secure_tables)
 
 
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def _sharded_block_offsets(spk_all, boundaries, mesh):
+    """Per-shard block offsets of the compacted stream against a NEW set
+    of boundaries — the re-planning counterpart of the searchsorted fused
+    into pass 1, used after an OOM degradation changes the block plan."""
+    from jax.sharding import PartitionSpec
+    from pipelinedp_tpu.parallel.mesh import SHARD_AXIS, shard_map
+    SP = PartitionSpec
+
+    def per_shard(spk_s, boundaries_r):
+        return jnp.searchsorted(spk_s, boundaries_r,
+                                side="left").astype(jnp.int32)
+
+    fn = shard_map(per_shard, mesh=mesh,
+                   in_specs=(SP(SHARD_AXIS), SP()),
+                   out_specs=SP(SHARD_AXIS))
+    return fn(spk_all, boundaries)
+
+
+def _block_boundaries(base: int, capacity: int, n_blocks: int) -> np.ndarray:
+    """int64 block boundaries over [base, base + n_blocks * capacity],
+    clamped into int32 range: partition ids are < P <= int32 max and
+    dropped rows carry the int32-max sentinel, so a clamped boundary still
+    lands left of every sentinel (same overflow guard everywhere)."""
+    return np.minimum(
+        base + np.arange(n_blocks + 1, dtype=np.int64) * capacity,
+        np.iinfo(np.int32).max).astype(np.int32)
+
+
 def aggregate_blocked_sharded(mesh,
                               pid,
                               pk,
@@ -429,7 +596,10 @@ def aggregate_blocked_sharded(mesh,
                               *,
                               block_partitions: int = 1 << 20,
                               secure_tables=None,
-                              reshard: str = "auto"
+                              reshard: str = "auto",
+                              retry: Optional[rt_retry.RetryPolicy] = None,
+                              journal: Optional[rt_journal.BlockJournal] = None,
+                              job_id: Optional[str] = None
                               ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
     """aggregate_blocked over a device mesh: the huge-P counterpart of
     sharded.sharded_aggregate_arrays.
@@ -453,6 +623,12 @@ def aggregate_blocked_sharded(mesh,
     (sharded.shard_rows_by_pid), also reachable as the reshard="host"
     escape hatch. See stage_rows_to_mesh for the padding model.
 
+    Failure semantics (shared with every blocked driver): transient block
+    failures retry under the same fold_in key (bit-identical noise), OOM
+    halves the partition block capacity and re-plans the remaining range,
+    and a journal records each consumed block's drained results for
+    resume — see README "Failure semantics".
+
     Returns (kept_partition_ids int64[M], {metric: f[M]}) — identical
     contract to aggregate_blocked.
     """
@@ -467,55 +643,100 @@ def aggregate_blocked_sharded(mesh,
     rows_key, final_key = jax.random.split(rng_key, 2)
     stds = jnp.asarray(stds)
 
-    C = min(block_partitions, P)
-    n_blocks = -(-P // C)
-    # int64 boundaries clamped into int32 range: same overflow guard as
-    # the single-device path (P within one block of 2^31).
-    boundaries = np.minimum(
-        np.arange(n_blocks + 1, dtype=np.int64) * C,
-        np.iinfo(np.int32).max).astype(np.int32)
+    C0 = min(block_partitions, P)
+    n_blocks0 = -(-P // C0)
+    boundaries0 = _block_boundaries(0, C0, n_blocks0)
 
     spk_all, pair_all, cols_all, leaf_all, starts = _sharded_bound_compact(
         pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, rows_key,
-        jnp.asarray(boundaries), cfg, mesh)
+        jnp.asarray(boundaries0), cfg, mesh)
     # The one per-aggregation host download that scales with n_blocks, not
     # rows: each shard's block offsets (host_fetch = sanctioned under the
     # transfer guard).
-    starts = host_fetch(starts).reshape(n_shards, n_blocks + 1)
+    starts0 = host_fetch(starts).reshape(n_shards, n_blocks0 + 1)
 
     output_names = [name for e in cfg.plan for name in e.outputs]
     kept_ids = []
     kept_outputs = {name: [] for name in output_names}
+    job = job_id or "aggregate_blocked_sharded"
 
     drain = _StagedDrain()
 
-    def consume(b, result):
-        n_kept, ids_sorted, outputs_sorted = result
-        k = int(n_kept)  # sync; gates O(kept) transfers
-        if k:
-            drain.stage(kept_ids, ids_sorted[:k],
-                        lambda h, base=b * C: h.astype(np.int64) + base)
-            for name, col in outputs_sorted.items():
-                drain.stage(kept_outputs.setdefault(name, []), col[:k])
-        drain.end_block()
+    def append_record(record: rt_journal.BlockRecord):
+        if record.n_kept:
+            kept_ids.append(record.ids)
+            for name, col in record.outputs.items():
+                kept_outputs.setdefault(name, []).append(col)
 
-    def block_iter():
-        for b in range(n_blocks):
-            lo = starts[:, b].astype(np.int32)
-            lens = (starts[:, b + 1] - starts[:, b]).astype(np.int32)
-            if int(lens.sum()) == 0 and cfg.private_selection:
-                # Row-less on every shard: selection provably emits
-                # nothing.
-                continue
-            c_actual = min(C, P - b * C)
-            cfg_block = dataclasses.replace(cfg, n_partitions=c_actual)
-            yield (b, _sharded_block_kernel(
-                spk_all, pair_all, cols_all, leaf_all, jnp.asarray(lo),
-                jnp.asarray(lens), b * C, min_v, max_v, mid, stds,
-                jax.random.fold_in(final_key, b), cfg_block,
-                round_capacity(int(lens.max())), mesh, secure_tables))
+    def run_range(base, C, gen, end):
+        n_blocks = -(-(end - base) // C)
+        if gen == 0 and C == C0:
+            # Generation 0 starts at base 0 with capacity C0, so the
+            # offsets fused into pass 1 are a prefix of the plan. (A
+            # resumed plan journaled under a different capacity — the
+            # _load_plan override warning — recomputes instead.)
+            starts_r = starts0[:, :n_blocks + 1]
+        else:
+            starts_r = host_fetch(
+                _sharded_block_offsets(
+                    spk_all, jnp.asarray(_block_boundaries(base, C,
+                                                           n_blocks)),
+                    mesh)).reshape(n_shards, n_blocks + 1)
 
-    _dispatch_blocks(block_iter(), consume)
+        def consume(j, result):
+            b_base = base + j * C
+            if isinstance(result, _Replay):
+                append_record(result.record)
+                drain.end_block()
+                return
+            n_kept, ids_sorted, outputs_sorted = result
+            k = int(n_kept)  # sync; gates O(kept) transfers
+            if journal is not None:
+                record = rt_journal.BlockRecord(
+                    ids=np.asarray(ids_sorted[:k]).astype(np.int64) + b_base,
+                    outputs={
+                        name: np.asarray(col[:k])
+                        for name, col in outputs_sorted.items()
+                    })
+                journal.put(job, rt_journal.block_key(b_base, C), record)
+                append_record(record)
+            elif k:
+                drain.stage(kept_ids, ids_sorted[:k],
+                            lambda h, base_=b_base: h.astype(np.int64) +
+                            base_)
+                for name, col in outputs_sorted.items():
+                    drain.stage(kept_outputs.setdefault(name, []), col[:k])
+            drain.end_block()
+
+        def block_iter():
+            for j in range(n_blocks):
+                b_base = base + j * C
+                if journal is not None:
+                    record = journal.get(job,
+                                         rt_journal.block_key(b_base, C))
+                    if record is not None:
+                        rt_telemetry.record("journal_replays")
+                        yield (j, _Replay(record))
+                        continue
+                lo = starts_r[:, j].astype(np.int32)
+                lens = (starts_r[:, j + 1] - starts_r[:, j]).astype(np.int32)
+                if int(lens.sum()) == 0 and cfg.private_selection:
+                    # Row-less on every shard: selection provably emits
+                    # nothing.
+                    continue
+                c_actual = min(C, end - b_base)
+                cfg_block = dataclasses.replace(cfg, n_partitions=c_actual)
+                yield (j, functools.partial(
+                    _sharded_block_kernel, spk_all, pair_all, cols_all,
+                    leaf_all, jnp.asarray(lo), jnp.asarray(lens), b_base,
+                    min_v, max_v, mid, stds,
+                    _block_noise_key(final_key, gen, j), cfg_block,
+                    round_capacity(int(lens.max())), mesh, secure_tables))
+
+        _dispatch_blocks(block_iter(), consume, retry_policy=retry)
+
+    rt_retry.run_with_degradation(run_range, P, C0, journal=journal,
+                                  job_id=job)
     drain.materialize()
 
     kept = (np.concatenate(kept_ids) if kept_ids else np.zeros(0, np.int64))
@@ -627,7 +848,12 @@ def select_partitions_blocked_sharded(mesh,
                                       selection,
                                       *,
                                       block_partitions: int = 1 << 20,
-                                      reshard: str = "auto"
+                                      reshard: str = "auto",
+                                      retry: Optional[
+                                          rt_retry.RetryPolicy] = None,
+                                      journal: Optional[
+                                          rt_journal.BlockJournal] = None,
+                                      job_id: Optional[str] = None
                                       ) -> np.ndarray:
     """select_partitions_blocked over a device mesh.
 
@@ -658,42 +884,80 @@ def select_partitions_blocked_sharded(mesh,
     pid, pk, _, valid = stage_rows_to_mesh(mesh, pid, pk, dummy_values,
                                            valid, reshard)
 
-    C = min(block_partitions, P)
-    n_blocks = -(-P // C)
-    boundaries = np.minimum(
-        np.arange(n_blocks + 1, dtype=np.int64) * C,
-        np.iinfo(np.int32).max).astype(np.int32)
-    spk_all, starts = _sharded_select_compact(pid, pk, valid, key_l0,
-                                              jnp.asarray(boundaries), l0, P,
-                                              mesh)
-    starts = host_fetch(starts).reshape(n_shards, n_blocks + 1)
+    C0 = min(block_partitions, P)
+    n_blocks0 = -(-P // C0)
+    spk_all, starts = _sharded_select_compact(
+        pid, pk, valid, key_l0,
+        jnp.asarray(_block_boundaries(0, C0, n_blocks0)), l0, P, mesh)
+    starts0 = host_fetch(starts).reshape(n_shards, n_blocks0 + 1)
 
     kept_ids = []
+    job = job_id or "select_partitions_blocked_sharded"
 
     drain = _StagedDrain()
 
-    def consume(b, result):
-        n_kept, order = result
-        k = int(n_kept)  # sync; gates the O(kept) transfer
-        if k:
-            drain.stage(kept_ids, order[:k],
-                        lambda h, base=b * C: h.astype(np.int64) + base)
-        drain.end_block()
+    def run_range(base, C, gen, end):
+        n_blocks = -(-(end - base) // C)
+        if gen == 0 and C == C0:
+            # Generation 0 starts at base 0 with capacity C0, so the
+            # offsets fused into pass 1 are a prefix of the plan. (A
+            # resumed plan journaled under a different capacity — the
+            # _load_plan override warning — recomputes instead.)
+            starts_r = starts0[:, :n_blocks + 1]
+        else:
+            starts_r = host_fetch(
+                _sharded_block_offsets(
+                    spk_all, jnp.asarray(_block_boundaries(base, C,
+                                                           n_blocks)),
+                    mesh)).reshape(n_shards, n_blocks + 1)
 
-    def block_iter():
-        for b in range(n_blocks):
-            lo = starts[:, b].astype(np.int32)
-            lens = (starts[:, b + 1] - starts[:, b]).astype(np.int32)
-            if int(lens.sum()) == 0:
-                # Row-less on every shard: keep probability is 0.
-                continue
-            c_actual = min(C, P - b * C)
-            yield (b, _sharded_selection_block(
-                spk_all, jnp.asarray(lo), jnp.asarray(lens), b * C,
-                c_actual, jax.random.fold_in(key_sel, b), selection,
-                round_capacity(int(lens.max())), mesh))
+        def consume(j, result):
+            b_base = base + j * C
+            if isinstance(result, _Replay):
+                if result.record.n_kept:
+                    kept_ids.append(result.record.ids)
+                drain.end_block()
+                return
+            n_kept, order = result
+            k = int(n_kept)  # sync; gates the O(kept) transfer
+            if journal is not None:
+                ids = np.asarray(order[:k]).astype(np.int64) + b_base
+                journal.put(job, rt_journal.block_key(b_base, C),
+                            rt_journal.BlockRecord(ids=ids, outputs={}))
+                if k:
+                    kept_ids.append(ids)
+            elif k:
+                drain.stage(kept_ids, order[:k],
+                            lambda h, base_=b_base: h.astype(np.int64) +
+                            base_)
+            drain.end_block()
 
-    _dispatch_blocks(block_iter(), consume)
+        def block_iter():
+            for j in range(n_blocks):
+                b_base = base + j * C
+                if journal is not None:
+                    record = journal.get(job,
+                                         rt_journal.block_key(b_base, C))
+                    if record is not None:
+                        rt_telemetry.record("journal_replays")
+                        yield (j, _Replay(record))
+                        continue
+                lo = starts_r[:, j].astype(np.int32)
+                lens = (starts_r[:, j + 1] - starts_r[:, j]).astype(np.int32)
+                if int(lens.sum()) == 0:
+                    # Row-less on every shard: keep probability is 0.
+                    continue
+                c_actual = min(C, end - b_base)
+                yield (j, functools.partial(
+                    _sharded_selection_block, spk_all, jnp.asarray(lo),
+                    jnp.asarray(lens), b_base, c_actual,
+                    _block_noise_key(key_sel, gen, j), selection,
+                    round_capacity(int(lens.max())), mesh))
+
+        _dispatch_blocks(block_iter(), consume, retry_policy=retry)
+
+    rt_retry.run_with_degradation(run_range, P, C0, journal=journal,
+                                  job_id=job)
     drain.materialize()
 
     if not kept_ids:
@@ -709,7 +973,11 @@ def select_partitions_blocked(pid,
                               n_partitions: int,
                               selection,
                               *,
-                              block_partitions: int = 1 << 20
+                              block_partitions: int = 1 << 20,
+                              retry: Optional[rt_retry.RetryPolicy] = None,
+                              journal: Optional[
+                                  rt_journal.BlockJournal] = None,
+                              job_id: Optional[str] = None
                               ) -> np.ndarray:
     """Standalone DP partition selection over a huge partition space.
 
@@ -732,43 +1000,67 @@ def select_partitions_blocked(pid,
         jnp.asarray(_pad_to(pid, cap, 0)), jnp.asarray(_pad_to(pk, cap, 0)),
         jnp.asarray(_pad_to(valid, cap, False)), key_l0, l0, P)
 
-    C = min(block_partitions, P)
-    n_blocks = -(-P // C)
-    # int64 boundaries clamped into int32 range: same overflow guard as
-    # aggregate_blocked (P within one block of 2^31).
-    boundaries = np.minimum(
-        np.arange(n_blocks + 1, dtype=np.int64) * C,
-        np.iinfo(np.int32).max).astype(np.int32)
-    block_starts = host_fetch(
-        jnp.searchsorted(spk_sorted, jnp.asarray(boundaries), side="left"))
-
+    C0 = min(block_partitions, P)
     kept_ids = []
+    job = job_id or "select_partitions_blocked"
 
     drain = _StagedDrain()
 
-    def consume(b, result):
-        n_kept, order = result
-        k = int(n_kept)  # sync; gates the O(kept) transfer
-        if k:
-            drain.stage(kept_ids, order[:k],
-                        lambda h, base=b * C: h.astype(np.int64) + base)
-        drain.end_block()
+    def run_range(base, C, gen, end):
+        n_blocks = -(-(end - base) // C)
+        block_starts = host_fetch(
+            jnp.searchsorted(spk_sorted,
+                             jnp.asarray(_block_boundaries(base, C,
+                                                           n_blocks)),
+                             side="left"))
 
-    def block_iter():
-        for b in range(n_blocks):
-            lo, hi = int(block_starts[b]), int(block_starts[b + 1])
-            if lo == hi:
-                # Selection keeps empty partitions with probability 0
-                # (selection_ops.keep_probabilities: n <= 0 -> 0):
-                # row-less blocks provably emit nothing.
-                continue
-            c_actual = min(C, P - b * C)
-            yield (b, _selection_block_kernel(
-                spk_sorted, lo, hi - lo, b * C, c_actual,
-                jax.random.fold_in(key_sel, b), selection,
-                round_capacity(hi - lo)))
+        def consume(j, result):
+            b_base = base + j * C
+            if isinstance(result, _Replay):
+                if result.record.n_kept:
+                    kept_ids.append(result.record.ids)
+                drain.end_block()
+                return
+            n_kept, order = result
+            k = int(n_kept)  # sync; gates the O(kept) transfer
+            if journal is not None:
+                ids = np.asarray(order[:k]).astype(np.int64) + b_base
+                journal.put(job, rt_journal.block_key(b_base, C),
+                            rt_journal.BlockRecord(ids=ids, outputs={}))
+                if k:
+                    kept_ids.append(ids)
+            elif k:
+                drain.stage(kept_ids, order[:k],
+                            lambda h, base_=b_base: h.astype(np.int64) +
+                            base_)
+            drain.end_block()
 
-    _dispatch_blocks(block_iter(), consume)
+        def block_iter():
+            for j in range(n_blocks):
+                b_base = base + j * C
+                if journal is not None:
+                    record = journal.get(job,
+                                         rt_journal.block_key(b_base, C))
+                    if record is not None:
+                        rt_telemetry.record("journal_replays")
+                        yield (j, _Replay(record))
+                        continue
+                lo, hi = int(block_starts[j]), int(block_starts[j + 1])
+                if lo == hi:
+                    # Selection keeps empty partitions with probability 0
+                    # (selection_ops.keep_probabilities: n <= 0 -> 0):
+                    # row-less blocks provably emit nothing.
+                    continue
+                c_actual = min(C, end - b_base)
+                yield (j, functools.partial(
+                    _selection_block_kernel, spk_sorted, lo, hi - lo,
+                    b_base, c_actual, _block_noise_key(key_sel, gen, j),
+                    selection, round_capacity(hi - lo)))
+
+        _dispatch_blocks(block_iter(), consume, retry_policy=retry)
+
+    rt_retry.run_with_degradation(run_range, P, C0, journal=journal,
+                                  job_id=job)
     drain.materialize()
 
     if not kept_ids:
@@ -796,7 +1088,10 @@ def aggregate_blocked(pid,
                       block_partitions: int = 1 << 20,
                       row_chunk: int = 1 << 24,
                       secure_tables=None,
-                      phase_times: Optional[dict] = None
+                      phase_times: Optional[dict] = None,
+                      retry: Optional[rt_retry.RetryPolicy] = None,
+                      journal: Optional[rt_journal.BlockJournal] = None,
+                      job_id: Optional[str] = None
                       ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
     """DP aggregation over an arbitrarily large partition space.
 
@@ -810,6 +1105,11 @@ def aggregate_blocked(pid,
     p2_drain, blocks_dispatched, total) — the profiling hook used by
     benchmarks/profile_large_p.py so the profiler times THIS code, not a
     replica. Adds one device sync after pass 1; leave None in production.
+
+    retry/journal/job_id: failure-semantics knobs (module docstring).
+    Journaled runs materialize each block's results at consume time (one
+    sync per block) so the record is durable immediately — the staged
+    drain's transfer overlap is traded for crash-resumability.
 
     Returns (kept_partition_ids int64[M], {metric: f[M]}).
     """
@@ -874,82 +1174,121 @@ def aggregate_blocked(pid,
         phase_times["p1_bound_compact"] = time.perf_counter() - t0
 
     # --- Pass 2: bin by partition block, finalize each block. -------------
-    t1 = time.perf_counter()
-    C = min(block_partitions, P)
-    n_blocks = -(-P // C)
     # Dropped rows carry an int32-max sentinel > P, so searchsorted over
-    # the compacted stream yields both block offsets AND the survivor count.
-    # Boundaries in int64 on host, clamped into int32 range for the device
-    # searchsorted: partition ids are < P <= int32 max and dropped rows
-    # carry the int32-max sentinel, so a clamped boundary still lands left
-    # of every sentinel. (Unclamped int32 arithmetic would overflow when P
-    # is within one block of 2^31 and silently drop the final blocks.)
-    boundaries = np.minimum(
-        np.arange(n_blocks + 1, dtype=np.int64) * C,
-        np.iinfo(np.int32).max).astype(np.int32)
-    block_starts = host_fetch(
-        jnp.searchsorted(spk_all, jnp.asarray(boundaries), side="left"))
-    if profiling:
-        phase_times["block_offsets"] = time.perf_counter() - t1
+    # the compacted stream yields both block offsets AND the survivor
+    # count (boundary overflow guard: _block_boundaries).
+    C0 = min(block_partitions, P)
     output_names = [name for e in cfg.plan for name in e.outputs]
     kept_ids = []
     kept_outputs = {name: [] for name in output_names}
+    job = job_id or "aggregate_blocked"
+    n_dispatched_total = 0
+    offsets_seconds = 0.0
 
     drain = _StagedDrain()
 
-    def consume(b, result):
-        n_kept, ids_sorted, outputs_sorted = result
-        ts = time.perf_counter()
-        k = int(n_kept)  # sync; gates O(kept) transfers
-        ta = time.perf_counter()
-        if k:
-            drain.stage(kept_ids, ids_sorted[:k],
-                        lambda h, base=b * C: h.astype(np.int64) + base)
-            for name, col in outputs_sorted.items():
-                drain.stage(kept_outputs.setdefault(name, []), col[:k])
-        drain.end_block()
-        if profiling:
-            # Sync wait (device still computing) and drain are attributed
-            # separately — conflating them would re-create the
-            # transfer-bound misdiagnosis this hook exists to prevent.
-            # Per-block drain time is stage/flush overhead (the O(kept)
-            # transfers are async and mostly land in the post-loop
-            # materialize() increment, or in end_block() flushes of
-            # blocks older than the window).
-            phase_times["p2_sync_wait"] = (
-                phase_times.get("p2_sync_wait", 0.0) + ta - ts)
-            phase_times["p2_drain"] = (phase_times.get("p2_drain", 0.0) +
-                                       time.perf_counter() - ta)
+    def append_record(record: rt_journal.BlockRecord):
+        if record.n_kept:
+            kept_ids.append(record.ids)
+            for name, col in record.outputs.items():
+                kept_outputs.setdefault(name, []).append(col)
 
-    def block_iter():
-        for b in range(n_blocks):
-            lo, hi = int(block_starts[b]), int(block_starts[b + 1])
-            if lo == hi and cfg.private_selection:
-                # Private selection keeps empty partitions with probability
-                # 0 (selection_ops.keep_probabilities: n <= 0 -> 0), so
-                # row-less blocks provably emit nothing — skip their device
-                # work. In the sparse 10^9-partition regime this skips
-                # nearly every block.
-                continue
-            c_actual = min(C, P - b * C)
-            cfg_block = dataclasses.replace(cfg, n_partitions=c_actual)
-            yield (b, _block_kernel_dev(spk_all, pair_all, cols_all,
-                                        leaf_all, lo, hi - lo, b * C, min_v,
-                                        max_v, mid, stds,
-                                        jax.random.fold_in(final_key, b),
-                                        cfg_block, round_capacity(hi - lo),
-                                        secure_tables))
+    def run_range(base, C, gen, end):
+        nonlocal n_dispatched_total, offsets_seconds
+        to = time.perf_counter()
+        n_blocks = -(-(end - base) // C)
+        block_starts = host_fetch(
+            jnp.searchsorted(spk_all,
+                             jnp.asarray(_block_boundaries(base, C,
+                                                           n_blocks)),
+                             side="left"))
+        offsets_seconds += time.perf_counter() - to
+
+        def consume(j, result):
+            b_base = base + j * C
+            if isinstance(result, _Replay):
+                append_record(result.record)
+                drain.end_block()
+                return
+            n_kept, ids_sorted, outputs_sorted = result
+            ts = time.perf_counter()
+            k = int(n_kept)  # sync; gates O(kept) transfers
+            ta = time.perf_counter()
+            if journal is not None:
+                # Journaled runs materialize per block (one sync each) so
+                # the record is durable the moment the block is consumed —
+                # the overlap the staged drain buys is traded for
+                # crash-resumability.
+                record = rt_journal.BlockRecord(
+                    ids=np.asarray(ids_sorted[:k]).astype(np.int64) +
+                    b_base,
+                    outputs={
+                        name: np.asarray(col[:k])
+                        for name, col in outputs_sorted.items()
+                    })
+                journal.put(job, rt_journal.block_key(b_base, C), record)
+                append_record(record)
+            elif k:
+                drain.stage(kept_ids, ids_sorted[:k],
+                            lambda h, base_=b_base: h.astype(np.int64) +
+                            base_)
+                for name, col in outputs_sorted.items():
+                    drain.stage(kept_outputs.setdefault(name, []), col[:k])
+            drain.end_block()
+            if profiling:
+                # Sync wait (device still computing) and drain are
+                # attributed separately — conflating them would re-create
+                # the transfer-bound misdiagnosis this hook exists to
+                # prevent. Per-block drain time is stage/flush overhead
+                # (the O(kept) transfers are async and mostly land in the
+                # post-loop materialize() increment, or in end_block()
+                # flushes of blocks older than the window).
+                phase_times["p2_sync_wait"] = (
+                    phase_times.get("p2_sync_wait", 0.0) + ta - ts)
+                phase_times["p2_drain"] = (phase_times.get("p2_drain", 0.0) +
+                                           time.perf_counter() - ta)
+
+        def block_iter():
+            for j in range(n_blocks):
+                b_base = base + j * C
+                if journal is not None:
+                    record = journal.get(job,
+                                         rt_journal.block_key(b_base, C))
+                    if record is not None:
+                        rt_telemetry.record("journal_replays")
+                        yield (j, _Replay(record))
+                        continue
+                lo, hi = int(block_starts[j]), int(block_starts[j + 1])
+                if lo == hi and cfg.private_selection:
+                    # Private selection keeps empty partitions with
+                    # probability 0 (selection_ops.keep_probabilities:
+                    # n <= 0 -> 0), so row-less blocks provably emit
+                    # nothing — skip their device work. In the sparse
+                    # 10^9-partition regime this skips nearly every block.
+                    continue
+                c_actual = min(C, end - b_base)
+                cfg_block = dataclasses.replace(cfg, n_partitions=c_actual)
+                yield (j, functools.partial(
+                    _block_kernel_dev, spk_all, pair_all, cols_all,
+                    leaf_all, lo, hi - lo, b_base, min_v, max_v, mid, stds,
+                    _block_noise_key(final_key, gen, j), cfg_block,
+                    round_capacity(hi - lo), secure_tables))
+
+        n_dispatched_total += _dispatch_blocks(block_iter(), consume,
+                                               retry_policy=retry)
 
     t2 = time.perf_counter()
-    n_dispatched = _dispatch_blocks(block_iter(), consume)
+    rt_retry.run_with_degradation(run_range, P, C0, journal=journal,
+                                  job_id=job)
     td = time.perf_counter()
     drain.materialize()
     if profiling:
         now = time.perf_counter()
+        phase_times["block_offsets"] = offsets_seconds
         phase_times["p2_drain"] = (phase_times.get("p2_drain", 0.0) +
                                    now - td)
         phase_times["p2_blocks_total"] = now - t2
-        phase_times["blocks_dispatched"] = n_dispatched
+        phase_times["blocks_dispatched"] = n_dispatched_total
         phase_times["total"] = now - t0
 
     # Each block emits kept partitions in ascending relative id (the compact

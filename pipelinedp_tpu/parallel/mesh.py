@@ -20,7 +20,9 @@ collective-reshard transfer discipline rests on:
     stage through the host.
 """
 
+import logging
 import threading
+import time
 from typing import Optional, Sequence
 
 import jax
@@ -85,16 +87,41 @@ def rows_per_shard(n: int, n_shards: int) -> int:
 _sanctioned_fetch = threading.local()
 
 
-def host_fetch(arr) -> np.ndarray:
+def host_fetch(arr, max_retries: int = 2) -> np.ndarray:
     """Sanctioned small device->host fetch for meshed control tables.
 
     Only O(D^2) / O(n_blocks) tables may cross here — never row data. The
     transfer-guard test forbids all other device->host materialization on
     the device-resident path, so any new fetch added outside this helper
     fails that test instead of silently re-introducing host staging.
+
+    Control-table fetches are sync points, so transient runtime failures
+    (a tunnel hiccup on a remote-attached chip) surface here; they are
+    retried a couple of times before propagating — the table is tiny, the
+    re-fetch is cheap, and losing a whole blocked run to one dropped
+    control-plane round trip is exactly the failure mode the runtime
+    package exists to remove.
     """
+    # Imported lazily: mesh is a leaf module most of the package imports.
+    from pipelinedp_tpu.runtime import retry as rt_retry
+    from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+
     _sanctioned_fetch.active = True
     try:
-        return np.asarray(arr)
+        attempt = 0
+        while True:
+            try:
+                return np.asarray(arr)
+            except Exception as e:  # noqa: BLE001 - classified below
+                if not rt_retry.is_transient(e) or attempt >= max_retries:
+                    raise
+                delay = min(0.05 * 2**attempt, 1.0)
+                attempt += 1
+                rt_telemetry.record("host_fetch_retries")
+                logging.warning(
+                    "control-table host fetch failed transiently (%s); "
+                    "retry %d/%d in %.2fs", type(e).__name__, attempt,
+                    max_retries, delay)
+                time.sleep(delay)
     finally:
         _sanctioned_fetch.active = False

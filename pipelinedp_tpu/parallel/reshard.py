@@ -53,6 +53,9 @@ from pipelinedp_tpu.parallel import mesh as mesh_lib
 from pipelinedp_tpu.parallel.mesh import (SHARD_AXIS, host_fetch,
                                           round_capacity, row_sharding,
                                           rows_per_shard, shard_map)
+from pipelinedp_tpu.runtime import faults as rt_faults
+from pipelinedp_tpu.runtime import retry as rt_retry
+from pipelinedp_tpu.runtime import telemetry as rt_telemetry
 
 # Fetches at or below this many elements are control-plane sized; the
 # transfer-guard treats anything larger as row data.
@@ -229,7 +232,23 @@ def stage_rows_to_mesh(mesh: Mesh, pid, pk, values, valid,
             pid, pk, values, valid = (jnp.asarray(pid), jnp.asarray(pk),
                                       jnp.asarray(values),
                                       jnp.asarray(valid))
-        return device_reshard_rows_by_pid(mesh, pid, pk, values, valid)
+        try:
+            rt_faults.maybe_fail("collective")
+            return device_reshard_rows_by_pid(mesh, pid, pk, values, valid)
+        except Exception as e:  # noqa: BLE001 - classified below
+            if not _is_collective_failure(e):
+                raise
+            rt_telemetry.record("reshard_host_fallbacks")
+            logging.warning(
+                "device collective reshard failed (%s: %s); gracefully "
+                "degrading to the host LPT permutation — rows stage "
+                "through the host for this aggregation (one O(rows) "
+                "round trip), results are unchanged.", type(e).__name__,
+                str(e).splitlines()[0][:200])
+            # host_fetch = the sanctioned materialization channel; the
+            # fallback legitimately moves rows through the host.
+            pid, pk, values, valid = (host_fetch(pid), host_fetch(pk),
+                                      host_fetch(values), host_fetch(valid))
     from pipelinedp_tpu.parallel import sharded
     values = np.asarray(values)
     if values_dtype is not None:
@@ -242,6 +261,21 @@ def stage_rows_to_mesh(mesh: Mesh, pid, pk, values, valid,
             jax.device_put(jnp.asarray(pk), sharding),
             jax.device_put(jnp.asarray(values), sharding),
             jax.device_put(jnp.asarray(valid), sharding))
+
+
+def _is_collective_failure(exc: BaseException) -> bool:
+    """Failures worth degrading to the host reshard for: the injected
+    collective fault, transient runtime failures, or an error naming the
+    exchange itself. Programming errors (shape/type) must propagate."""
+    if isinstance(exc, rt_faults.InjectedCollectiveError):
+        return True
+    if isinstance(exc, rt_faults.InjectedFault):
+        return False
+    if rt_retry.is_transient(exc):
+        return True
+    msg = str(exc)
+    return any(marker in msg for marker in ("all_to_all", "all-to-all",
+                                            "collective", "AllToAll"))
 
 
 @contextlib.contextmanager

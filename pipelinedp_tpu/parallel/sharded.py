@@ -38,6 +38,7 @@ from pipelinedp_tpu import executor
 from pipelinedp_tpu.ops import selection_ops
 from pipelinedp_tpu.parallel.mesh import SHARD_AXIS, round_capacity, shard_map
 from pipelinedp_tpu.parallel.reshard import stage_rows_to_mesh
+from pipelinedp_tpu.runtime import retry as rt_retry
 
 
 def shard_rows_by_pid(pid: np.ndarray, pk: np.ndarray, values: np.ndarray,
@@ -163,7 +164,8 @@ def _sharded_select_kernel(pid, pk, valid, rng_key, l0: int,
 def sharded_select_partitions(mesh: Mesh, pid, pk, valid, rng_key, l0: int,
                               n_partitions: int,
                               selection: selection_ops.SelectionParams,
-                              reshard: str = "auto"):
+                              reshard: str = "auto",
+                              retry: rt_retry.RetryPolicy = None):
     """Standalone partition selection over the mesh: shard rows by privacy
     id (on-device all_to_all for device-resident inputs, host LPT
     permutation otherwise — see stage_rows_to_mesh), count shard-locally
@@ -181,14 +183,19 @@ def sharded_select_partitions(mesh: Mesh, pid, pk, valid, rng_key, l0: int,
         dummy_values = np.zeros((len(pid), 0), np.float32)
     pid, pk, _, valid = stage_rows_to_mesh(mesh, pid, pk, dummy_values,
                                            valid, reshard)
-    return _sharded_select_kernel(pid, pk, valid, rng_key, l0, n_partitions,
-                                  selection, mesh)
+    # Retried dispatches reuse the identical rng_key: a retry is a replay
+    # of the same selection decisions, never a second draw.
+    return rt_retry.retry_call(
+        lambda: _sharded_select_kernel(pid, pk, valid, rng_key, l0,
+                                       n_partitions, selection, mesh),
+        retry, what="sharded select_partitions dispatch")
 
 
 def sharded_aggregate_arrays(mesh: Mesh, pid, pk, values, valid, min_v, max_v,
                              min_s, max_s, mid, stds, rng_key,
                              cfg: executor.KernelConfig, secure_tables=None,
-                             reshard: str = "auto"):
+                             reshard: str = "auto",
+                             retry: rt_retry.RetryPolicy = None):
     """Shards rows by pid over `mesh` and runs the two-phase fused program.
 
     Accepts host numpy arrays or device-resident jax arrays (any length);
@@ -200,6 +207,10 @@ def sharded_aggregate_arrays(mesh: Mesh, pid, pk, values, valid, min_v, max_v,
     pid, pk, values, valid = stage_rows_to_mesh(
         mesh, pid, pk, values, valid, reshard,
         values_dtype=np.dtype(executor._ftype()))
-    return _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s,
-                           mid, jnp.asarray(stds), rng_key, cfg, mesh,
-                           secure_tables)
+    # Retried dispatches reuse the identical rng_key, so the redrawn noise
+    # is bit-identical — a retry replays the same release.
+    return rt_retry.retry_call(
+        lambda: _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s,
+                                max_s, mid, jnp.asarray(stds), rng_key, cfg,
+                                mesh, secure_tables),
+        retry, what="sharded aggregation dispatch")

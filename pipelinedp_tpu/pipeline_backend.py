@@ -343,6 +343,28 @@ class TPUBackend(LocalBackend):
             a mesh the blocked path runs sharded (pid-sharded pass 1,
             one [C]-sized psum per partition block over ICI). None
             disables the routing.
+        retry: optional pipelinedp_tpu.runtime.RetryPolicy for transient
+            block-dispatch failures (None = the runtime default: 3
+            retries, bounded exponential backoff). A retried block
+            re-derives the same fold_in key and redraws bit-identical
+            noise — no second DP release, no budget re-spend. OOM on a
+            block kernel instead halves the partition block capacity and
+            re-plans; see README "Failure semantics".
+        journal: optional pipelinedp_tpu.runtime.BlockJournal. When set,
+            the blocked drivers record each consumed block's drained
+            O(kept) results keyed by (job_id, block); an interrupted run
+            re-invoked with the same journal + job_id resumes from the
+            last consumed block instead of restarting. Pair with
+            noise_seed for a deterministic resume (a journal without a
+            seed warns: only journaled blocks keep their original noise).
+        job_id: journal key namespace for this pipeline's aggregations.
+            None derives a digest of the static kernel config + seed —
+            pass explicit distinct ids when one pipeline runs several
+            identically-configured aggregations.
+        block_partitions: partition block capacity C of the blocked path
+            (None = the drivers' default, 2^20). The failure-domain knob:
+            smaller blocks mean finer-grained retry/journal/OOM-degrade
+            units at more dispatch overhead.
     """
 
     def __init__(self,
@@ -351,7 +373,11 @@ class TPUBackend(LocalBackend):
                  noise_seed: Optional[int] = None,
                  secure_noise: bool = False,
                  large_partition_threshold: Optional[int] = 1 << 21,
-                 reshard: str = "auto"):
+                 reshard: str = "auto",
+                 retry=None,
+                 journal=None,
+                 job_id: Optional[str] = None,
+                 block_partitions: Optional[int] = None):
         super().__init__(seed=noise_seed)
         if reshard not in ("auto", "host", "device"):
             raise ValueError(
@@ -362,6 +388,10 @@ class TPUBackend(LocalBackend):
         self.secure_noise = secure_noise
         self.large_partition_threshold = large_partition_threshold
         self.reshard = reshard
+        self.retry = retry
+        self.journal = journal
+        self.job_id = job_id
+        self.block_partitions = block_partitions
 
     @property
     def is_tpu(self) -> bool:

@@ -66,8 +66,11 @@ def make_pair_extractors(
     extractors from the params apply to element = x[1]."""
     enforced = getattr(metric_params, 'contribution_bounds_already_enforced',
                        False)
+    # Value-less metrics (COUNT/PRIVACY_ID_COUNT) use 0.0, not None: None
+    # becomes NaN in the float64 value column and the ingest boundary
+    # rejects non-finite values (columnar.nonfinite_value_rows).
     value_extractor = ((lambda x: metric_params.value_extractor(x[1]))
-                       if needs_value else (lambda x: None))
+                       if needs_value else (lambda x: 0.0))
     return data_extractors.DataExtractors(
         partition_extractor=lambda x: metric_params.partition_extractor(x[1]),
         privacy_id_extractor=_privacy_id_extractor(enforced),

@@ -1,0 +1,45 @@
+"""Fault-tolerant block-stream runtime.
+
+The blocked and sharded drivers (parallel/large_p.py, parallel/sharded.py)
+stream thousands of device blocks per job. This package owns their failure
+semantics:
+
+  * journal.BlockJournal — host-side record of each consumed block's
+    drained O(kept) results, keyed by (job_id, block key), so an
+    interrupted blocked run resumes from the last consumed block instead
+    of restarting (and re-releasing) everything.
+  * retry — bounded-exponential-backoff retry of transient dispatch/sync
+    failures. A retried block re-derives the SAME fold_in(final_key, b)
+    key and therefore redraws bit-identical noise: no second DP release,
+    no budget re-spend. OOM-classified failures are never retried at the
+    same shape — they surface as BlockOOMError so the driver can halve
+    the partition block capacity and re-plan (run_with_degradation).
+  * faults — deterministic fault injection (killed dispatches, OOMs,
+    collective failures, slow blocks) by schedule, used by the tests and
+    the multichip dryrun to prove the above under adversity.
+  * telemetry — process-wide counters (retries, degradations, fallbacks,
+    replays) recorded into bench receipts.
+
+The privacy invariants this package leans on are documented in README
+"Failure semantics": mechanisms register with the BudgetAccountant at
+graph-build time only, so retries can never double-spend the ledger
+(asserted via BudgetAccountant.no_new_mechanisms), and per-block noise
+keys are pure functions of (final_key, block), so re-execution of a block
+is a replay of the same release, not a second one.
+"""
+
+from pipelinedp_tpu.runtime import faults
+from pipelinedp_tpu.runtime import telemetry
+from pipelinedp_tpu.runtime.journal import BlockJournal
+from pipelinedp_tpu.runtime.retry import (BlockOOMError, RetryPolicy,
+                                          retry_call, run_with_degradation)
+
+__all__ = [
+    "BlockJournal",
+    "BlockOOMError",
+    "RetryPolicy",
+    "faults",
+    "retry_call",
+    "run_with_degradation",
+    "telemetry",
+]

@@ -1,0 +1,159 @@
+"""Deterministic fault injection for the block-stream runtime.
+
+Real block-stream failures — preempted dispatches, HBM OOM on a padded
+kernel, a dropped collective, a straggler block — are nondeterministic and
+environment-specific, so the retry/degradation/journal machinery cannot be
+regression-tested against them directly. This harness injects the same
+failure classes by SCHEDULE: a FaultSchedule lists (kind, block, times)
+triples, and the runtime's hook points (retry.retry_call, the blocked
+drivers' consume path, reshard.device_reshard_rows_by_pid) consult the
+active schedule and raise the corresponding typed exception. Each fault
+fires exactly `times` attempts and is then spent, so a retried block
+succeeds — the schedule is the deterministic script of the adversity, the
+assertions are on the recovery.
+
+Activation is scoped and thread-local:
+
+    with faults.inject(faults.FaultSchedule([
+            faults.Fault("dispatch", block=2, times=2),
+            faults.Fault("oom", block=5),
+            faults.Fault("collective"),
+    ])):
+        ... run the blocked aggregation ...
+
+Fault kinds and the exception they raise:
+  dispatch    InjectedDispatchError   transient; retried with backoff
+  consume     InjectedConsumeError    transient at the sync point (models
+                                      an async dispatch error surfacing at
+                                      host materialization); retried by
+                                      re-dispatching the SAME block key
+  oom         InjectedOOMError        never retried at the same shape;
+                                      drivers halve block capacity
+  collective  InjectedCollectiveError reshard falls back to the host path
+  fatal       InjectedFatalError      never retried — models a hard crash
+                                      (the journal-resume test case)
+  slow        (no exception)          sleeps `delay` seconds at dispatch
+"""
+
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import List, Optional
+
+from pipelinedp_tpu.runtime import telemetry
+
+
+class InjectedFault(RuntimeError):
+    """Base of all injected failures (never raised itself)."""
+
+
+class InjectedDispatchError(InjectedFault):
+    """Transient dispatch failure (preemption / runtime hiccup)."""
+
+
+class InjectedConsumeError(InjectedFault):
+    """Transient failure surfacing at the block's host sync point."""
+
+
+class InjectedOOMError(InjectedFault):
+    """RESOURCE_EXHAUSTED: the block kernel did not fit device memory."""
+
+
+class InjectedCollectiveError(InjectedFault):
+    """A mesh collective (all_to_all / psum fabric) failed."""
+
+
+class InjectedFatalError(InjectedFault):
+    """Unrecoverable failure — the run must abort (and later resume)."""
+
+
+_RAISES = {
+    "dispatch": InjectedDispatchError,
+    "consume": InjectedConsumeError,
+    "oom": InjectedOOMError,
+    "collective": InjectedCollectiveError,
+    "fatal": InjectedFatalError,
+}
+
+
+@dataclasses.dataclass
+class Fault:
+    """One scheduled fault: fires on `kind` hooks for block `block` (None =
+    the first block that reaches the hook), `times` attempts in a row."""
+    kind: str
+    block: Optional[int] = None
+    times: int = 1
+    delay: float = 0.0  # kind == "slow" only
+
+    def __post_init__(self):
+        if self.kind not in set(_RAISES) | {"slow"}:
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.times <= 0:
+            raise ValueError("times must be positive")
+
+
+class FaultSchedule:
+    """An ordered, consumable list of Faults."""
+
+    def __init__(self, faults: List[Fault]):
+        self._remaining = [[f, f.times] for f in faults]
+
+    def take(self, kind: str, block: int) -> Optional[Fault]:
+        """Consumes and returns the first pending fault matching (kind,
+        block); None if nothing is scheduled for this hook."""
+        for entry in self._remaining:
+            fault, left = entry
+            if left <= 0 or fault.kind != kind:
+                continue
+            if fault.block is not None and fault.block != block:
+                continue
+            entry[1] -= 1
+            return fault
+        return None
+
+    def pending(self) -> int:
+        """Number of fault firings not yet consumed."""
+        return sum(left for _, left in self._remaining)
+
+
+_active = threading.local()
+
+
+def active() -> Optional[FaultSchedule]:
+    return getattr(_active, "schedule", None)
+
+
+@contextlib.contextmanager
+def inject(schedule: FaultSchedule):
+    """Activates `schedule` for the current thread within the scope."""
+    prev = active()
+    _active.schedule = schedule
+    try:
+        yield schedule
+    finally:
+        _active.schedule = prev
+
+
+def maybe_fail(kind: str, block: int = 0) -> None:
+    """Hook point: raises the scheduled exception if a fault is pending."""
+    schedule = active()
+    if schedule is None:
+        return
+    fault = schedule.take(kind, block)
+    if fault is not None:
+        telemetry.record("injected_faults")
+        raise _RAISES[kind](
+            f"injected {kind} fault at block {block} "
+            f"(attempt schedule: {fault.times} firing(s))")
+
+
+def maybe_sleep(block: int = 0) -> None:
+    """Hook point for 'slow' faults: stalls the dispatch by fault.delay."""
+    schedule = active()
+    if schedule is None:
+        return
+    fault = schedule.take("slow", block)
+    if fault is not None:
+        telemetry.record("injected_faults")
+        time.sleep(fault.delay)

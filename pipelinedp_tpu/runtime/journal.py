@@ -1,0 +1,132 @@
+"""Host-side block journal: consumed-block results keyed by (job, block).
+
+A blocked run over P/C blocks is a long stream of independent device
+dispatches; a crash at block 900 of 1000 should not cost the first 899 —
+and privacy-wise it MUST not: re-executing consumed blocks under a fresh
+run would redraw noise for partitions whose noisy values may already have
+left the process (a second release). The journal records each consumed
+block's drained O(kept) results; on resume the driver replays journaled
+blocks from the host record and dispatches only the remainder.
+
+Record keys are "base:capacity" (the block's first partition and the
+partition block capacity it ran under), not bare block indices: after an
+OOM degradation the same index means a different partition range, and a
+replay must only ever hit a record of the exact same block geometry.
+
+The journal is deliberately dumb storage — dict in memory, one .npz per
+record when a directory is given (written atomically via os.replace so a
+crash mid-write never leaves a truncated record). Resume across processes
+requires a directory, a stable job_id, and a deterministic noise key
+(TPUBackend(noise_seed=...)); resume within a process needs only the same
+BlockJournal instance.
+"""
+
+import dataclasses
+import os
+import re
+import tempfile
+from typing import Dict, Iterable, Optional, Tuple
+
+import numpy as np
+
+_OUT_PREFIX = "out__"
+
+
+@dataclasses.dataclass
+class BlockRecord:
+    """One consumed block: absolute kept partition ids + output columns
+    (empty dict for selection-only blocks)."""
+    ids: np.ndarray
+    outputs: Dict[str, np.ndarray]
+
+    @property
+    def n_kept(self) -> int:
+        return len(self.ids)
+
+
+def block_key(base: int, capacity: int) -> str:
+    """Geometry-qualified journal key of one block."""
+    return f"{base}:{capacity}"
+
+
+def _safe(token: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", str(token))
+
+
+class BlockJournal:
+    """In-memory (optionally directory-backed) record of consumed blocks."""
+
+    def __init__(self, directory: Optional[str] = None):
+        self._mem: Dict[Tuple[str, str], BlockRecord] = {}
+        self._dir = directory
+        if directory is not None:
+            os.makedirs(directory, exist_ok=True)
+
+    def _path(self, job_id: str, key: str) -> str:
+        return os.path.join(self._dir, f"{_safe(job_id)}__{_safe(key)}.npz")
+
+    def put(self, job_id: str, key: str, record: BlockRecord) -> None:
+        self._mem[(job_id, key)] = record
+        if self._dir is None:
+            return
+        payload = {"ids": record.ids}
+        for name, col in record.outputs.items():
+            payload[_OUT_PREFIX + name] = col
+        # Atomic write: a crash mid-save must leave either the old record
+        # or none, never a truncated npz that poisons the resume.
+        fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **payload)
+            os.replace(tmp, self._path(job_id, key))
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    def get(self, job_id: str, key: str) -> Optional[BlockRecord]:
+        record = self._mem.get((job_id, key))
+        if record is not None or self._dir is None:
+            return record
+        path = self._path(job_id, key)
+        if not os.path.exists(path):
+            return None
+        with np.load(path, allow_pickle=False) as data:
+            record = BlockRecord(
+                ids=data["ids"],
+                outputs={
+                    name[len(_OUT_PREFIX):]: data[name]
+                    for name in data.files if name.startswith(_OUT_PREFIX)
+                })
+        self._mem[(job_id, key)] = record
+        return record
+
+    def keys(self, job_id: str) -> Iterable[str]:
+        """Block keys recorded for a job (memory + directory; disk-only
+        records surface under their sanitized file-name form, which get()
+        resolves to the same file)."""
+        mem = {key for jid, key in self._mem if jid == job_id}
+        keys = set(mem)
+        if self._dir is not None:
+            sanitized_mem = {_safe(key) for key in mem}
+            prefix = _safe(job_id) + "__"
+            for name in os.listdir(self._dir):
+                if name.startswith(prefix) and name.endswith(".npz"):
+                    key = name[len(prefix):-len(".npz")]
+                    if key not in sanitized_mem:
+                        keys.add(key)
+        return sorted(keys)
+
+    def clear(self, job_id: Optional[str] = None) -> None:
+        """Drops records — all of them, or one job's."""
+        for jid, key in list(self._mem):
+            if job_id is None or jid == job_id:
+                del self._mem[(jid, key)]
+        if self._dir is None:
+            return
+        prefix = None if job_id is None else _safe(job_id) + "__"
+        for name in os.listdir(self._dir):
+            if not name.endswith(".npz"):
+                continue
+            if prefix is None or name.startswith(prefix):
+                os.unlink(os.path.join(self._dir, name))

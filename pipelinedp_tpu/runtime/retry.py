@@ -1,0 +1,249 @@
+"""Bounded-backoff retry + OOM degradation for block dispatch.
+
+Why naive re-execution is not an option here: redrawing fresh noise for a
+partition whose noisy value was already computed is a SECOND DP release of
+the same statistic, and re-running the graph-build (which is where
+mechanisms register) would double-spend the epsilon ledger. The retry
+discipline therefore has two halves:
+
+  * retry_call re-invokes the same dispatch closure. Every blocked driver
+    derives its block key as fold_in(final_key, b) — a pure function of
+    the run key and the block index — so the retried kernel redraws
+    bit-identical noise: the retry is a replay of the SAME release.
+    (JAX-Privacy's deterministic step-keyed noise is the same foundation.)
+  * OOM-classified failures are never retried at the same shape (the same
+    allocation would fail again); they surface as BlockOOMError so
+    run_with_degradation can halve the partition block capacity and
+    re-plan the REMAINING partition range. Re-planned blocks draw fresh
+    keys — sound, because the OOM'd dispatch never produced (let alone
+    released) an output for those partitions.
+
+Error classification is by marker substrings over the PJRT/XLA exception
+text (there is no stable cross-version exception taxonomy to type-match)
+plus the injection harness's typed exceptions.
+"""
+
+import dataclasses
+import logging
+import time
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from pipelinedp_tpu.runtime import faults
+from pipelinedp_tpu.runtime import journal as journal_lib
+from pipelinedp_tpu.runtime import telemetry
+
+# PJRT status markers of failures worth re-dispatching: the runtime came
+# back (or will), the program itself is fine.
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+    "ABORTED",
+    "CANCELLED",
+    "connection reset",
+    "socket closed",
+    "Broken pipe",
+    "preempted",
+)
+
+# Markers of allocation failure: retrying the identical shape re-fails.
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Resource exhausted",
+    "out of memory",
+    "OOM",
+    "Out of memory",
+)
+
+
+class BlockOOMError(RuntimeError):
+    """A block kernel exceeded device memory.
+
+    `block` is the index of the failed block within the current plan; all
+    earlier blocks of the plan were consumed (their results drained and,
+    when journaling, recorded) before this was raised, so the driver can
+    re-plan from exactly this block's base partition.
+    """
+
+    def __init__(self, block: int, cause: BaseException):
+        super().__init__(f"block {block} kernel exceeded device memory: "
+                         f"{type(cause).__name__}: {cause}")
+        self.block = block
+        self.cause = cause
+
+
+def is_oom(exc: BaseException) -> bool:
+    if isinstance(exc, (faults.InjectedOOMError, MemoryError)):
+        return True
+    if isinstance(exc, faults.InjectedFault):
+        return False
+    msg = str(exc)
+    return any(marker in msg for marker in _OOM_MARKERS)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Whether re-dispatching the same program can plausibly succeed."""
+    if isinstance(exc,
+                  (faults.InjectedDispatchError, faults.InjectedConsumeError,
+                   faults.InjectedCollectiveError)):
+        return True
+    if isinstance(exc, faults.InjectedFault):  # oom / fatal
+        return False
+    if is_oom(exc):
+        return False
+    msg = str(exc)
+    return any(marker in msg for marker in _TRANSIENT_MARKERS)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff: base * multiplier^attempt, capped."""
+    max_retries: int = 3
+    base_delay: float = 0.05
+    multiplier: float = 2.0
+    max_delay: float = 2.0
+
+    def delay(self, attempt: int) -> float:
+        return min(self.base_delay * self.multiplier**attempt,
+                   self.max_delay)
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+
+def retry_call(fn: Callable,
+               policy: Optional[RetryPolicy] = None,
+               *,
+               block: int = 0,
+               what: str = "block dispatch",
+               counter: str = "block_retries",
+               sleep: Callable[[float], None] = time.sleep):
+    """Calls fn(), retrying transient failures with bounded backoff.
+
+    Consults the fault-injection hooks before each attempt (so scheduled
+    dispatch faults and slow blocks fire here). Non-transient errors —
+    OOMs included — propagate to the caller immediately.
+    """
+    policy = policy or DEFAULT_POLICY
+    attempt = 0
+    while True:
+        try:
+            faults.maybe_fail("fatal", block)
+            faults.maybe_fail("oom", block)
+            faults.maybe_fail("dispatch", block)
+            faults.maybe_sleep(block)
+            return fn()
+        except Exception as e:  # noqa: BLE001 - classified below
+            if not is_transient(e) or attempt >= policy.max_retries:
+                raise
+            delay = policy.delay(attempt)
+            attempt += 1
+            telemetry.record(counter)
+            logging.warning(
+                "%s failed transiently at block %d (%s: %s); retry %d/%d "
+                "in %.2fs — the retried kernel re-derives the same block "
+                "key, so noise is bit-identical (no second release)", what,
+                block, type(e).__name__,
+                str(e).splitlines()[0][:160], attempt, policy.max_retries,
+                delay)
+            sleep(delay)
+
+
+# Journal key of the per-job plan-history record (flattened
+# [base, capacity, generation] triples in BlockRecord.ids).
+PLAN_KEY = "__plan__"
+
+
+def _load_plan(journal, job_id: str,
+               block_partitions: int) -> List[List[int]]:
+    if journal is None:
+        return [[0, block_partitions, 0]]
+    record = journal.get(job_id, PLAN_KEY)
+    if record is None or record.ids.size == 0:
+        return [[0, block_partitions, 0]]
+    ranges = [
+        list(map(int, triple))
+        for triple in np.asarray(record.ids).reshape(-1, 3)
+    ]
+    if ranges[0][1] != block_partitions:
+        logging.warning(
+            "journaled plan starts at block capacity %d; overriding "
+            "block_partitions=%d so the resumed run replays the exact "
+            "geometry (and keys) of the interrupted one.", ranges[0][1],
+            block_partitions)
+    return ranges
+
+
+def _save_plan(journal, job_id: str, ranges: List[List[int]]) -> None:
+    if journal is None:
+        return
+    journal.put(
+        job_id, PLAN_KEY,
+        journal_lib.BlockRecord(ids=np.asarray(ranges,
+                                               dtype=np.int64).reshape(-1),
+                                outputs={}))
+
+
+def run_with_degradation(run_range: Callable[[int, int, int, int], None],
+                         n_partitions: int,
+                         block_partitions: int,
+                         min_block_partitions: int = 8,
+                         journal=None,
+                         job_id: Optional[str] = None) -> int:
+    """Drives a blocked pass with OOM-halving re-planning.
+
+    run_range(base, capacity, generation, end) must process partitions
+    [base, end) in blocks of `capacity`, raising BlockOOMError (with the
+    failed in-plan block index) after consuming every block that
+    completed before the failure. On OOM the capacity halves and the
+    remaining range re-plans under the next generation — generation feeds
+    the block key derivation so a re-planned block never reuses a key a
+    differently-shaped block already consumed.
+
+    The plan history (the (base, capacity, generation) ranges entered) is
+    itself journaled BEFORE each degraded range runs: a run that degrades
+    and then crashes resumes under the exact degraded geometry —
+    journaled blocks replay by their (base, capacity) keys, unjournaled
+    blocks dispatch with the very keys the interrupted run would have
+    used. Without this, a resume would re-plan from scratch and redraw
+    noise for partitions whose finer-geometry results were already
+    consumed — a second release. Ranges other than the last are fully
+    journaled by construction (every block consumed before an OOM is
+    recorded first). Undegraded runs save no plan record — the default
+    single-range plan is what a resume reconstructs anyway.
+
+    Returns the final block capacity (== block_partitions when no
+    degradation happened and no degraded plan was resumed).
+    """
+    ranges = _load_plan(journal, job_id, block_partitions)
+    idx = 0
+    while idx < len(ranges):
+        base, capacity, generation = ranges[idx]
+        last = idx + 1 >= len(ranges)
+        end = n_partitions if last else ranges[idx + 1][0]
+        try:
+            run_range(base, capacity, generation, end)
+        except BlockOOMError as e:
+            if not last:
+                # Historical ranges replay from the journal and cannot
+                # legitimately OOM; degrading here would fork the
+                # already-released geometry.
+                raise
+            new_base = base + e.block * capacity
+            if capacity // 2 < min_block_partitions:
+                raise
+            capacity //= 2
+            telemetry.record("block_oom_degradations")
+            logging.warning(
+                "block kernel OOM at partition base %d; halving partition "
+                "block capacity to %d and re-planning the remaining "
+                "%d partitions (generation %d). Already-consumed blocks "
+                "keep their drained results; re-planned partitions draw "
+                "fresh noise keys (nothing was released for them).",
+                new_base, capacity, n_partitions - new_base,
+                generation + 1)
+            ranges.append([new_base, capacity, generation + 1])
+            _save_plan(journal, job_id, ranges)
+        idx += 1
+    return ranges[-1][1]

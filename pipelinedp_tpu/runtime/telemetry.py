@@ -1,0 +1,48 @@
+"""Process-wide fault-tolerance counters.
+
+A flat Counter rather than per-run stats objects: the drivers that
+increment these live several layers below the entry points that want to
+report them (bench.py receipts, the dryrun), and threading a stats dict
+through every signature would couple all of them to the runtime. Counters
+are monotonically increasing per process; callers that want per-run deltas
+snapshot() before and after.
+
+Counter names used by the runtime:
+  block_retries            transient dispatch/sync failures retried
+  block_oom_degradations   partition block capacity halvings after OOM
+  reshard_host_fallbacks   device collective reshard -> host permutation
+  journal_replays          blocks served from the journal instead of
+                           re-dispatching
+  host_fetch_retries       transient control-table fetch failures retried
+  injected_faults          faults raised by the injection harness
+"""
+
+import collections
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+counters: "collections.Counter[str]" = collections.Counter()
+
+
+def record(name: str, n: int = 1) -> None:
+    with _lock:
+        counters[name] += n
+
+
+def snapshot() -> Dict[str, int]:
+    with _lock:
+        return dict(counters)
+
+
+def delta(before: Dict[str, int]) -> Dict[str, int]:
+    """Counter increments since a snapshot() (zero-valued keys omitted)."""
+    now = snapshot()
+    keys = set(now) | set(before)
+    out = {k: now.get(k, 0) - before.get(k, 0) for k in keys}
+    return {k: v for k, v in out.items() if v}
+
+
+def reset() -> None:
+    with _lock:
+        counters.clear()

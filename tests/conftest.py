@@ -22,3 +22,16 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    # Registered here (no pytest.ini in this repo) so -m filters stay
+    # warning-free. The tier-1 command runs `-m 'not slow'`, so `faults`
+    # tests — the fault-injection harness suite — are part of tier-1 by
+    # default and selectable alone with `-m faults`.
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (-m 'not slow')")
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection/robustness tests (runs in tier-1; "
+        "select alone with -m faults)")

@@ -1,0 +1,114 @@
+"""Empty-dataset and zero-kept-blocks edge cases across all four blocked/
+sharded drivers (aggregate_blocked, aggregate_blocked_sharded,
+select_partitions_blocked, select_partitions_blocked_sharded)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import combiners, executor
+from pipelinedp_tpu.aggregate_params import MechanismType
+from pipelinedp_tpu.ops import selection_ops
+from pipelinedp_tpu.parallel import large_p, make_mesh
+
+P = 300
+BLOCK = 64
+L0 = 4
+
+
+def _spec():
+    params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+                                 noise_kind=pdp.NoiseKind.LAPLACE,
+                                 max_partitions_contributed=L0,
+                                 max_contributions_per_partition=8,
+                                 min_value=0.0,
+                                 max_value=5.0)
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                           total_delta=1e-6)
+    compound = combiners.create_compound_combiner(params, accountant)
+    budget = accountant.request_budget(MechanismType.GENERIC)
+    accountant.compute_budgets()
+    selection = selection_ops.selection_params_from_host(
+        params.partition_selection_strategy, budget.eps, budget.delta, L0,
+        None)
+    cfg = executor.make_kernel_config(params, compound, P,
+                                      private_selection=True,
+                                      selection_params=selection)
+    stds = executor.compute_noise_stds(compound, params)
+    return cfg, stds, executor.kernel_scalars(params), selection
+
+
+def _empty():
+    return (np.zeros(0, np.int32), np.zeros(0, np.int32), np.zeros(0),
+            np.zeros(0, bool))
+
+
+def _all_invalid(n=500):
+    # Rows present but every one invalid: the selection keep probability
+    # of every partition is 0, so every driver must emit nothing.
+    rng = np.random.default_rng(0)
+    return (rng.integers(0, 100, n).astype(np.int32),
+            rng.integers(0, P, n).astype(np.int32), rng.uniform(0, 5, n),
+            np.zeros(n, bool))
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(n_devices=8)
+
+
+class TestAggregateBlockedEdges:
+
+    @pytest.mark.parametrize("data", [_empty(), _all_invalid()],
+                             ids=["empty", "all_invalid"])
+    def test_zero_kept(self, data):
+        cfg, stds, (min_v, max_v, min_s, max_s, mid), _ = _spec()
+        kept, outputs = large_p.aggregate_blocked(
+            *data, min_v, max_v, min_s, max_s, mid, np.asarray(stds),
+            jax.random.PRNGKey(0), cfg, block_partitions=BLOCK)
+        assert kept.shape == (0,) and kept.dtype == np.int64
+        assert set(outputs) == {"count", "sum"}
+        assert all(len(col) == 0 for col in outputs.values())
+
+
+class TestAggregateBlockedShardedEdges:
+
+    @pytest.mark.parametrize("data", [_empty(), _all_invalid()],
+                             ids=["empty", "all_invalid"])
+    def test_zero_kept(self, mesh, data):
+        cfg, stds, (min_v, max_v, min_s, max_s, mid), _ = _spec()
+        kept, outputs = large_p.aggregate_blocked_sharded(
+            mesh, *data, min_v, max_v, min_s, max_s, mid, np.asarray(stds),
+            jax.random.PRNGKey(0), cfg, block_partitions=BLOCK)
+        assert kept.shape == (0,) and kept.dtype == np.int64
+        assert set(outputs) == {"count", "sum"}
+        assert all(len(col) == 0 for col in outputs.values())
+
+
+class TestSelectBlockedEdges:
+
+    @pytest.mark.parametrize("data", [_empty(), _all_invalid()],
+                             ids=["empty", "all_invalid"])
+    def test_zero_kept(self, data):
+        _, _, _, selection = _spec()
+        pid, pk, _, valid = data
+        kept = large_p.select_partitions_blocked(pid, pk, valid,
+                                                 jax.random.PRNGKey(1), L0,
+                                                 P, selection,
+                                                 block_partitions=BLOCK)
+        assert kept.shape == (0,) and kept.dtype == np.int64
+
+
+class TestSelectBlockedShardedEdges:
+
+    @pytest.mark.parametrize("data", [_empty(), _all_invalid()],
+                             ids=["empty", "all_invalid"])
+    def test_zero_kept(self, mesh, data):
+        _, _, _, selection = _spec()
+        pid, pk, _, valid = data
+        kept = large_p.select_partitions_blocked_sharded(
+            mesh, pid, pk, valid, jax.random.PRNGKey(1), L0, P, selection,
+            block_partitions=BLOCK)
+        assert kept.shape == (0,) and kept.dtype == np.int64

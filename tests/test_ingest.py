@@ -639,3 +639,95 @@ class TestChunkedEncoderProperty:
                     assert a == b, (a, b)
 
         run()
+
+
+class TestNonFiniteValueValidation:
+    """NaN/Inf in the VALUE column survives jnp.clip and silently poisons
+    sums; the ingest/columnar boundary must reject (default) or
+    drop-with-warning."""
+
+    def _cols(self):
+        pids = np.array(["u1", "u2", "u3", "u4"])
+        pks = np.array(["a", "a", "b", "b"])
+        vals = np.array([1.0, np.nan, np.inf, 2.0])
+        return pids, pks, vals
+
+    def test_encode_columns_rejects_by_default(self):
+        pids, pks, vals = self._cols()
+        with pytest.raises(ValueError, match="non-finite"):
+            columnar.encode_columns(pids, pks, vals)
+
+    def test_encode_columns_drop_policy_invalidates_rows(self, caplog):
+        pids, pks, vals = self._cols()
+        with caplog.at_level("WARNING"):
+            encoded = columnar.encode_columns(pids, pks, vals,
+                                              nonfinite="drop")
+        assert "dropping 2" in caplog.text
+        np.testing.assert_array_equal(encoded.valid,
+                                      [True, False, False, True])
+        assert np.isfinite(encoded.values).all()
+
+    def test_vector_values_any_bad_coordinate_drops_row(self):
+        pids = np.array(["u1", "u2"])
+        pks = np.array(["a", "b"])
+        vals = np.array([[1.0, np.nan], [2.0, 3.0]])
+        with pytest.raises(ValueError, match="non-finite"):
+            columnar.encode_columns(pids, pks, vals)
+        encoded = columnar.encode_columns(pids, pks, vals, nonfinite="drop")
+        np.testing.assert_array_equal(encoded.valid, [False, True])
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(ValueError, match="error|drop"):
+            columnar.nonfinite_value_rows(np.array([1.0]), policy="ignore")
+
+    def test_integer_values_never_checked(self):
+        assert columnar.nonfinite_value_rows(np.array([1, 2, 3])) is None
+
+    def test_stream_encode_rejects_and_drops(self):
+        chunks = lambda: iter([(np.array(["u1", "u2"]), np.array(["a", "b"]),
+                                np.array([1.0, np.inf]))])
+        with pytest.raises(ValueError, match="non-finite"):
+            ingest.stream_encode_columns(chunks())
+        encoded = ingest.stream_encode_columns(chunks(), nonfinite="drop")
+        np.testing.assert_array_equal(np.asarray(encoded.valid),
+                                      [True, False])
+        assert np.isfinite(np.asarray(encoded.values)).all()
+
+    def test_encode_shard_rejects_and_drops(self):
+        chunks = lambda: iter([(np.array(["u1", "u2"]), np.array(["a", "b"]),
+                                np.array([np.nan, 5.0]))])
+        with pytest.raises(ValueError, match="non-finite"):
+            ingest.encode_shard(chunks())
+        shard = ingest.encode_shard(chunks(), nonfinite="drop")
+        np.testing.assert_array_equal(shard.pk, [-1, 1])
+        assert np.isfinite(shard.values).all()
+
+    def test_dropped_rows_do_not_poison_engine_results(self):
+        # End to end: a poisoned row dropped at ingest leaves the other
+        # partitions' noise-free sums intact.
+        pids = np.array(["u%d" % (i % 30) for i in range(300)])
+        pks = np.array(["p%d" % (i % 3) for i in range(300)])
+        vals = np.ones(300)
+        vals[7] = np.nan
+        encoded = columnar.encode_columns(pids, pks, vals, nonfinite="drop")
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            noise_kind=pdp.NoiseKind.LAPLACE,
+            max_partitions_contributed=3,
+            max_contributions_per_partition=10,
+            min_value=0.0,
+            max_value=5.0)
+        extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                        partition_extractor=lambda r: r[1],
+                                        value_extractor=lambda r: r[2])
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=HUGE_EPS,
+                                               total_delta=1e-6)
+        engine = pdp.DPEngine(accountant, pdp.TPUBackend(noise_seed=3))
+        result = engine.aggregate(encoded, params, extractors)
+        accountant.compute_budgets()
+        out = dict(result)
+        assert len(out) == 3
+        for pk, metrics in out.items():
+            assert np.isfinite(metrics.sum)
+            expected = 100.0 - (1.0 if pk == "p1" else 0.0)
+            assert metrics.sum == pytest.approx(expected, abs=0.1)

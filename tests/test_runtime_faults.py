@@ -1,0 +1,533 @@
+"""Fault-tolerant blocked execution: journaled resume, deterministic-noise
+retry, graceful degradation — driven by the fault-injection harness
+(pipelinedp_tpu/runtime/)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import combiners, executor, runtime
+from pipelinedp_tpu.aggregate_params import MechanismType
+from pipelinedp_tpu.ops import selection_ops
+from pipelinedp_tpu.parallel import large_p, make_mesh
+from pipelinedp_tpu.runtime import faults, journal as journal_lib
+from pipelinedp_tpu.runtime import retry as retry_lib
+from pipelinedp_tpu.runtime import telemetry
+
+pytestmark = pytest.mark.faults
+
+
+def _spec(P, eps=1.0, l0=4, linf=8):
+    params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+                                 noise_kind=pdp.NoiseKind.LAPLACE,
+                                 max_partitions_contributed=l0,
+                                 max_contributions_per_partition=linf,
+                                 min_value=0.0,
+                                 max_value=5.0)
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=eps,
+                                           total_delta=1e-6)
+    compound = combiners.create_compound_combiner(params, accountant)
+    budget = accountant.request_budget(MechanismType.GENERIC)
+    accountant.compute_budgets()
+    selection = selection_ops.selection_params_from_host(
+        params.partition_selection_strategy, budget.eps, budget.delta, l0,
+        None)
+    cfg = executor.make_kernel_config(params, compound, P,
+                                      private_selection=True,
+                                      selection_params=selection)
+    stds = executor.compute_noise_stds(compound, params)
+    return cfg, stds, executor.kernel_scalars(params)
+
+
+def _data(n=20_000, n_ids=500, P=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    pid = rng.integers(0, n_ids, n).astype(np.int32)
+    pk = rng.integers(0, P, n).astype(np.int32)
+    values = rng.uniform(0, 5, n)
+    return pid, pk, values, np.ones(n, bool)
+
+
+# A fast policy so retry/backoff tests don't sleep for real.
+FAST = retry_lib.RetryPolicy(max_retries=3, base_delay=0.0, max_delay=0.0)
+
+
+class TestFaultSchedule:
+
+    def test_take_consumes_and_matches(self):
+        sched = faults.FaultSchedule([
+            faults.Fault("dispatch", block=2, times=2),
+            faults.Fault("oom"),
+        ])
+        assert sched.take("dispatch", 0) is None  # wrong block
+        assert sched.take("dispatch", 2) is not None
+        assert sched.take("dispatch", 2) is not None
+        assert sched.take("dispatch", 2) is None  # spent
+        assert sched.take("oom", 7) is not None  # block=None matches any
+        assert sched.pending() == 0
+
+    def test_inject_scopes_and_raises(self):
+        with faults.inject(faults.FaultSchedule([faults.Fault("oom")])):
+            with pytest.raises(faults.InjectedOOMError):
+                faults.maybe_fail("oom", 0)
+        faults.maybe_fail("oom", 0)  # no active schedule outside the scope
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            faults.Fault("meteor")
+
+
+class TestRetryClassification:
+
+    def test_markers(self):
+        assert retry_lib.is_oom(RuntimeError("RESOURCE_EXHAUSTED: hbm"))
+        assert not retry_lib.is_transient(RuntimeError("RESOURCE_EXHAUSTED"))
+        assert retry_lib.is_transient(RuntimeError("UNAVAILABLE: socket"))
+        assert not retry_lib.is_transient(ValueError("shape mismatch"))
+        assert retry_lib.is_oom(faults.InjectedOOMError("x"))
+        assert not retry_lib.is_transient(faults.InjectedFatalError("x"))
+
+    def test_retry_call_bounded(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise RuntimeError("UNAVAILABLE: flaky")
+
+        with pytest.raises(RuntimeError):
+            retry_lib.retry_call(fn, FAST, sleep=lambda _: None)
+        assert len(calls) == FAST.max_retries + 1
+
+    def test_no_new_mechanisms_guard(self):
+        acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0, total_delta=1e-6)
+        with acc.no_new_mechanisms("test"):
+            pass  # no registration: fine
+        with pytest.raises(AssertionError, match="double-spend"):
+            with acc.no_new_mechanisms("test"):
+                acc.request_budget(MechanismType.LAPLACE)
+
+
+class TestRetryDeterminism:
+    """A retried block redraws bit-identical noise: the faulted run's
+    outputs equal the fault-free run's exactly, noise included."""
+
+    def _run(self, retry=FAST, **kwargs):
+        P = 1000
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P)
+        pid, pk, values, valid = _data(P=P)
+        return large_p.aggregate_blocked(pid, pk, values, valid, min_v,
+                                         max_v, min_s, max_s, mid,
+                                         np.asarray(stds),
+                                         jax.random.PRNGKey(7), cfg,
+                                         block_partitions=128, retry=retry,
+                                         **kwargs)
+
+    def test_killed_dispatches_bit_identical_with_noise(self):
+        base_kept, base_out = self._run()
+        before = telemetry.snapshot()
+        sched = faults.FaultSchedule([
+            faults.Fault("dispatch", block=0, times=2),
+            faults.Fault("consume", block=2),
+            faults.Fault("slow", block=3, delay=0.01),
+        ])
+        with faults.inject(sched):
+            kept, out = self._run()
+        assert sched.pending() == 0
+        np.testing.assert_array_equal(base_kept, kept)
+        for name in base_out:
+            np.testing.assert_array_equal(base_out[name], out[name],
+                                          err_msg=name)
+        delta = telemetry.delta(before)
+        assert delta.get("block_retries", 0) >= 3
+        assert delta.get("injected_faults", 0) == 4
+
+    def test_retries_exhaust_then_raise(self):
+        sched = faults.FaultSchedule([
+            faults.Fault("dispatch", block=1, times=FAST.max_retries + 1)
+        ])
+        with faults.inject(sched):
+            with pytest.raises(faults.InjectedDispatchError):
+                self._run()
+        assert sched.pending() == 0
+
+
+class TestOOMDegradation:
+    """OOM on a block kernel halves the partition block capacity and
+    re-plans instead of aborting; already-consumed blocks keep their
+    results.
+
+    Re-planned blocks legitimately draw FRESH selection/noise keys (their
+    OOM'd dispatch released nothing), so the parity data must make every
+    selection decision key-independent: dense partitions with 120 distinct
+    ids (keep probability ~1 at eps=30) and single-id partitions (keep
+    probability ~0), noise-free."""
+
+    DENSE = ((np.arange(12) * 77 + 5) % 1000).astype(np.int64)
+
+    def _run_noise_free(self, block_partitions=128):
+        P = 1000
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P, eps=30,
+                                                             linf=64)
+        n_per = 120
+        pid = (np.repeat(np.arange(n_per), len(self.DENSE)) * 1003 +
+               np.tile(np.arange(len(self.DENSE)), n_per)).astype(np.int32)
+        pk = np.tile(self.DENSE, n_per).astype(np.int32)
+        rng = np.random.default_rng(4)
+        values = rng.uniform(0, 5, len(pk))
+        pid = np.concatenate([pid, 900_000 + np.arange(5, dtype=np.int32)])
+        pk = np.concatenate(
+            [pk, ((np.arange(5) * 311 + 9) % P).astype(np.int32)])
+        values = np.concatenate([values, np.ones(5)])
+        valid = np.ones(len(pid), bool)
+        return large_p.aggregate_blocked(pid, pk, values, valid, min_v,
+                                         max_v, min_s, max_s, mid,
+                                         np.zeros_like(np.asarray(stds)),
+                                         jax.random.PRNGKey(5), cfg,
+                                         block_partitions=block_partitions,
+                                         retry=FAST)
+
+    def test_oom_halves_capacity_and_completes(self):
+        base_kept, base_out = self._run_noise_free()
+        np.testing.assert_array_equal(base_kept, np.sort(self.DENSE))
+        before = telemetry.snapshot()
+        with faults.inject(
+                faults.FaultSchedule([faults.Fault("oom", block=3)])):
+            kept, out = self._run_noise_free()
+        np.testing.assert_array_equal(base_kept, kept)
+        np.testing.assert_allclose(base_out["count"], out["count"],
+                                   atol=1e-9)
+        np.testing.assert_allclose(base_out["sum"], out["sum"], rtol=1e-6)
+        assert telemetry.delta(before).get("block_oom_degradations") == 1
+
+    def test_repeated_oom_keeps_halving(self):
+        before = telemetry.snapshot()
+        with faults.inject(
+                faults.FaultSchedule([
+                    faults.Fault("oom", block=2),
+                    faults.Fault("oom", block=0),
+                ])):
+            kept, _ = self._run_noise_free()
+        assert telemetry.delta(before).get("block_oom_degradations") == 2
+        base_kept, _ = self._run_noise_free()
+        np.testing.assert_array_equal(base_kept, kept)
+
+    def test_oom_below_floor_propagates(self):
+        # A schedule that OOMs every generation's first block until the
+        # capacity floor: the driver must stop degrading and raise.
+        with faults.inject(
+                faults.FaultSchedule(
+                    [faults.Fault("oom", times=64)])):
+            with pytest.raises(retry_lib.BlockOOMError):
+                self._run_noise_free(block_partitions=16)
+
+
+class TestJournalResume:
+
+    def _run(self, key=7, **kwargs):
+        P = 1000
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P)
+        pid, pk, values, valid = _data(P=P)
+        return large_p.aggregate_blocked(pid, pk, values, valid, min_v,
+                                         max_v, min_s, max_s, mid,
+                                         np.asarray(stds),
+                                         jax.random.PRNGKey(key), cfg,
+                                         block_partitions=128, retry=FAST,
+                                         **kwargs)
+
+    def test_fatal_crash_then_resume_bit_identical(self):
+        base_kept, base_out = self._run()
+        journal = runtime.BlockJournal()
+        with faults.inject(
+                faults.FaultSchedule([faults.Fault("fatal", block=5)])):
+            with pytest.raises(faults.InjectedFatalError):
+                self._run(journal=journal, job_id="job-resume")
+        consumed = list(journal.keys("job-resume"))
+        assert 0 < len(consumed) < 8  # partial progress was journaled
+        before = telemetry.snapshot()
+        kept, out = self._run(journal=journal, job_id="job-resume")
+        np.testing.assert_array_equal(base_kept, kept)
+        for name in base_out:
+            np.testing.assert_array_equal(base_out[name], out[name],
+                                          err_msg=name)
+        assert telemetry.delta(before).get("journal_replays") == \
+            len(consumed)
+
+    def test_resume_is_per_job(self):
+        journal = runtime.BlockJournal()
+        kept_a, _ = self._run(journal=journal, job_id="job-a")
+        # A different job id must not replay job-a's blocks.
+        before = telemetry.snapshot()
+        kept_b, _ = self._run(key=8, journal=journal, job_id="job-b")
+        assert telemetry.delta(before).get("journal_replays") is None
+        assert list(journal.keys("job-a")) == list(journal.keys("job-b"))
+        np.testing.assert_array_equal(kept_a, np.asarray(kept_a))
+        del kept_b
+
+    def test_directory_persistence_across_instances(self, tmp_path):
+        journal = runtime.BlockJournal(str(tmp_path))
+        record = journal_lib.BlockRecord(
+            ids=np.arange(5, dtype=np.int64),
+            outputs={"count": np.full(5, 2.0)})
+        journal.put("jobX", journal_lib.block_key(0, 64), record)
+        fresh = runtime.BlockJournal(str(tmp_path))
+        loaded = fresh.get("jobX", journal_lib.block_key(0, 64))
+        np.testing.assert_array_equal(loaded.ids, record.ids)
+        np.testing.assert_array_equal(loaded.outputs["count"],
+                                      record.outputs["count"])
+        fresh.clear("jobX")
+        assert runtime.BlockJournal(str(tmp_path)).get(
+            "jobX", journal_lib.block_key(0, 64)) is None
+
+    def test_crash_resume_across_journal_directory(self, tmp_path):
+        """Process-crash model: the resume uses a FRESH BlockJournal over
+        the same directory (nothing survives in memory)."""
+        base_kept, base_out = self._run()
+        with faults.inject(
+                faults.FaultSchedule([faults.Fault("fatal", block=4)])):
+            with pytest.raises(faults.InjectedFatalError):
+                self._run(journal=runtime.BlockJournal(str(tmp_path)),
+                          job_id="j")
+        kept, out = self._run(journal=runtime.BlockJournal(str(tmp_path)),
+                              job_id="j")
+        np.testing.assert_array_equal(base_kept, kept)
+        for name in base_out:
+            np.testing.assert_array_equal(base_out[name], out[name],
+                                          err_msg=name)
+
+
+class TestBlockedSelectionFaults:
+
+    def test_selection_faulted_matches(self):
+        P, l0 = 300, 30
+        selection = selection_ops.selection_params_from_host(
+            pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC, 1e7, 1e-5,
+            l0, None)
+        rows = []
+        for p in list(range(10)) + list(range(290, 300)):
+            for u in range(200):
+                rows.append((u * 100_003 + p, p))
+        for p in range(100, 110):
+            rows.append((10_000_000 + p, p))
+        pid = np.array([r[0] for r in rows], np.int64)
+        pk = np.array([r[1] for r in rows], np.int32)
+        valid = np.ones(len(rows), bool)
+        key = jax.random.PRNGKey(5)
+        base = large_p.select_partitions_blocked(pid, pk, valid, key, l0, P,
+                                                 selection,
+                                                 block_partitions=64)
+        journal = runtime.BlockJournal()
+        with faults.inject(
+                faults.FaultSchedule([
+                    faults.Fault("dispatch", block=0),
+                    faults.Fault("oom", block=2),
+                ])):
+            kept = large_p.select_partitions_blocked(
+                pid, pk, valid, key, l0, P, selection, block_partitions=64,
+                retry=FAST, journal=journal, job_id="sel")
+        np.testing.assert_array_equal(base, kept)
+        # Resume replays everything: zero new dispatches, same answer.
+        before = telemetry.snapshot()
+        kept2 = large_p.select_partitions_blocked(
+            pid, pk, valid, key, l0, P, selection, block_partitions=64,
+            retry=FAST, journal=journal, job_id="sel")
+        np.testing.assert_array_equal(base, kept2)
+        assert telemetry.delta(before).get("journal_replays", 0) > 0
+
+
+class TestMeshedFaults:
+    """Collective-failure fallback + the full fault schedule over the
+    8-device mesh (conftest forces the virtual CPU mesh)."""
+
+    def _mesh_spec(self):
+        mesh = make_mesh(n_devices=8)
+        P = 1 << 12
+        cfg, stds, scalars = _spec(P, eps=30, linf=64)
+        stds = np.zeros_like(np.asarray(stds))
+        dense = (np.arange(12) * 331 + 17) % P
+        n_per = 120
+        pid = (np.repeat(np.arange(n_per), len(dense)) * 1003 +
+               np.tile(np.arange(len(dense)), n_per)).astype(np.int32)
+        pk = np.tile(dense, n_per).astype(np.int32)
+        rng = np.random.default_rng(3)
+        values = rng.uniform(0, 5, len(pk))
+        pid = np.concatenate([pid, 900_000 + np.arange(5, dtype=np.int32)])
+        pk = np.concatenate(
+            [pk, ((np.arange(5) * 777 + 9) % P).astype(np.int32)])
+        values = np.concatenate([values, np.ones(5)])
+        valid = np.ones(len(pid), bool)
+        return mesh, P, cfg, stds, scalars, (pid, pk, values, valid)
+
+    def test_collective_failure_falls_back_to_host_reshard(self):
+        mesh, P, cfg, stds, scalars, cols = self._mesh_spec()
+        min_v, max_v, min_s, max_s, mid = scalars
+        pid, pk, values, valid = cols
+        key = jax.random.PRNGKey(11)
+        dev = (jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(values),
+               jnp.asarray(valid))
+        base_kept, base_out = large_p.aggregate_blocked_sharded(
+            mesh, pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+            stds, key, cfg, block_partitions=1 << 9)
+        before = telemetry.snapshot()
+        with faults.inject(
+                faults.FaultSchedule([faults.Fault("collective")])):
+            kept, out = large_p.aggregate_blocked_sharded(
+                mesh, *dev, min_v, max_v, min_s, max_s, mid, stds, key,
+                cfg, block_partitions=1 << 9, retry=FAST)
+        np.testing.assert_array_equal(base_kept, kept)
+        np.testing.assert_allclose(base_out["count"], out["count"],
+                                   atol=1e-9)
+        np.testing.assert_allclose(base_out["sum"], out["sum"], rtol=1e-6,
+                                   atol=1e-6)
+        assert telemetry.delta(before).get("reshard_host_fallbacks") == 1
+
+    def test_full_schedule_blocked_sharded(self):
+        mesh, P, cfg, stds, scalars, cols = self._mesh_spec()
+        min_v, max_v, min_s, max_s, mid = scalars
+        pid, pk, values, valid = cols
+        key = jax.random.PRNGKey(11)
+        dev = (jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(values),
+               jnp.asarray(valid))
+        base_kept, base_out = large_p.aggregate_blocked_sharded(
+            mesh, pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+            stds, key, cfg, block_partitions=1 << 9)
+        sched = faults.FaultSchedule([
+            faults.Fault("collective"),
+            faults.Fault("dispatch", block=0, times=2),
+            faults.Fault("consume", block=1),
+            faults.Fault("oom", block=3),
+            faults.Fault("slow", block=4, delay=0.01),
+        ])
+        before = telemetry.snapshot()
+        with faults.inject(sched):
+            kept, out = large_p.aggregate_blocked_sharded(
+                mesh, *dev, min_v, max_v, min_s, max_s, mid, stds, key,
+                cfg, block_partitions=1 << 9, retry=FAST)
+        assert sched.pending() == 0
+        np.testing.assert_array_equal(base_kept, kept)
+        np.testing.assert_allclose(base_out["count"], out["count"],
+                                   atol=1e-9)
+        np.testing.assert_allclose(base_out["sum"], out["sum"], rtol=1e-6,
+                                   atol=1e-6)
+        delta = telemetry.delta(before)
+        assert delta.get("reshard_host_fallbacks") == 1
+        assert delta.get("block_oom_degradations") == 1
+        assert delta.get("block_retries", 0) >= 3
+
+
+class TestEngineLevelInvariants:
+    """Whole-engine faulted runs: identical results, zero duplicate
+    mechanism registrations in the budget ledger."""
+
+    def _aggregate(self, backend, rows):
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            noise_kind=pdp.NoiseKind.LAPLACE,
+            max_partitions_contributed=4,
+            max_contributions_per_partition=8,
+            min_value=0.0,
+            max_value=5.0)
+        extractors = pdp.DataExtractors(
+            privacy_id_extractor=lambda r: r[0],
+            partition_extractor=lambda r: r[1],
+            value_extractor=lambda r: r[2])
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                               total_delta=1e-6)
+        engine = pdp.DPEngine(accountant, backend)
+        result = engine.aggregate(rows, params, extractors)
+        accountant.compute_budgets()
+        registered = accountant.mechanism_count
+        out = dict(result)
+        assert accountant.mechanism_count == registered
+        return out, registered
+
+    def test_blocked_engine_faulted_run_identical_ledger_stable(self):
+        rng = np.random.default_rng(1)
+        rows = list(
+            zip(rng.integers(0, 300, 8000).tolist(),
+                rng.integers(0, 3000, 8000).tolist(),
+                rng.uniform(0, 5, 8000).tolist()))
+        make = lambda: pdp.TPUBackend(noise_seed=13,
+                                      large_partition_threshold=1 << 10,
+                                      block_partitions=1 << 10,
+                                      retry=FAST)
+        base, n_base = self._aggregate(make(), rows)
+        sched = faults.FaultSchedule([
+            faults.Fault("dispatch", block=0, times=2),
+            faults.Fault("consume", block=1),
+        ])
+        with faults.inject(sched):
+            faulted, n_faulted = self._aggregate(make(), rows)
+        assert sched.pending() == 0
+        assert n_base == n_faulted  # zero duplicate registrations
+        assert base.keys() == faulted.keys()
+        for pk in base:
+            assert base[pk] == faulted[pk], pk
+
+    def test_engine_journal_resume(self, tmp_path):
+        rng = np.random.default_rng(2)
+        rows = list(
+            zip(rng.integers(0, 300, 8000).tolist(),
+                rng.integers(0, 3000, 8000).tolist(),
+                rng.uniform(0, 5, 8000).tolist()))
+        make = lambda journal=None: pdp.TPUBackend(
+            noise_seed=13,
+            large_partition_threshold=1 << 10,
+            block_partitions=1 << 10,
+            retry=FAST,
+            journal=journal)
+        base, _ = self._aggregate(make(), rows)
+        with faults.inject(
+                faults.FaultSchedule([faults.Fault("fatal", block=2)])):
+            with pytest.raises(faults.InjectedFatalError):
+                self._aggregate(make(runtime.BlockJournal(str(tmp_path))),
+                                rows)
+        before = telemetry.snapshot()
+        resumed, _ = self._aggregate(
+            make(runtime.BlockJournal(str(tmp_path))), rows)
+        assert telemetry.delta(before).get("journal_replays", 0) > 0
+        assert base == resumed
+
+    def test_guard_rejects_execution_time_registration(self):
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                               total_delta=1e-6)
+        engine = pdp.DPEngine(accountant, pdp.LocalBackend())
+
+        class RogueCombiner(pdp.CustomCombiner):
+
+            def create_accumulator(self, values):
+                return len(values)
+
+            def merge_accumulators(self, a, b):
+                return a + b
+
+            def compute_metrics(self, acc):
+                # Budget request during EXECUTION — the double-spend bug
+                # the guard exists to catch.
+                accountant._finalized = False
+                accountant.request_budget(MechanismType.LAPLACE)
+                return {"rogue": acc}
+
+            def explain_computation(self):
+                return lambda: "rogue"
+
+            def request_budget(self, budget_accountant):
+                self._budget = budget_accountant.request_budget(
+                    MechanismType.LAPLACE)
+
+            def metrics_names(self):
+                return ["rogue"]
+
+        params = pdp.AggregateParams(metrics=None,
+                                     custom_combiners=[RogueCombiner()],
+                                     max_partitions_contributed=2,
+                                     max_contributions_per_partition=2)
+        extractors = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                        partition_extractor=lambda r: r[1],
+                                        value_extractor=lambda r: 1.0)
+        result = engine.aggregate([(1, "a"), (2, "a")], params, extractors,
+                                  public_partitions=["a"])
+        accountant.compute_budgets()
+        with pytest.raises(AssertionError, match="double-spend"):
+            list(result)
