@@ -681,12 +681,30 @@ def main():
     # a healthy run records zeros; nonzero retries/fallbacks/degradations
     # in a receipt flag the run as having survived adversity (and explain
     # any throughput dip) instead of silently hiding it.
+    from pipelinedp_tpu.runtime import health as rt_health
     from pipelinedp_tpu.runtime import telemetry as rt_telemetry
     fault_counters = {
         name: rt_telemetry.counters.get(name, 0)
-        for name in ("block_retries", "block_oom_degradations",
-                     "reshard_host_fallbacks", "journal_replays",
+        for name in ("block_retries", "block_timeouts",
+                     "block_oom_degradations", "reshard_host_fallbacks",
+                     "journal_replays", "journal_quarantined",
+                     "watchdog_timeouts", "watchdog_late_completions",
                      "host_fetch_retries")
+    }
+    # Per-phase wall-time stats (telemetry.record_duration) and the
+    # health state machine's per-job verdicts: a receipt that stalled,
+    # degraded or quarantined says so — and says where the time went.
+    phase_timings = {
+        name: {k: round(v, 4) for k, v in stats.items()}
+        for name, stats in rt_telemetry.timing_snapshot().items()
+    }
+    job_health = {
+        job: {
+            "state": snap["state"],
+            "counters": snap["counters"],
+            "journal_quarantined": snap["journal_quarantined"],
+        }
+        for job, snap in rt_health.snapshot_all().items()
     }
     builder_receipt = _builder_receipt_summary() if fallback else None
     print(
@@ -714,6 +732,8 @@ def main():
                 **reshard_detail,
                 **baseline_detail,
                 "runtime_fault_counters": fault_counters,
+                "runtime_phase_timings": phase_timings,
+                "runtime_job_health": job_health,
                 **({"device_fallback": fallback} if fallback else {}),
                 # CPU-fallback runs carry the newest committed device
                 # evidence so a tunnel-dropped driver round still shows it.

@@ -994,9 +994,9 @@ def blocked_job_id(kind: str, static_config, noise_seed) -> str:
 
 
 def _blocked_runtime_kwargs(backend, kind: str, static_config) -> dict:
-    """The failure-semantics kwargs (retry/journal/job_id, plus the
-    block_partitions failure-domain size when set) threaded from
-    TPUBackend into the blocked drivers."""
+    """The failure-semantics kwargs (retry/journal/job_id, the watchdog
+    deadline knobs, plus the block_partitions failure-domain size when
+    set) threaded from TPUBackend into the blocked drivers."""
     journal = getattr(backend, "journal", None)
     job_id = getattr(backend, "job_id", None)
     noise_seed = getattr(backend, "noise_seed", None)
@@ -1014,6 +1014,28 @@ def _blocked_runtime_kwargs(backend, kind: str, static_config) -> dict:
     block_partitions = getattr(backend, "block_partitions", None)
     if block_partitions is not None:
         kwargs["block_partitions"] = block_partitions
+    timeout_s = getattr(backend, "timeout_s", None)
+    if timeout_s is not None:
+        kwargs["timeout_s"] = timeout_s
+    wd = getattr(backend, "watchdog", None)
+    if wd is not None:
+        kwargs["watchdog"] = wd
+    # Attribute the job's health record to this backend so
+    # TPUBackend.health() can answer for the aggregations it actually
+    # ran. Without an explicit/derived job_id the drivers fall back to
+    # their own function name as the job key.
+    health_jobs = getattr(backend, "_health_jobs", None)
+    if health_jobs is not None:
+        if job_id is not None:
+            health_jobs.add(job_id)
+        else:
+            meshed = getattr(backend, "mesh", None) is not None
+            health_jobs.add({
+                "aggregate": "aggregate_blocked_sharded"
+                             if meshed else "aggregate_blocked",
+                "select": "select_partitions_blocked_sharded"
+                          if meshed else "select_partitions_blocked",
+            }.get(kind, kind))
     return kwargs
 
 

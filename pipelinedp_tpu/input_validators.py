@@ -3,10 +3,18 @@
 Semantics match the reference validators (/root/reference/pipeline_dp/
 input_validators.py:17-34): epsilon must be a positive finite number, delta a
 number in [0, 1).
+
+The runtime-knob validators (timeout_s, job_id, retry budgets) reject bad
+values at the API boundary — TPUBackend construction and the blocked
+drivers' entry — with actionable messages, instead of letting a
+non-positive deadline silently disable the watchdog or a path-unsafe
+job_id fail (or worse, sanitize into a colliding key) deep inside
+BlockJournal._path.
 """
 
 import math
 import numbers
+import re
 
 
 def validate_epsilon_delta(epsilon: float, delta: float, obj_name: str) -> None:
@@ -31,3 +39,79 @@ def validate_epsilon_delta(epsilon: float, delta: float, obj_name: str) -> None:
     if delta >= 1:
         raise ValueError(f"{obj_name}: delta must be less than 1, but "
                          f"delta={delta} given.")
+
+
+# Journal job ids become file-name components (BlockJournal._path). The
+# sanitizer there maps disallowed characters to "_", so two ids differing
+# only in unsafe characters would COLLIDE on disk — reject them up front.
+_JOB_ID_UNSAFE = re.compile(r"[/\\\x00]|(?:^|[/\\])\.\.(?:[/\\]|$)")
+
+
+def validate_timeout_s(timeout_s, obj_name: str) -> None:
+    """Validates a watchdog deadline: a positive finite number of seconds.
+
+    Raises:
+        ValueError: timeout_s is not a positive finite number.
+    """
+    if (not isinstance(timeout_s, numbers.Number) or
+            isinstance(timeout_s, bool) or math.isnan(timeout_s)):
+        raise ValueError(f"{obj_name}: timeout_s must be a number of "
+                         f"seconds, but {timeout_s!r} given.")
+    if timeout_s <= 0 or math.isinf(timeout_s):
+        raise ValueError(
+            f"{obj_name}: timeout_s must be positive and finite, but "
+            f"timeout_s={timeout_s} given — a non-positive deadline would "
+            f"expire every block immediately; leave it None to disable "
+            f"deadlines instead.")
+
+
+def validate_job_id(job_id, obj_name: str) -> None:
+    """Validates a journal job id: a non-empty, path-safe string.
+
+    Raises:
+        ValueError: job_id is empty, not a string, or contains path
+        separators / parent-directory references / NUL (which the journal
+        file-name sanitizer would fold together, silently colliding two
+        different jobs' records).
+    """
+    if not isinstance(job_id, str):
+        raise ValueError(f"{obj_name}: job_id must be a string, but "
+                         f"{type(job_id).__name__} given.")
+    if not job_id.strip():
+        raise ValueError(f"{obj_name}: job_id must be non-empty — it keys "
+                         f"this job's journal records; pass a stable "
+                         f"identifier (or None to derive one from the "
+                         f"kernel config).")
+    if len(job_id) > 200:
+        raise ValueError(f"{obj_name}: job_id is {len(job_id)} characters; "
+                         f"the limit is 200 (it becomes a file-name "
+                         f"component).")
+    if _JOB_ID_UNSAFE.search(job_id) or job_id in (".", ".."):
+        raise ValueError(
+            f"{obj_name}: job_id {job_id!r} contains path separators or "
+            f"directory references; journal records are files named after "
+            f"the job id, so it must be path-safe.")
+
+
+def validate_retry_policy(retry, obj_name: str) -> None:
+    """Validates a runtime.RetryPolicy-shaped object's budgets.
+
+    Raises:
+        ValueError: negative max_retries, or negative/NaN delays.
+    """
+    max_retries = getattr(retry, "max_retries", None)
+    if (not isinstance(max_retries, numbers.Number) or
+            isinstance(max_retries, bool) or max_retries < 0 or
+            max_retries != int(max_retries)):
+        raise ValueError(
+            f"{obj_name}: retry.max_retries must be a non-negative "
+            f"integer, but {max_retries!r} given (0 disables retries; "
+            f"use None for the retry= knob itself to take the default "
+            f"policy).")
+    for field in ("base_delay", "max_delay"):
+        v = getattr(retry, field, 0.0)
+        if (not isinstance(v, numbers.Number) or isinstance(v, bool) or
+                math.isnan(v) or v < 0):
+            raise ValueError(f"{obj_name}: retry.{field} must be a "
+                             f"non-negative number of seconds, but "
+                             f"{v!r} given.")

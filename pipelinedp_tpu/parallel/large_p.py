@@ -42,11 +42,17 @@ psum over ICI.
 Failure semantics (pipelinedp_tpu/runtime, README "Failure semantics"):
 every driver takes retry= (transient dispatch/sync failures re-dispatch
 under the SAME fold_in(final_key, b) key — bit-identical noise, no second
-release), journal=/job_id= (consumed blocks' drained results recorded for
-resume; replayed blocks never re-dispatch), and degrades on OOM by
-halving the partition block capacity and re-planning the remaining range
-(run_with_degradation; re-planned blocks draw fresh keys — nothing was
-released for them).
+release), journal=/job_id= (consumed blocks' drained results recorded
+with CRC32 integrity checks for resume; replayed blocks never
+re-dispatch, corrupt records quarantine and recompute),
+timeout_s=/watchdog= (per-operation deadlines: a timed-out dispatch or
+drain retries same-key, repeated timeouts degrade like OOM, a timed-out
+reshard collective falls back to the host permutation), and degrades on
+OOM by halving the partition block capacity and re-planning the
+remaining range (run_with_degradation; re-planned blocks draw fresh
+keys — nothing was released for them). Each run executes inside its
+job's health scope (runtime/health.py), so retries, timeouts,
+fallbacks and quarantines surface in TPUBackend.health().
 """
 
 import dataclasses
@@ -60,13 +66,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from pipelinedp_tpu import executor
+from pipelinedp_tpu import input_validators
 # Canonical shape arithmetic lives with the mesh helpers; re-exported here
 # because the blocked path made the name public first.
 from pipelinedp_tpu.parallel.mesh import host_fetch, round_capacity
 from pipelinedp_tpu.runtime import faults as rt_faults
+from pipelinedp_tpu.runtime import health as rt_health
 from pipelinedp_tpu.runtime import journal as rt_journal
 from pipelinedp_tpu.runtime import retry as rt_retry
 from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+from pipelinedp_tpu.runtime import watchdog as rt_watchdog
 
 # One shared depth for the async block pipeline: _dispatch_blocks keeps at
 # most this many block kernels in flight, and _StagedDrain keeps at most
@@ -225,6 +234,53 @@ class _Replay:
         self.record = record
 
 
+def _runtime_entry(kind: str):
+    """Decorator giving every blocked driver the shared runtime entry
+    discipline: the timeout_s=/watchdog= knobs, runtime-knob validation
+    at the API boundary, the job's health scope (telemetry forwarding +
+    completion/failure accounting) and thread-local watchdog activation
+    (so retry_call, the drain guards, host_fetch heartbeats and the
+    device-reshard collective deadline all see it without signature
+    threading).
+
+    timeout_s: per-operation deadline in seconds. Shorthand for
+        watchdog=Watchdog(timeout_s=...); with neither, no deadlines are
+        enforced (PR-2 behavior). Passing a Watchdog without timeout_s
+        auto-derives deadlines as a multiple of the pass-1 profiled time.
+    """
+
+    def deco(fn):
+
+        @functools.wraps(fn)
+        def wrapper(*args,
+                    timeout_s: Optional[float] = None,
+                    watchdog: Optional[rt_watchdog.Watchdog] = None,
+                    job_id: Optional[str] = None,
+                    **kwargs):
+            job = job_id or kind
+            input_validators.validate_job_id(job, kind)
+            if timeout_s is not None:
+                input_validators.validate_timeout_s(timeout_s, kind)
+            if kwargs.get("retry") is not None:
+                input_validators.validate_retry_policy(
+                    kwargs["retry"], kind)
+            wd = watchdog
+            if wd is None and timeout_s is not None:
+                wd = rt_watchdog.Watchdog(timeout_s=timeout_s)
+            elif wd is not None and timeout_s is not None:
+                wd.timeout_s = timeout_s
+            t0 = time.perf_counter()
+            with rt_health.job_scope(job), rt_watchdog.activate(wd):
+                result = fn(*args, job_id=job, **kwargs)
+                rt_telemetry.record_duration(kind,
+                                             time.perf_counter() - t0)
+            return result
+
+        return wrapper
+
+    return deco
+
+
 def _sync_scalars(result) -> None:
     """Forces the 0-d leaves (the n_kept gates) to host — the sync point
     where asynchronously-dispatched block failures surface."""
@@ -281,7 +337,12 @@ def _dispatch_blocks(block_iter, consume,
         while True:
             try:
                 rt_faults.maybe_fail("consume", b)
-                _sync_scalars(result)
+                # The drain sync runs under its own watchdog deadline
+                # (when one is active): an expiry surfaces as a transient
+                # BlockTimeoutError and re-dispatches the same key below.
+                with rt_watchdog.guard("drain", b):
+                    rt_faults.maybe_hang(b, point="drain")
+                    _sync_scalars(result)
                 break
             except Exception as e:  # noqa: BLE001 - classified below
                 if (not rt_retry.is_transient(e) or
@@ -289,6 +350,8 @@ def _dispatch_blocks(block_iter, consume,
                     raise
                 delay = policy.delay(attempt)
                 attempt += 1
+                if rt_retry.is_timeout(e):
+                    rt_telemetry.record("block_timeouts")
                 rt_telemetry.record("block_retries")
                 logging.warning(
                     "block %d failed at its sync point (%s); re-dispatching "
@@ -299,15 +362,26 @@ def _dispatch_blocks(block_iter, consume,
                 result = start(b, make)
         consume(b, result)
 
+    def _degradable(err):
+        # Exhausted timeouts degrade exactly like OOM: halving the block
+        # capacity shrinks per-block work, so the smaller block can land
+        # inside the deadline — and the timed-out block never produced
+        # consumed output, so the re-plan's fresh keys release nothing
+        # twice.
+        return rt_retry.is_oom(err) or rt_retry.is_timeout(err)
+
     def consume_or_oom(b, entry, make):
         try:
             consume_one(b, entry, make)
         except Exception as err:
-            if make is not None and rt_retry.is_oom(err):
+            if make is not None and _degradable(err):
                 raise rt_retry.BlockOOMError(b, err) from err
             raise
 
+    active_wd = rt_watchdog.active()
     for b, entry in block_iter:
+        if active_wd is not None:
+            active_wd.beat("dispatch")
         if isinstance(entry, _Replay):
             pending.append((b, entry, None))
         else:
@@ -328,7 +402,7 @@ def _dispatch_blocks(block_iter, consume,
                         "draining in-flight blocks after a dispatch "
                         "failure itself failed; earlier results may be "
                         "incomplete")
-                if rt_retry.is_oom(err):
+                if _degradable(err):
                     raise rt_retry.BlockOOMError(b, err) from err
                 raise
             pending.append((b, result, entry))
@@ -417,6 +491,18 @@ class _StagedDrain:
             host = np.asarray(arr)
             target.append(transform(host) if transform else host)
         del self._staged[:n]
+
+
+def _seed_pass1(seconds: float) -> None:
+    """Feeds the pass-1 wall time into telemetry and the active
+    watchdog's auto-deadline profile: pass 1 touches every row, so any
+    single block is strictly cheaper and multiplier * this time is a
+    generous per-block deadline (floored by the watchdog's
+    min_timeout_s; explicit timeout_s overrides it entirely)."""
+    rt_telemetry.record_duration("p1_bound_compact", seconds)
+    wd = rt_watchdog.active()
+    if wd is not None:
+        wd.seed_profile(seconds)
 
 
 def _pad_to(a, cap: int, fill):
@@ -580,6 +666,7 @@ def _block_boundaries(base: int, capacity: int, n_blocks: int) -> np.ndarray:
         np.iinfo(np.int32).max).astype(np.int32)
 
 
+@_runtime_entry("aggregate_blocked_sharded")
 def aggregate_blocked_sharded(mesh,
                               pid,
                               pk,
@@ -647,6 +734,7 @@ def aggregate_blocked_sharded(mesh,
     n_blocks0 = -(-P // C0)
     boundaries0 = _block_boundaries(0, C0, n_blocks0)
 
+    t_p1 = time.perf_counter()
     spk_all, pair_all, cols_all, leaf_all, starts = _sharded_bound_compact(
         pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, rows_key,
         jnp.asarray(boundaries0), cfg, mesh)
@@ -654,6 +742,7 @@ def aggregate_blocked_sharded(mesh,
     # rows: each shard's block offsets (host_fetch = sanctioned under the
     # transfer guard).
     starts0 = host_fetch(starts).reshape(n_shards, n_blocks0 + 1)
+    _seed_pass1(time.perf_counter() - t_p1)
 
     output_names = [name for e in cfg.plan for name in e.outputs]
     kept_ids = []
@@ -838,6 +927,7 @@ def _sharded_selection_block(spk_all, lo_r, len_r, base, c_actual, key,
     return fn(spk_all, lo_r, len_r, key)
 
 
+@_runtime_entry("select_partitions_blocked_sharded")
 def select_partitions_blocked_sharded(mesh,
                                       pid,
                                       pk,
@@ -886,10 +976,12 @@ def select_partitions_blocked_sharded(mesh,
 
     C0 = min(block_partitions, P)
     n_blocks0 = -(-P // C0)
+    t_p1 = time.perf_counter()
     spk_all, starts = _sharded_select_compact(
         pid, pk, valid, key_l0,
         jnp.asarray(_block_boundaries(0, C0, n_blocks0)), l0, P, mesh)
     starts0 = host_fetch(starts).reshape(n_shards, n_blocks0 + 1)
+    _seed_pass1(time.perf_counter() - t_p1)
 
     kept_ids = []
     job = job_id or "select_partitions_blocked_sharded"
@@ -965,6 +1057,7 @@ def select_partitions_blocked_sharded(mesh,
     return np.concatenate(kept_ids)
 
 
+@_runtime_entry("select_partitions_blocked")
 def select_partitions_blocked(pid,
                               pk,
                               valid,
@@ -996,9 +1089,11 @@ def select_partitions_blocked(pid,
     if not isinstance(pid, jax.Array):
         pid, pk, valid = np.asarray(pid), np.asarray(pk), np.asarray(valid)
     cap = round_capacity(len(pid))
+    t_p1 = time.perf_counter()
     spk_sorted, _ = executor.select_kept_pair_stream(
         jnp.asarray(_pad_to(pid, cap, 0)), jnp.asarray(_pad_to(pk, cap, 0)),
         jnp.asarray(_pad_to(valid, cap, False)), key_l0, l0, P)
+    _seed_pass1(time.perf_counter() - t_p1)
 
     C0 = min(block_partitions, P)
     kept_ids = []
@@ -1072,6 +1167,7 @@ def select_partitions_blocked(pid,
     return out
 
 
+@_runtime_entry("aggregate_blocked")
 def aggregate_blocked(pid,
                       pk,
                       values,
@@ -1172,6 +1268,11 @@ def aggregate_blocked(pid,
         else:
             jax.block_until_ready(spk_all)
         phase_times["p1_bound_compact"] = time.perf_counter() - t0
+    # Without profiling, pass 1 was dispatched async — the wall time here
+    # under-measures, but the watchdog floors the auto deadline and takes
+    # the max over later completed-guard observations, so the seed only
+    # has to be the right order of magnitude.
+    _seed_pass1(time.perf_counter() - t0)
 
     # --- Pass 2: bin by partition block, finalize each block. -------------
     # Dropped rows carry an int32-max sentinel > P, so searchsorted over

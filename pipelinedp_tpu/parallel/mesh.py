@@ -105,6 +105,14 @@ def host_fetch(arr, max_retries: int = 2) -> np.ndarray:
     # Imported lazily: mesh is a leaf module most of the package imports.
     from pipelinedp_tpu.runtime import retry as rt_retry
     from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+    from pipelinedp_tpu.runtime import watchdog as rt_watchdog
+
+    # Control-table fetches are sync points the blocked drivers pass
+    # through between dispatch windows: heartbeat the active watchdog so
+    # health can report seconds-since-progress even between block guards.
+    wd = rt_watchdog.active()
+    if wd is not None:
+        wd.beat("host_fetch")
 
     _sanctioned_fetch.active = True
     try:

@@ -56,6 +56,7 @@ from pipelinedp_tpu.parallel.mesh import (SHARD_AXIS, host_fetch,
 from pipelinedp_tpu.runtime import faults as rt_faults
 from pipelinedp_tpu.runtime import retry as rt_retry
 from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+from pipelinedp_tpu.runtime import watchdog as rt_watchdog
 
 # Fetches at or below this many elements are control-plane sized; the
 # transfer-guard treats anything larger as row data.
@@ -233,8 +234,15 @@ def stage_rows_to_mesh(mesh: Mesh, pid, pk, values, valid,
                                       jnp.asarray(values),
                                       jnp.asarray(valid))
         try:
-            rt_faults.maybe_fail("collective")
-            return device_reshard_rows_by_pid(mesh, pid, pk, values, valid)
+            # The collective exchange runs under its own watchdog deadline
+            # (when one is active on this thread): a hang on the
+            # all_to_all fabric surfaces as BlockTimeoutError and degrades
+            # to the host permutation exactly like a failed collective.
+            with rt_watchdog.guard("collective"):
+                rt_faults.maybe_fail("collective")
+                rt_faults.maybe_hang(point="collective")
+                return device_reshard_rows_by_pid(mesh, pid, pk, values,
+                                                  valid)
         except Exception as e:  # noqa: BLE001 - classified below
             if not _is_collective_failure(e):
                 raise
@@ -265,9 +273,12 @@ def stage_rows_to_mesh(mesh: Mesh, pid, pk, values, valid,
 
 def _is_collective_failure(exc: BaseException) -> bool:
     """Failures worth degrading to the host reshard for: the injected
-    collective fault, transient runtime failures, or an error naming the
-    exchange itself. Programming errors (shape/type) must propagate."""
+    collective fault, a deadline expiry on the exchange, transient
+    runtime failures, or an error naming the exchange itself.
+    Programming errors (shape/type) must propagate."""
     if isinstance(exc, rt_faults.InjectedCollectiveError):
+        return True
+    if isinstance(exc, rt_watchdog.BlockTimeoutError):
         return True
     if isinstance(exc, rt_faults.InjectedFault):
         return False

@@ -31,6 +31,7 @@ from typing import Any, Callable, Iterable, List, Optional
 import numpy as np
 
 from pipelinedp_tpu import combiners as dp_combiners
+from pipelinedp_tpu import input_validators
 
 try:
     import apache_beam as beam
@@ -365,6 +366,19 @@ class TPUBackend(LocalBackend):
             (None = the drivers' default, 2^20). The failure-domain knob:
             smaller blocks mean finer-grained retry/journal/OOM-degrade
             units at more dispatch overhead.
+        timeout_s: per-operation deadline (seconds) for the blocked
+            drivers' watchdog: every block dispatch, drain sync and the
+            device-reshard collective must finish inside it or the
+            watchdog cancels at the next cooperative point. A timed-out
+            block retries under the SAME fold_in key (bit-identical
+            noise); repeated timeouts degrade the block capacity like
+            OOM; a timed-out reshard collective falls back to the host
+            permutation. None (default) enforces no deadline unless
+            `watchdog` is given.
+        watchdog: optional pipelinedp_tpu.runtime.Watchdog instance to
+            share/configure directly (auto-derived deadlines from the
+            pass-1 profile, custom multiplier). timeout_s is shorthand
+            for watchdog=Watchdog(timeout_s=...).
     """
 
     def __init__(self,
@@ -377,11 +391,22 @@ class TPUBackend(LocalBackend):
                  retry=None,
                  journal=None,
                  job_id: Optional[str] = None,
-                 block_partitions: Optional[int] = None):
+                 block_partitions: Optional[int] = None,
+                 timeout_s: Optional[float] = None,
+                 watchdog=None):
         super().__init__(seed=noise_seed)
         if reshard not in ("auto", "host", "device"):
             raise ValueError(
                 f"reshard must be auto|host|device, got {reshard!r}")
+        # Runtime knobs are validated here, at the API boundary, so a bad
+        # timeout/job_id/retry budget fails with an actionable message
+        # instead of deep inside the journal or the watchdog monitor.
+        if timeout_s is not None:
+            input_validators.validate_timeout_s(timeout_s, "TPUBackend")
+        if job_id is not None:
+            input_validators.validate_job_id(job_id, "TPUBackend")
+        if retry is not None:
+            input_validators.validate_retry_policy(retry, "TPUBackend")
         self.mesh = mesh
         self.max_partitions = max_partitions
         self.noise_seed = noise_seed
@@ -392,10 +417,30 @@ class TPUBackend(LocalBackend):
         self.journal = journal
         self.job_id = job_id
         self.block_partitions = block_partitions
+        self.timeout_s = timeout_s
+        self.watchdog = watchdog
+        # Job ids whose health this backend's aggregations fed (the
+        # executor records them as it resolves/derives them).
+        self._health_jobs = set()
 
     @property
     def is_tpu(self) -> bool:
         return True
+
+    def health(self) -> dict:
+        """Health snapshots of the jobs this backend has run (or, before
+        any blocked run attributed a job to this backend, every job the
+        process tracked): {job_id: {state, counters, phase_seconds,
+        journal_quarantined, ...}} — see runtime/health.py for the
+        HEALTHY/DEGRADED/STALLED/FAILED semantics."""
+        from pipelinedp_tpu.runtime import health as rt_health
+        snaps = rt_health.snapshot_all()
+        jobs = set(self._health_jobs)
+        if self.job_id is not None:
+            jobs.add(self.job_id)
+        if jobs:
+            return {j: s for j, s in snaps.items() if j in jobs}
+        return snaps
 
 
 # Lambdas cannot be pickled for Pool.map; with the fork start method the

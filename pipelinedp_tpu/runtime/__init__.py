@@ -7,18 +7,35 @@ semantics:
   * journal.BlockJournal — host-side record of each consumed block's
     drained O(kept) results, keyed by (job_id, block key), so an
     interrupted blocked run resumes from the last consumed block instead
-    of restarting (and re-releasing) everything.
+    of restarting (and re-releasing) everything. Records carry CRC32
+    checksums verified on read; corrupt/truncated records are quarantined
+    (renamed aside, never replayed) and compact() drops records
+    superseded by OOM re-planned generations.
   * retry — bounded-exponential-backoff retry of transient dispatch/sync
     failures. A retried block re-derives the SAME fold_in(final_key, b)
     key and therefore redraws bit-identical noise: no second DP release,
     no budget re-spend. OOM-classified failures are never retried at the
     same shape — they surface as BlockOOMError so the driver can halve
     the partition block capacity and re-plan (run_with_degradation).
+  * watchdog — deadline/heartbeat monitoring of every block-stream step
+    (dispatch, drain, collective reshard, control fetches): per-block
+    deadlines (explicit timeout_s or a multiple of the pass-1 profiled
+    time), a background monitor thread, and BlockTimeoutError verdicts
+    that route into the SAME retry (same key, bit-identical noise) and
+    degradation (repeated timeouts halve the block capacity like OOM)
+    machinery. A deadline expiry on the device-reshard collective falls
+    back to the host permutation like any collective failure.
+  * health — per-job HEALTHY -> DEGRADED -> STALLED -> FAILED state
+    machine aggregating watchdog verdicts, retry/fallback/quarantine
+    telemetry, journal state and per-phase wall time into one queryable
+    snapshot (TPUBackend.health(), bench receipts).
   * faults — deterministic fault injection (killed dispatches, OOMs,
-    collective failures, slow blocks) by schedule, used by the tests and
-    the multichip dryrun to prove the above under adversity.
-  * telemetry — process-wide counters (retries, degradations, fallbacks,
-    replays) recorded into bench receipts.
+    collective failures, slow blocks, bounded hangs, journal corruption)
+    by schedule, used by the tests and the multichip dryrun to prove the
+    above under adversity.
+  * telemetry — process-wide counters (retries, timeouts, degradations,
+    fallbacks, replays, quarantines) and per-phase timing stats recorded
+    into bench receipts.
 
 The privacy invariants this package leans on are documented in README
 "Failure semantics": mechanisms register with the BudgetAccountant at
@@ -29,16 +46,26 @@ is a replay of the same release, not a second one.
 """
 
 from pipelinedp_tpu.runtime import faults
+from pipelinedp_tpu.runtime import health
 from pipelinedp_tpu.runtime import telemetry
-from pipelinedp_tpu.runtime.journal import BlockJournal
+from pipelinedp_tpu.runtime.health import HealthState, JobHealth
+from pipelinedp_tpu.runtime.journal import (BlockJournal,
+                                            JournalCorruptionError)
 from pipelinedp_tpu.runtime.retry import (BlockOOMError, RetryPolicy,
                                           retry_call, run_with_degradation)
+from pipelinedp_tpu.runtime.watchdog import BlockTimeoutError, Watchdog
 
 __all__ = [
     "BlockJournal",
     "BlockOOMError",
+    "BlockTimeoutError",
+    "HealthState",
+    "JobHealth",
+    "JournalCorruptionError",
     "RetryPolicy",
+    "Watchdog",
     "faults",
+    "health",
     "retry_call",
     "run_with_degradation",
     "telemetry",

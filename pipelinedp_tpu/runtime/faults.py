@@ -33,15 +33,37 @@ Fault kinds and the exception they raise:
   fatal       InjectedFatalError      never retried — models a hard crash
                                       (the journal-resume test case)
   slow        (no exception)          sleeps `delay` seconds at dispatch
+  hang        BlockTimeoutError       a never-completing operation: the
+                                      hook stalls, polling the active
+                                      watchdog guard's cancel event, and
+                                      raises when the deadline monitor
+                                      cancels it (or after the fault's
+                                      `delay` hard cap — default 30 s —
+                                      so a watchdog-less run, or a
+                                      watchdog BUG, cannot hang tier-1).
+                                      `point` targets one hook site:
+                                      dispatch | drain | collective.
+  corrupt     (no exception)          silently corrupts the journal
+                                      record just written (`mode`:
+                                      "flip" a byte or "truncate" the
+                                      file) — the integrity-check /
+                                      quarantine test case.
 """
 
 import contextlib
 import dataclasses
+import logging
+import os
 import threading
 import time
 from typing import List, Optional
 
 from pipelinedp_tpu.runtime import telemetry
+
+# Hard cap on an injected hang with no explicit delay: long enough that a
+# configured watchdog always wins the race, short enough that a watchdog
+# bug surfaces as a failed test rather than a hung suite.
+_DEFAULT_HANG_CAP_S = 30.0
 
 
 class InjectedFault(RuntimeError):
@@ -80,17 +102,32 @@ _RAISES = {
 @dataclasses.dataclass
 class Fault:
     """One scheduled fault: fires on `kind` hooks for block `block` (None =
-    the first block that reaches the hook), `times` attempts in a row."""
+    the first block that reaches the hook), `times` attempts in a row.
+
+    delay: seconds — the sleep of a "slow" fault, or the hard cap of a
+        "hang" fault (0 = the 30 s default cap).
+    point: "hang" only — restrict to one hook site ("dispatch", "drain",
+        "collective"); None fires at whichever site reaches it first.
+    mode: "corrupt" only — "flip" (default) flips one payload byte,
+        "truncate" cuts the file in half.
+    """
     kind: str
     block: Optional[int] = None
     times: int = 1
-    delay: float = 0.0  # kind == "slow" only
+    delay: float = 0.0  # kind in ("slow", "hang") only
+    point: Optional[str] = None  # kind == "hang" only
+    mode: str = "flip"  # kind == "corrupt" only
 
     def __post_init__(self):
-        if self.kind not in set(_RAISES) | {"slow"}:
+        if self.kind not in set(_RAISES) | {"slow", "hang", "corrupt"}:
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.times <= 0:
             raise ValueError("times must be positive")
+        if self.point is not None and self.point not in (
+                "dispatch", "drain", "collective"):
+            raise ValueError(f"unknown hang point {self.point!r}")
+        if self.mode not in ("flip", "truncate"):
+            raise ValueError(f"unknown corrupt mode {self.mode!r}")
 
 
 class FaultSchedule:
@@ -99,14 +136,17 @@ class FaultSchedule:
     def __init__(self, faults: List[Fault]):
         self._remaining = [[f, f.times] for f in faults]
 
-    def take(self, kind: str, block: int) -> Optional[Fault]:
+    def take(self, kind: str, block: int,
+             point: Optional[str] = None) -> Optional[Fault]:
         """Consumes and returns the first pending fault matching (kind,
-        block); None if nothing is scheduled for this hook."""
+        block[, point]); None if nothing is scheduled for this hook."""
         for entry in self._remaining:
             fault, left = entry
             if left <= 0 or fault.kind != kind:
                 continue
             if fault.block is not None and fault.block != block:
+                continue
+            if fault.point is not None and fault.point != point:
                 continue
             entry[1] -= 1
             return fault
@@ -157,3 +197,65 @@ def maybe_sleep(block: int = 0) -> None:
     if fault is not None:
         telemetry.record("injected_faults")
         time.sleep(fault.delay)
+
+
+def maybe_hang(block: int = 0, point: Optional[str] = None) -> None:
+    """Hook point for 'hang' faults: a never-completing operation.
+
+    Stalls, polling the innermost watchdog guard's cancel event; when the
+    deadline monitor cancels (or the fault's `delay` hard cap elapses —
+    modelling the runtime eventually surfacing DEADLINE_EXCEEDED on its
+    own), raises BlockTimeoutError. Either way the hang is bounded and
+    the error is transient-classified: the retried operation re-derives
+    the same key, so recovery is a replay, not a second release.
+    """
+    schedule = active()
+    if schedule is None:
+        return
+    fault = schedule.take("hang", block, point)
+    if fault is None:
+        return
+    telemetry.record("injected_faults")
+    from pipelinedp_tpu.runtime import watchdog as rt_watchdog
+    token = rt_watchdog.current_token()
+    cap = fault.delay if fault.delay > 0 else _DEFAULT_HANG_CAP_S
+    where = point or "operation"
+    start = time.monotonic()
+    while True:
+        if token is not None and token.cancel.wait(0.005):
+            raise rt_watchdog.BlockTimeoutError(
+                where, block, token.timeout_s,
+                "injected hang cancelled by the deadline monitor")
+        if token is None:
+            time.sleep(0.005)
+        waited = time.monotonic() - start
+        if waited >= cap:
+            raise rt_watchdog.BlockTimeoutError(
+                where, block, cap,
+                "injected hang hit its hard cap (no watchdog "
+                "cancellation arrived)")
+
+
+def maybe_corrupt(path: str, block: int = 0) -> None:
+    """Hook point for 'corrupt' faults: damages the file at `path` in
+    place (a journal record that was just durably written), modelling a
+    bit-flip or truncation between write and replay."""
+    schedule = active()
+    if schedule is None:
+        return
+    fault = schedule.take("corrupt", block)
+    if fault is None:
+        return
+    telemetry.record("injected_faults")
+    size = os.path.getsize(path)
+    if fault.mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+    else:
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            byte = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([byte[0] ^ 0xFF]) if byte else b"\xff")
+    logging.warning("injected %s corruption into journal record %s",
+                    fault.mode, path)

@@ -1,0 +1,250 @@
+"""Per-job health state machine for the blocked runtime.
+
+One queryable answer to "how is this job doing?": a JobHealth aggregates
+watchdog verdicts (deadline expiries, late completions), the retry /
+fallback / degradation telemetry the runtime already records, journal
+state (replays, quarantined records) and per-phase wall time into a
+four-state machine:
+
+    HEALTHY   no anomaly observed.
+    DEGRADED  the job recovered from adversity (retries, an OOM capacity
+              halving, a collective->host fallback, a quarantined journal
+              record) — results are unaffected, capacity or latency may
+              be.
+    STALLED   a deadline expired on an operation that has not completed:
+              the job is (or recently was) not making progress. Demoted
+              back to DEGRADED when the stalled operation completes or
+              its retry succeeds.
+    FAILED    the driver surfaced an unrecoverable error. Terminal for
+              the attempt; a later run of the same job that completes
+              (the journaled-resume path) demotes to DEGRADED — the
+              crash stays visible in counters and last_error.
+
+Severity only escalates (except the STALLED->DEGRADED recovery demotion),
+so a snapshot taken at any time is a faithful worst-observed summary.
+
+Wiring: drivers enter a job_scope(job_id), which makes the job's
+JobHealth the thread's *current* one; telemetry.record() and
+record_duration() forward every counter/duration to it, so the existing
+failure-path instrumentation feeds health with no extra plumbing. The
+watchdog monitor thread (which cannot see the driver thread's current
+job) posts its verdicts directly on the JobHealth captured at guard
+creation. Snapshots surface through TPUBackend.health() and bench
+receipts.
+"""
+
+import contextlib
+import enum
+import threading
+import time
+from typing import Dict, Optional
+
+from pipelinedp_tpu.runtime import telemetry
+
+
+class HealthState(enum.IntEnum):
+    """Ordered by severity; transitions only escalate (except the
+    STALLED -> DEGRADED recovery demotion)."""
+    HEALTHY = 0
+    DEGRADED = 1
+    STALLED = 2
+    FAILED = 3
+
+
+# Telemetry counters that imply a health event for the current job.
+# retries/fallbacks/degradations/quarantines mean "survived adversity"
+# (DEGRADED); a timeout means "not making progress" (STALLED).
+_DEGRADING_COUNTERS = frozenset({
+    "block_retries",
+    "block_oom_degradations",
+    "reshard_host_fallbacks",
+    "journal_quarantined",
+    "host_fetch_retries",
+    "watchdog_late_completions",
+})
+_STALLING_COUNTERS = frozenset({"block_timeouts", "watchdog_timeouts"})
+_TRACKED_COUNTERS = (_DEGRADING_COUNTERS | _STALLING_COUNTERS |
+                     frozenset({"journal_replays"}))
+
+
+class JobHealth:
+    """Thread-safe health record of one job (keyed by journal job_id)."""
+
+    def __init__(self, job_id: str):
+        self.job_id = job_id
+        self._lock = threading.Lock()
+        self._state = HealthState.HEALTHY
+        self._counters: Dict[str, int] = {}
+        self._phase_seconds: Dict[str, float] = {}
+        self._last_error: Optional[str] = None
+        self._last_beat: Optional[float] = None
+        self._started = time.time()
+        self._completed_runs = 0
+
+    # -- event intake ----------------------------------------------------
+
+    def _escalate(self, state: HealthState) -> None:
+        if self._state is not HealthState.FAILED and state > self._state:
+            self._state = state
+
+    def observe_counter(self, name: str, n: int = 1) -> None:
+        if name not in _TRACKED_COUNTERS:
+            return
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+            if name in _STALLING_COUNTERS:
+                self._escalate(HealthState.STALLED)
+            elif name in _DEGRADING_COUNTERS:
+                self._escalate(HealthState.DEGRADED)
+
+    def observe_duration(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._phase_seconds[name] = (self._phase_seconds.get(name, 0.0) +
+                                         float(seconds))
+
+    def note_timeout(self, phase: str, block: int) -> None:
+        """A deadline expired on an in-flight operation (watchdog verdict;
+        the monitor thread posts this directly)."""
+        with self._lock:
+            self._counters["watchdog_timeouts"] = (
+                self._counters.get("watchdog_timeouts", 0) + 1)
+            self._escalate(HealthState.STALLED)
+            self._last_error = (f"deadline expired: {phase} block {block}")
+
+    def note_recovered(self) -> None:
+        """A stalled operation completed (late) or its retry succeeded:
+        the job is making progress again, but did not run clean."""
+        with self._lock:
+            if self._state is HealthState.STALLED:
+                self._state = HealthState.DEGRADED
+
+    def note_failed(self, exc: BaseException) -> None:
+        with self._lock:
+            self._state = HealthState.FAILED
+            self._last_error = f"{type(exc).__name__}: {exc}"
+
+    def note_complete(self) -> None:
+        with self._lock:
+            self._completed_runs += 1
+            if self._state in (HealthState.STALLED, HealthState.FAILED):
+                # The run finished: whatever stalled (or crashed an
+                # earlier attempt — the journaled-resume path) was
+                # recovered from. The crash stays visible in counters
+                # and last_error; the state reflects the recovery.
+                self._state = HealthState.DEGRADED
+
+    def beat(self) -> None:
+        self._last_beat = time.monotonic()
+
+    # -- queries ---------------------------------------------------------
+
+    @property
+    def state(self) -> HealthState:
+        with self._lock:
+            return self._state
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            age = (None if self._last_beat is None else
+                   round(time.monotonic() - self._last_beat, 3))
+            return {
+                "job_id": self.job_id,
+                "state": self._state.name,
+                "counters": dict(self._counters),
+                "journal_quarantined":
+                    self._counters.get("journal_quarantined", 0),
+                "phase_seconds": {
+                    k: round(v, 6) for k, v in self._phase_seconds.items()
+                },
+                "completed_runs": self._completed_runs,
+                "last_error": self._last_error,
+                "seconds_since_heartbeat": age,
+            }
+
+
+# -- process-wide registry + thread-local current job ---------------------
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, JobHealth] = {}
+_current = threading.local()
+
+
+def for_job(job_id: str) -> JobHealth:
+    """The (process-wide) JobHealth of a job, created on first use."""
+    with _registry_lock:
+        h = _registry.get(job_id)
+        if h is None:
+            h = _registry[job_id] = JobHealth(job_id)
+        return h
+
+
+def current() -> Optional[JobHealth]:
+    stack = getattr(_current, "stack", None)
+    return stack[-1] if stack else None
+
+
+def current_or(job_id: str) -> JobHealth:
+    """The tracked job's health, or the registry entry for job_id when no
+    job is tracked on this thread (e.g. journal access outside a run)."""
+    return current() or for_job(job_id)
+
+
+@contextlib.contextmanager
+def track(health: Optional[JobHealth]):
+    """Makes `health` the thread's current job for telemetry forwarding."""
+    if health is None:
+        yield None
+        return
+    stack = getattr(_current, "stack", None)
+    if stack is None:
+        stack = _current.stack = []
+    stack.append(health)
+    try:
+        yield health
+    finally:
+        stack.pop()
+
+
+@contextlib.contextmanager
+def job_scope(job_id: str):
+    """Driver entry scope: tracks the job and records completion/failure.
+
+    Failures that escape the driver mark the job FAILED; a clean exit
+    records a completed run (demoting STALLED to DEGRADED — the run got
+    through whatever stalled it)."""
+    h = for_job(job_id)
+    h.beat()
+    with track(h):
+        try:
+            yield h
+        except BaseException as e:
+            h.note_failed(e)
+            raise
+    h.note_complete()
+
+
+def observe_counter(name: str, n: int) -> None:
+    """telemetry.record() forwarding hook (no-op when nothing tracked)."""
+    h = current()
+    if h is not None:
+        h.observe_counter(name, n)
+
+
+def observe_duration(name: str, seconds: float) -> None:
+    """telemetry.record_duration() forwarding hook."""
+    h = current()
+    if h is not None:
+        h.observe_duration(name, seconds)
+
+
+def snapshot_all() -> Dict[str, dict]:
+    """Snapshot of every job the process has tracked."""
+    with _registry_lock:
+        jobs = list(_registry.values())
+    return {h.job_id: h.snapshot() for h in jobs}
+
+
+def reset() -> None:
+    """Drops all job records (test isolation)."""
+    with _registry_lock:
+        _registry.clear()

@@ -13,23 +13,49 @@ partition block capacity it ran under), not bare block indices: after an
 OOM degradation the same index means a different partition range, and a
 replay must only ever hit a record of the exact same block geometry.
 
-The journal is deliberately dumb storage — dict in memory, one .npz per
-record when a directory is given (written atomically via os.replace so a
-crash mid-write never leaves a truncated record). Resume across processes
-requires a directory, a stable job_id, and a deterministic noise key
-(TPUBackend(noise_seed=...)); resume within a process needs only the same
-BlockJournal instance.
+Integrity: the journal is the ground truth a resume replays into RELEASED
+DP results, so it is never trusted blindly. Every record carries a CRC32
+over its payload arrays (names, dtypes, shapes, bytes), verified on
+get(); a record that fails verification — truncated, bit-flipped, written
+by a crash the atomic-rename discipline didn't cover, or missing its
+checksum — is QUARANTINED: renamed aside (``<record>.npz.corrupt``),
+never replayed, counted in telemetry (``journal_quarantined``) and the
+job's health snapshot. The block then re-dispatches; under a fixed noise
+seed that re-dispatch derives the same fold_in key, so recovery is a
+replay of the same release, not a second one. Writes fsync before the
+atomic os.replace (a record must be durable before it is nameable), and
+construction sweeps orphaned ``*.tmp`` files left by a crash mid-write.
+
+compact(job_id) drops records superseded by OOM re-planned generations
+(their geometry no longer appears in the journaled plan), bounding the
+directory to the records a resume can actually replay.
+
+Resume across processes requires a directory, a stable job_id, and a
+deterministic noise key (TPUBackend(noise_seed=...)); resume within a
+process needs only the same BlockJournal instance.
 """
 
 import dataclasses
+import logging
 import os
 import re
 import tempfile
+import zlib
 from typing import Dict, Iterable, Optional, Tuple
 
 import numpy as np
 
 _OUT_PREFIX = "out__"
+_CRC_KEY = "__crc32__"
+
+# Journal key of the per-job plan-history record (flattened
+# [base, capacity, generation] triples in BlockRecord.ids); written by
+# retry.run_with_degradation, interpreted by compact().
+PLAN_KEY = "__plan__"
+
+
+class JournalCorruptionError(RuntimeError):
+    """A journal record failed its integrity check."""
 
 
 @dataclasses.dataclass
@@ -53,14 +79,50 @@ def _safe(token: str) -> str:
     return re.sub(r"[^A-Za-z0-9_.-]", "_", str(token))
 
 
+def _payload_crc(payload: Dict[str, np.ndarray]) -> int:
+    """CRC32 over the payload arrays — names, dtypes, shapes and bytes,
+    in sorted-name order so the digest is layout-independent."""
+    crc = 0
+    for name in sorted(payload):
+        a = np.ascontiguousarray(payload[name])
+        header = f"{name}|{a.dtype.str}|{a.shape}|".encode()
+        crc = zlib.crc32(a.tobytes(), zlib.crc32(header, crc))
+    return crc & 0xFFFFFFFF
+
+
 class BlockJournal:
-    """In-memory (optionally directory-backed) record of consumed blocks."""
+    """In-memory (optionally directory-backed) record of consumed blocks.
+
+    Single-writer per (directory, job_id): the crash-recovery sweep and
+    compact() assume no concurrent process is mid-write in the same
+    directory.
+    """
 
     def __init__(self, directory: Optional[str] = None):
         self._mem: Dict[Tuple[str, str], BlockRecord] = {}
         self._dir = directory
         if directory is not None:
             os.makedirs(directory, exist_ok=True)
+            self._sweep_orphan_tmp(directory)
+
+    @staticmethod
+    def _sweep_orphan_tmp(directory: str) -> None:
+        """Removes ``*.tmp`` files a crashed writer left behind. They were
+        never renamed, so no record names them — but left in place they
+        accumulate forever and can confuse directory listings."""
+        for name in os.listdir(directory):
+            if not name.endswith(".tmp"):
+                continue
+            path = os.path.join(directory, name)
+            try:
+                os.unlink(path)
+                logging.warning(
+                    "journal: removed orphaned temp file %s (crash "
+                    "mid-write; the record it was becoming was never "
+                    "named, so nothing is lost that a re-dispatch cannot "
+                    "recompute under the same key)", path)
+            except OSError:
+                pass
 
     def _path(self, job_id: str, key: str) -> str:
         return os.path.join(self._dir, f"{_safe(job_id)}__{_safe(key)}.npz")
@@ -72,17 +134,97 @@ class BlockJournal:
         payload = {"ids": record.ids}
         for name, col in record.outputs.items():
             payload[_OUT_PREFIX + name] = col
-        # Atomic write: a crash mid-save must leave either the old record
-        # or none, never a truncated npz that poisons the resume.
+        payload[_CRC_KEY] = np.uint32(_payload_crc(payload))
+        # Atomic + durable write: fsync BEFORE the rename so a crash can
+        # leave the old record or none — never a named-but-unflushed file
+        # whose content is at the kernel's mercy — and never a truncated
+        # npz that poisons the resume.
         fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp")
         try:
             with os.fdopen(fd, "wb") as f:
                 np.savez(f, **payload)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, self._path(job_id, key))
         except BaseException:
             if os.path.exists(tmp):
                 os.unlink(tmp)
             raise
+        # Fault-injection hook: 'corrupt' faults damage the record that
+        # was just durably written (bit-flip / truncation between write
+        # and replay — the integrity machinery's test case).
+        from pipelinedp_tpu.runtime import faults
+        faults.maybe_corrupt(self._path(job_id, key))
+
+    def _load_verified(self, path: str) -> BlockRecord:
+        """Loads and integrity-checks one record file.
+
+        Raises JournalCorruptionError on a missing or mismatched
+        checksum; np.load itself raises on truncated/garbage zip data.
+        """
+        with np.load(path, allow_pickle=False) as data:
+            payload = {name: data[name] for name in data.files}
+        stored = payload.pop(_CRC_KEY, None)
+        if stored is None:
+            raise JournalCorruptionError(
+                f"{path}: no {_CRC_KEY} checksum — unverifiable records "
+                f"(pre-integrity writes included) are never replayed")
+        actual = _payload_crc(payload)
+        if int(stored) != actual:
+            raise JournalCorruptionError(
+                f"{path}: checksum mismatch (stored {int(stored):#010x}, "
+                f"computed {actual:#010x}) — record is corrupt")
+        if "ids" not in payload:
+            raise JournalCorruptionError(f"{path}: record has no ids array")
+        return BlockRecord(
+            ids=payload["ids"],
+            outputs={
+                name[len(_OUT_PREFIX):]: col
+                for name, col in payload.items()
+                if name.startswith(_OUT_PREFIX)
+            })
+
+    def _quarantine(self, job_id: str, key: str, path: str,
+                    error: BaseException) -> None:
+        """Renames a corrupt record aside so it can never be replayed
+        (``.npz.corrupt`` fails every ``.npz`` listing filter), and
+        surfaces the event in telemetry + the job's health snapshot."""
+        from pipelinedp_tpu.runtime import health as rt_health
+        from pipelinedp_tpu.runtime import telemetry
+        quarantine = path + ".corrupt"
+        n = 0
+        while os.path.exists(quarantine):
+            n += 1
+            quarantine = f"{path}.corrupt.{n}"
+        try:
+            os.replace(path, quarantine)
+        except OSError:
+            # Renaming failed (e.g. permissions): deleting is the only
+            # way left to guarantee the record is never replayed.
+            try:
+                os.unlink(path)
+                quarantine = "<deleted>"
+            except OSError:
+                logging.error(
+                    "journal: could not quarantine corrupt record %s; "
+                    "it remains on disk but will keep failing "
+                    "verification and is never replayed", path)
+                quarantine = "<in place>"
+        # telemetry.record forwards to the thread's tracked job health;
+        # when no job is tracked (journal access outside a run), post the
+        # event on the job's registry entry directly instead.
+        if rt_health.current() is None:
+            with rt_health.track(rt_health.for_job(job_id)):
+                telemetry.record("journal_quarantined")
+        else:
+            telemetry.record("journal_quarantined")
+        logging.warning(
+            "journal: record %s for job %r block %r failed integrity "
+            "verification (%s: %s); quarantined to %s. The block will "
+            "re-dispatch — under a fixed noise seed it re-derives the "
+            "same key, so this is a replay of the same release, never a "
+            "second one.", path, job_id, key, type(error).__name__,
+            str(error).splitlines()[0][:200], quarantine)
 
     def get(self, job_id: str, key: str) -> Optional[BlockRecord]:
         record = self._mem.get((job_id, key))
@@ -91,13 +233,15 @@ class BlockJournal:
         path = self._path(job_id, key)
         if not os.path.exists(path):
             return None
-        with np.load(path, allow_pickle=False) as data:
-            record = BlockRecord(
-                ids=data["ids"],
-                outputs={
-                    name[len(_OUT_PREFIX):]: data[name]
-                    for name in data.files if name.startswith(_OUT_PREFIX)
-                })
+        try:
+            record = self._load_verified(path)
+        except Exception as e:  # noqa: BLE001 - any load/verify failure
+            # Truncated zip central directories raise zipfile/OSError,
+            # flipped bytes raise JournalCorruptionError or ValueError
+            # from within np.load — every one of them means the same
+            # thing: this record cannot be trusted as released truth.
+            self._quarantine(job_id, key, path, e)
+            return None
         self._mem[(job_id, key)] = record
         return record
 
@@ -116,6 +260,69 @@ class BlockJournal:
                     if key not in sanitized_mem:
                         keys.add(key)
         return sorted(keys)
+
+    def compact(self, job_id: str,
+                n_partitions: Optional[int] = None) -> int:
+        """Drops records superseded by OOM re-planned generations.
+
+        The journaled plan (PLAN_KEY) is the list of (base, capacity,
+        generation) ranges the job executed; a block record is LIVE iff
+        its "base:capacity" geometry lies on one of those ranges (range i
+        covers [base_i, base_{i+1}), the last to n_partitions when
+        given). Records from a geometry the plan no longer contains —
+        consumed under a capacity later halved away before the halving
+        point — can never be replayed (get() is always keyed by the
+        current plan's geometry) and only cost disk; compact removes
+        them. Without a journaled plan the run never degraded and every
+        record is live. Returns the number of records dropped.
+        """
+        from pipelinedp_tpu.runtime import telemetry
+        plan = self.get(job_id, PLAN_KEY)
+        if plan is None or plan.ids.size == 0:
+            return 0
+        ranges = [
+            list(map(int, triple))
+            for triple in np.asarray(plan.ids).reshape(-1, 3)
+        ]
+        dropped = 0
+        safe_plan = _safe(PLAN_KEY)
+        for key in list(self.keys(job_id)):
+            if key in (PLAN_KEY, safe_plan):
+                continue
+            m = re.match(r"^(\d+)[:_](\d+)$", key)  # disk form uses '_'
+            if not m:
+                continue
+            base_b, cap_b = int(m.group(1)), int(m.group(2))
+            live = False
+            for i, (base, cap, _gen) in enumerate(ranges):
+                end = (ranges[i + 1][0]
+                       if i + 1 < len(ranges) else n_partitions)
+                if (cap == cap_b and base_b >= base and
+                        (base_b - base) % cap == 0 and
+                        (end is None or base_b < end)):
+                    live = True
+                    break
+            if not live:
+                self._drop(job_id, key)
+                dropped += 1
+        if dropped:
+            telemetry.record("journal_compacted", dropped)
+            logging.info(
+                "journal: compacted %d superseded record(s) for job %r "
+                "(geometries no longer on the journaled plan)", dropped,
+                job_id)
+        return dropped
+
+    def _drop(self, job_id: str, key: str) -> None:
+        self._mem.pop((job_id, key), None)
+        # The sanitized forms of the raw and disk-listed key spellings
+        # land on the same file.
+        for variant in {key, key.replace("_", ":", 1)}:
+            self._mem.pop((job_id, variant), None)
+        if self._dir is not None:
+            path = self._path(job_id, key)
+            if os.path.exists(path):
+                os.unlink(path)
 
     def clear(self, job_id: Optional[str] = None) -> None:
         """Drops records — all of them, or one job's."""

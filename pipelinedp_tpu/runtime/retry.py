@@ -33,6 +33,7 @@ import numpy as np
 from pipelinedp_tpu.runtime import faults
 from pipelinedp_tpu.runtime import journal as journal_lib
 from pipelinedp_tpu.runtime import telemetry
+from pipelinedp_tpu.runtime import watchdog as watchdog_lib
 
 # PJRT status markers of failures worth re-dispatching: the runtime came
 # back (or will), the program itself is fine.
@@ -58,7 +59,10 @@ _OOM_MARKERS = (
 
 
 class BlockOOMError(RuntimeError):
-    """A block kernel exceeded device memory.
+    """A block kernel needs re-planning at a smaller capacity: it either
+    exceeded device memory, or exceeded its deadline through the whole
+    retry budget (halving the block shrinks the allocation AND the
+    per-block work, so both failure classes degrade identically).
 
     `block` is the index of the failed block within the current plan; all
     earlier blocks of the plan were consumed (their results drained and,
@@ -67,7 +71,8 @@ class BlockOOMError(RuntimeError):
     """
 
     def __init__(self, block: int, cause: BaseException):
-        super().__init__(f"block {block} kernel exceeded device memory: "
+        super().__init__(f"block {block} kernel needs re-planning at a "
+                         f"smaller capacity: "
                          f"{type(cause).__name__}: {cause}")
         self.block = block
         self.cause = cause
@@ -88,12 +93,31 @@ def is_transient(exc: BaseException) -> bool:
                   (faults.InjectedDispatchError, faults.InjectedConsumeError,
                    faults.InjectedCollectiveError)):
         return True
+    # A deadline expiry is transient BY DESIGN: the retried block
+    # re-derives the same fold_in key (bit-identical noise), and the
+    # dispatcher escalates exhausted timeouts into OOM-style degradation.
+    if isinstance(exc, watchdog_lib.BlockTimeoutError):
+        return True
     if isinstance(exc, faults.InjectedFault):  # oom / fatal
         return False
     if is_oom(exc):
         return False
     msg = str(exc)
     return any(marker in msg for marker in _TRANSIENT_MARKERS)
+
+
+def is_timeout(exc: BaseException) -> bool:
+    """Whether the failure is a deadline expiry (watchdog verdict or the
+    runtime's own DEADLINE_EXCEEDED). Timeouts are transient — but when
+    one survives the whole retry budget, the dispatcher degrades the
+    block capacity exactly as it would for OOM: a smaller block is
+    likelier to finish inside the deadline, and nothing was released for
+    the timed-out block, so the re-plan draws fresh keys soundly."""
+    if isinstance(exc, watchdog_lib.BlockTimeoutError):
+        return True
+    if isinstance(exc, faults.InjectedFault):
+        return False
+    return "DEADLINE_EXCEEDED" in str(exc)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -133,12 +157,20 @@ def retry_call(fn: Callable,
             faults.maybe_fail("oom", block)
             faults.maybe_fail("dispatch", block)
             faults.maybe_sleep(block)
-            return fn()
+            # Each attempt runs under its own watchdog deadline (when a
+            # watchdog is active on this thread): an expiry cancels the
+            # injected hang / surfaces as BlockTimeoutError, lands in the
+            # transient branch below, and re-dispatches the same key.
+            with watchdog_lib.guard("dispatch", block):
+                faults.maybe_hang(block, point="dispatch")
+                return fn()
         except Exception as e:  # noqa: BLE001 - classified below
             if not is_transient(e) or attempt >= policy.max_retries:
                 raise
             delay = policy.delay(attempt)
             attempt += 1
+            if is_timeout(e):
+                telemetry.record("block_timeouts")
             telemetry.record(counter)
             logging.warning(
                 "%s failed transiently at block %d (%s: %s); retry %d/%d "
@@ -151,8 +183,9 @@ def retry_call(fn: Callable,
 
 
 # Journal key of the per-job plan-history record (flattened
-# [base, capacity, generation] triples in BlockRecord.ids).
-PLAN_KEY = "__plan__"
+# [base, capacity, generation] triples in BlockRecord.ids). Defined in
+# journal.py (compact() interprets it there); re-exported for callers.
+PLAN_KEY = journal_lib.PLAN_KEY
 
 
 def _load_plan(journal, job_id: str,
@@ -236,7 +269,8 @@ def run_with_degradation(run_range: Callable[[int, int, int, int], None],
             capacity //= 2
             telemetry.record("block_oom_degradations")
             logging.warning(
-                "block kernel OOM at partition base %d; halving partition "
+                "block kernel OOM (or exhausted deadline) at partition "
+                "base %d; halving partition "
                 "block capacity to %d and re-planning the remaining "
                 "%d partitions (generation %d). Already-consumed blocks "
                 "keep their drained results; re-planned partitions draw "

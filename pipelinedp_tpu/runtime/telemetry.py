@@ -1,4 +1,4 @@
-"""Process-wide fault-tolerance counters.
+"""Process-wide fault-tolerance counters and phase timings.
 
 A flat Counter rather than per-run stats objects: the drivers that
 increment these live several layers below the entry points that want to
@@ -9,12 +9,30 @@ snapshot() before and after.
 
 Counter names used by the runtime:
   block_retries            transient dispatch/sync failures retried
+  block_timeouts           blocks whose deadline expired (watchdog verdict
+                           or runtime DEADLINE_EXCEEDED surfaced)
   block_oom_degradations   partition block capacity halvings after OOM
+                           (or after repeated deadline expiries)
   reshard_host_fallbacks   device collective reshard -> host permutation
   journal_replays          blocks served from the journal instead of
                            re-dispatching
+  journal_quarantined      corrupt/truncated journal records renamed
+                           aside and never replayed
+  journal_compacted        superseded journal records dropped by
+                           BlockJournal.compact()
+  watchdog_timeouts        deadline expiries observed by the monitor
+  watchdog_late_completions guarded operations that completed after
+                           their deadline had already expired
   host_fetch_retries       transient control-table fetch failures retried
   injected_faults          faults raised by the injection harness
+
+Timings (record_duration) aggregate per-phase wall time as
+(count, min, max, sum); the watchdog and the blocked drivers feed them
+so bench receipts can show where a job's wall clock went. Every counter
+increment and duration is also forwarded to the current job's health
+state machine (runtime/health.py) when one is tracked, which is how
+health aggregates retry/fallback/quarantine telemetry without the
+drivers threading a health object through every layer.
 """
 
 import collections
@@ -23,22 +41,65 @@ from typing import Dict
 
 _lock = threading.Lock()
 counters: "collections.Counter[str]" = collections.Counter()
+# name -> [count, min, max, sum] of recorded durations.
+_timings: Dict[str, list] = {}
 
 
 def record(name: str, n: int = 1) -> None:
     with _lock:
         counters[name] += n
+    # Forward to the current job's health state machine (lazy import:
+    # health imports telemetry for durations, so the top-level import
+    # would be circular; the hook only fires on failure-path events).
+    from pipelinedp_tpu.runtime import health
+    health.observe_counter(name, n)
 
 
-def snapshot() -> Dict[str, int]:
+def record_duration(name: str, seconds: float) -> None:
+    """Aggregates one phase wall-time observation (min/max/sum/count)."""
+    seconds = float(seconds)
     with _lock:
-        return dict(counters)
+        entry = _timings.get(name)
+        if entry is None:
+            _timings[name] = [1, seconds, seconds, seconds]
+        else:
+            entry[0] += 1
+            entry[1] = min(entry[1], seconds)
+            entry[2] = max(entry[2], seconds)
+            entry[3] += seconds
+    from pipelinedp_tpu.runtime import health
+    health.observe_duration(name, seconds)
+
+
+def timing_snapshot() -> Dict[str, Dict[str, float]]:
+    """Per-phase wall-time stats recorded via record_duration."""
+    with _lock:
+        return {
+            name: {
+                "count": entry[0],
+                "min": entry[1],
+                "max": entry[2],
+                "sum": entry[3],
+            }
+            for name, entry in _timings.items()
+        }
+
+
+def snapshot(timings: bool = False) -> Dict[str, int]:
+    """Counter values (plus, with timings=True, a nested "timings" key
+    holding the record_duration stats — leave False when the result is
+    fed to delta(), which subtracts integer counters only)."""
+    with _lock:
+        out = dict(counters)
+    if timings:
+        out["timings"] = timing_snapshot()
+    return out
 
 
 def delta(before: Dict[str, int]) -> Dict[str, int]:
     """Counter increments since a snapshot() (zero-valued keys omitted)."""
     now = snapshot()
-    keys = set(now) | set(before)
+    keys = {k for k in set(now) | set(before) if k != "timings"}
     out = {k: now.get(k, 0) - before.get(k, 0) for k in keys}
     return {k: v for k, v in out.items() if v}
 
@@ -46,3 +107,4 @@ def delta(before: Dict[str, int]) -> Dict[str, int]:
 def reset() -> None:
     with _lock:
         counters.clear()
+        _timings.clear()

@@ -1,0 +1,318 @@
+"""Deadline watchdog: bounds how long any block-stream step may take.
+
+PR 2 made the blocked runtime survive crashes; this module makes it
+survive *hangs* — a stuck collective, a stalled dispatch, a device that
+stops making progress. Nothing else in the stack bounds step time, so a
+single wedged operation would stall a million-block job forever with no
+signal.
+
+Model: every monitored operation (a block dispatch, a drain sync, the
+device-reshard collective, a control-table fetch) runs inside a
+``Watchdog.guard(phase, block)`` scope with a deadline — explicit
+(``timeout_s``), or auto-derived as a multiple of the profiled pass-1
+block time (``seed_profile``/``observe``). A background monitor thread
+scans the in-flight guards; on expiry it
+
+  * sets the guard's cancel event (cooperative cancellation points —
+    the injected ``hang`` fault's poll loop, and any future code that
+    checks ``guard.cancelled`` — raise ``BlockTimeoutError``),
+  * records the ``watchdog_timeouts`` telemetry counter, and
+  * posts a STALLED verdict on the job's health record (captured at
+    guard creation, because the monitor thread cannot see the driver
+    thread's current job).
+
+``BlockTimeoutError`` is classified *transient* by runtime/retry.py, so
+a timed-out block re-dispatches under the same ``fold_in(final_key, b)``
+key — bit-identical noise, no second release — and *repeated* timeouts
+exhaust the retry budget and degrade exactly like OOM (the dispatcher
+converts an exhausted timeout into ``BlockOOMError``, halving the
+partition block capacity: smaller blocks are likelier to finish inside
+the deadline). A deadline expiry on the device-reshard collective is a
+collective failure and falls back to the host LPT permutation.
+
+Honesty note: Python cannot preempt a wedged native call. A truly stuck
+XLA execution raises at the next cooperative point; until then the
+monitor's verdict (telemetry + STALLED health + a log line) is the
+detection signal. Operations that complete *after* their deadline are
+kept (using them is a replay of the same release, and discarding a
+finished result would only re-pay its cost) but are counted as
+``watchdog_late_completions`` and degrade health.
+"""
+
+import contextlib
+import logging
+import math
+import threading
+import time
+from typing import Dict, Optional
+
+from pipelinedp_tpu import input_validators
+from pipelinedp_tpu.runtime import telemetry
+
+
+class BlockTimeoutError(RuntimeError):
+    """An operation exceeded its watchdog deadline.
+
+    Transient by classification: the retried operation re-derives the
+    same block key, so the retry is a replay of the same DP release.
+    """
+
+    def __init__(self, phase: str, block: int, timeout_s: float,
+                 detail: str = ""):
+        super().__init__(
+            f"{phase} for block {block} exceeded its "
+            f"{timeout_s:.3f}s deadline"
+            f"{(': ' + detail) if detail else ''}")
+        self.phase = phase
+        self.block = block
+        self.timeout_s = timeout_s
+
+
+class _Guard:
+    """One monitored in-flight operation."""
+
+    __slots__ = ("phase", "block", "started", "deadline", "timeout_s",
+                 "cancel", "expired", "health")
+
+    def __init__(self, phase: str, block: int, timeout_s: float, health):
+        self.phase = phase
+        self.block = block
+        self.started = time.monotonic()
+        self.timeout_s = timeout_s
+        self.deadline = (self.started + timeout_s
+                         if math.isfinite(timeout_s) else math.inf)
+        self.cancel = threading.Event()
+        self.expired = False
+        self.health = health
+
+    @property
+    def cancelled(self) -> bool:
+        return self.cancel.is_set()
+
+    def raise_if_expired(self) -> None:
+        if self.expired:
+            raise BlockTimeoutError(self.phase, self.block, self.timeout_s)
+
+
+class Watchdog:
+    """Deadline/heartbeat monitor shared by one job's monitored steps.
+
+    timeout_s: one deadline for every guarded operation. None derives
+        deadlines from the profile instead: multiplier * the largest
+        observed completed-operation time (seeded by the drivers with
+        the pass-1 wall time — pass 1 touches every row, so any single
+        block is strictly cheaper). With neither a timeout nor a profile,
+        guards carry no deadline (infinite) — the watchdog then only
+        tracks heartbeats.
+    multiplier: auto-deadline factor over the profiled time.
+    min_timeout_s: floor of the auto-derived deadline (profiled times on
+        tiny inputs are microseconds; a deadline below scheduler jitter
+        would flag healthy blocks).
+    poll_interval_s: monitor thread scan period.
+    """
+
+    def __init__(self,
+                 timeout_s: Optional[float] = None,
+                 multiplier: float = 8.0,
+                 min_timeout_s: float = 0.25,
+                 poll_interval_s: float = 0.02):
+        if timeout_s is not None:
+            input_validators.validate_timeout_s(timeout_s, "Watchdog")
+        if multiplier <= 0:
+            raise ValueError(f"Watchdog: multiplier must be positive, "
+                             f"got {multiplier}")
+        self.timeout_s = timeout_s
+        self.multiplier = multiplier
+        self.min_timeout_s = min_timeout_s
+        self.poll_interval_s = poll_interval_s
+        self._lock = threading.Lock()
+        self._guards: Dict[int, _Guard] = {}
+        self._profile: Dict[str, float] = {}
+        self._next_id = 0
+        self._monitor: Optional[threading.Thread] = None
+        self._closed = False
+        self._last_beat: Optional[tuple] = None
+
+    # -- deadlines -------------------------------------------------------
+
+    def seed_profile(self, seconds: float, phase: str = "*") -> None:
+        """Seeds the auto-deadline profile (drivers pass the pass-1 wall
+        time; "*" applies to every phase without its own observation)."""
+        self.observe(phase, seconds)
+
+    def observe(self, phase: str, seconds: float) -> None:
+        """Feeds one completed-operation time into the auto profile."""
+        with self._lock:
+            self._profile[phase] = max(self._profile.get(phase, 0.0),
+                                       float(seconds))
+
+    def resolved_timeout(self, phase: str,
+                         timeout_s: Optional[float] = None) -> float:
+        """Deadline seconds for one operation: explicit per-call, else the
+        watchdog-wide timeout_s, else multiplier * profiled time, else
+        +inf (no deadline)."""
+        if timeout_s is not None:
+            return float(timeout_s)
+        if self.timeout_s is not None:
+            return float(self.timeout_s)
+        with self._lock:
+            profiled = self._profile.get(phase, self._profile.get("*"))
+        if profiled is None:
+            return math.inf
+        return max(self.multiplier * profiled, self.min_timeout_s)
+
+    # -- guards ----------------------------------------------------------
+
+    @contextlib.contextmanager
+    def guard(self, phase: str, block: int = 0,
+              timeout_s: Optional[float] = None):
+        """Monitors one operation; yields the guard token.
+
+        The guard's duration feeds telemetry (record_duration under
+        "watchdog_<phase>") and the auto profile. Completing after the
+        deadline is counted and degrades health but does not discard the
+        result (module docstring)."""
+        from pipelinedp_tpu.runtime import health as rt_health
+        g = _Guard(phase, block, self.resolved_timeout(phase, timeout_s),
+                   rt_health.current())
+        with self._lock:
+            gid = self._next_id
+            self._next_id += 1
+            self._guards[gid] = g
+            self._ensure_monitor()
+        _push_token(g)
+        failed = False
+        try:
+            yield g
+        except BaseException:
+            failed = True
+            raise
+        finally:
+            _pop_token(g)
+            with self._lock:
+                self._guards.pop(gid, None)
+            dt = time.monotonic() - g.started
+            telemetry.record_duration(f"watchdog_{phase}", dt)
+            self.observe(phase, dt)
+            self._last_beat = (phase, time.monotonic())
+            if g.expired and not failed:
+                telemetry.record("watchdog_late_completions")
+                if g.health is not None:
+                    g.health.note_recovered()
+                logging.warning(
+                    "%s for block %d completed %.3fs after its %.3fs "
+                    "deadline; the result is kept (same release) but the "
+                    "job is marked degraded.", phase, block,
+                    dt - g.timeout_s, g.timeout_s)
+
+    def check(self, g: Optional[_Guard]) -> None:
+        """Cooperative cancellation point: raises if the guard expired."""
+        if g is not None:
+            g.raise_if_expired()
+
+    def beat(self, phase: str = "") -> None:
+        """Heartbeat from an unguarded step (e.g. host_fetch): updates the
+        liveness timestamp surfaced in health snapshots."""
+        from pipelinedp_tpu.runtime import health as rt_health
+        self._last_beat = (phase, time.monotonic())
+        h = rt_health.current()
+        if h is not None:
+            h.beat()
+
+    def seconds_since_beat(self) -> Optional[float]:
+        beat = self._last_beat
+        return None if beat is None else time.monotonic() - beat[1]
+
+    # -- monitor ---------------------------------------------------------
+
+    def _ensure_monitor(self) -> None:
+        # Called under self._lock.
+        if self._monitor is None or not self._monitor.is_alive():
+            self._monitor = threading.Thread(target=self._run_monitor,
+                                             name="pdp-watchdog",
+                                             daemon=True)
+            self._monitor.start()
+
+    def _run_monitor(self) -> None:
+        while not self._closed:
+            now = time.monotonic()
+            with self._lock:
+                expiring = [
+                    g for g in self._guards.values()
+                    if not g.expired and now >= g.deadline
+                ]
+            for g in expiring:
+                g.expired = True
+                g.cancel.set()
+                telemetry.record("watchdog_timeouts")
+                if g.health is not None:
+                    g.health.note_timeout(g.phase, g.block)
+                logging.warning(
+                    "watchdog: %s for block %d has been in flight %.3fs "
+                    "(> %.3fs deadline); cancelling at the next "
+                    "cooperative point — the retried block re-derives "
+                    "the same key (bit-identical noise, no second "
+                    "release).", g.phase, g.block,
+                    now - g.started, g.timeout_s)
+            time.sleep(self.poll_interval_s)
+
+    def close(self) -> None:
+        self._closed = True
+
+
+# -- thread-local activation + current guard token ------------------------
+
+_tls = threading.local()
+
+
+def active() -> Optional[Watchdog]:
+    """The watchdog activated for the current thread, if any."""
+    stack = getattr(_tls, "watchdogs", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def activate(wd: Optional[Watchdog]):
+    """Scopes `wd` as the thread's active watchdog (None = no-op), so
+    layers without a watchdog parameter (retry_call, stage_rows_to_mesh,
+    host_fetch) can guard/heartbeat without signature changes."""
+    if wd is None:
+        yield None
+        return
+    stack = getattr(_tls, "watchdogs", None)
+    if stack is None:
+        stack = _tls.watchdogs = []
+    stack.append(wd)
+    try:
+        yield wd
+    finally:
+        stack.pop()
+
+
+def _push_token(g: _Guard) -> None:
+    stack = getattr(_tls, "tokens", None)
+    if stack is None:
+        stack = _tls.tokens = []
+    stack.append(g)
+
+
+def _pop_token(g: _Guard) -> None:
+    stack = getattr(_tls, "tokens", None)
+    if stack and stack[-1] is g:
+        stack.pop()
+
+
+def current_token() -> Optional[_Guard]:
+    """The innermost guard on this thread (the injected hang fault polls
+    its cancel event so a deadline expiry cancels the hang)."""
+    stack = getattr(_tls, "tokens", None)
+    return stack[-1] if stack else None
+
+
+def guard(phase: str, block: int = 0):
+    """Guard under the thread's active watchdog; no-op context without
+    one. The convenience form used at the runtime's hook points."""
+    wd = active()
+    if wd is None:
+        return contextlib.nullcontext()
+    return wd.guard(phase, block)

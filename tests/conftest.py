@@ -9,7 +9,11 @@ env var), so the config must be reset *programmatically* after importing
 jax — before any backend is initialized.
 """
 
+import _thread
 import os
+import threading
+
+import pytest
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -25,13 +29,42 @@ jax.config.update("jax_enable_x64", True)
 
 
 def pytest_configure(config):
-    # Registered here (no pytest.ini in this repo) so -m filters stay
-    # warning-free. The tier-1 command runs `-m 'not slow'`, so `faults`
-    # tests — the fault-injection harness suite — are part of tier-1 by
+    # Also registered in pytest.ini; kept here so a stray invocation from
+    # another rootdir stays warning-free. The tier-1 command runs
+    # `-m 'not slow'`, so `faults` tests — the fault-injection harness
+    # suite, including the hang/corrupt kinds — are part of tier-1 by
     # default and selectable alone with `-m faults`.
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 run (-m 'not slow')")
     config.addinivalue_line(
         "markers",
-        "faults: fault-injection/robustness tests (runs in tier-1; "
-        "select alone with -m faults)")
+        "faults: fault-injection/robustness tests, including the "
+        "hang/corrupt kinds (runs in tier-1; select alone with "
+        "-m faults)")
+    config.addinivalue_line(
+        "markers",
+        "hard_timeout(seconds): outer hard timeout enforced by the "
+        "conftest guard — a watchdog BUG in the code under test cannot "
+        "hang tier-1")
+
+
+@pytest.fixture(autouse=True)
+def _hard_timeout_guard(request):
+    """Outer safety net for the watchdog/hang tests: if a test marked
+    hard_timeout runs past its limit (i.e. the deadline machinery under
+    test failed to cancel an injected hang), interrupt the main thread so
+    the test FAILS instead of wedging the whole tier-1 run. The injected
+    hang hooks sleep in small increments, so KeyboardInterrupt lands
+    promptly."""
+    marker = request.node.get_closest_marker("hard_timeout")
+    if marker is None:
+        yield
+        return
+    limit = float(marker.args[0]) if marker.args else 120.0
+    timer = threading.Timer(limit, _thread.interrupt_main)
+    timer.daemon = True
+    timer.start()
+    try:
+        yield
+    finally:
+        timer.cancel()

@@ -297,6 +297,258 @@ class TestJournalResume:
                                           err_msg=name)
 
 
+def _flip_middle_byte(path):
+    import os
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size // 2)
+        byte = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([byte[0] ^ 0xFF]))
+
+
+class TestJournalIntegrity:
+    """Corrupt/truncated records are quarantined — renamed aside, never
+    replayed — and the resumed run recomputes them bit-identically."""
+
+    def _record(self):
+        return journal_lib.BlockRecord(ids=np.arange(7, dtype=np.int64),
+                                       outputs={"count": np.full(7, 3.0)})
+
+    def test_flipped_byte_quarantined(self, tmp_path):
+        j = runtime.BlockJournal(str(tmp_path))
+        key = journal_lib.block_key(0, 64)
+        j.put("jq", key, self._record())
+        _flip_middle_byte(j._path("jq", key))
+        fresh = runtime.BlockJournal(str(tmp_path))
+        before = telemetry.snapshot()
+        assert fresh.get("jq", key) is None
+        assert telemetry.delta(before).get("journal_quarantined") == 1
+        # Renamed aside: no longer listed, and a second get stays None
+        # without re-counting.
+        assert list(fresh.keys("jq")) == []
+        assert fresh.get("jq", key) is None
+        quarantined = [
+            p.name for p in tmp_path.iterdir() if ".corrupt" in p.name
+        ]
+        assert len(quarantined) == 1
+
+    def test_truncated_record_quarantined(self, tmp_path):
+        import os
+        j = runtime.BlockJournal(str(tmp_path))
+        key = journal_lib.block_key(64, 64)
+        j.put("jq", key, self._record())
+        path = j._path("jq", key)
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) // 2)
+        assert runtime.BlockJournal(str(tmp_path)).get("jq", key) is None
+
+    def test_missing_checksum_never_replayed(self, tmp_path):
+        # A record written without a checksum (e.g. by a pre-integrity
+        # build) is unverifiable and must not be replayed as released
+        # truth.
+        j = runtime.BlockJournal(str(tmp_path))
+        key = journal_lib.block_key(128, 64)
+        path = j._path("jq", key)
+        np.savez(path, ids=np.arange(3, dtype=np.int64))
+        assert runtime.BlockJournal(str(tmp_path)).get("jq", key) is None
+
+    def test_stale_tmp_files_swept(self, tmp_path):
+        (tmp_path / "orphanXYZ.tmp").write_bytes(b"half-written")
+        runtime.BlockJournal(str(tmp_path))
+        assert not (tmp_path / "orphanXYZ.tmp").exists()
+
+    def test_good_records_round_trip_with_checksum(self, tmp_path):
+        j = runtime.BlockJournal(str(tmp_path))
+        key = journal_lib.block_key(0, 32)
+        record = self._record()
+        j.put("ok", key, record)
+        loaded = runtime.BlockJournal(str(tmp_path)).get("ok", key)
+        np.testing.assert_array_equal(loaded.ids, record.ids)
+        np.testing.assert_array_equal(loaded.outputs["count"],
+                                      record.outputs["count"])
+
+    def test_compact_drops_superseded_geometries(self, tmp_path):
+        j = runtime.BlockJournal(str(tmp_path))
+        # Plan: [0, 128) at C=128 (gen 0), then re-planned to C=64 from
+        # 128 (gen 1) — so C=128 records at base >= 128 are superseded.
+        j.put(
+            "jc", journal_lib.PLAN_KEY,
+            journal_lib.BlockRecord(ids=np.asarray(
+                [0, 128, 0, 128, 64, 1], dtype=np.int64),
+                                    outputs={}))
+        j.put("jc", journal_lib.block_key(0, 128), self._record())
+        j.put("jc", journal_lib.block_key(128, 128), self._record())
+        j.put("jc", journal_lib.block_key(128, 64), self._record())
+        j.put("jc", journal_lib.block_key(192, 64), self._record())
+        before = telemetry.snapshot()
+        dropped = j.compact("jc", n_partitions=256)
+        assert dropped == 1
+        assert telemetry.delta(before).get("journal_compacted") == 1
+        assert j.get("jc", journal_lib.block_key(128, 128)) is None
+        for live in (journal_lib.block_key(0, 128),
+                     journal_lib.block_key(128, 64),
+                     journal_lib.block_key(192, 64)):
+            assert j.get("jc", live) is not None
+        # Idempotent, and a fresh instance over the directory agrees.
+        assert j.compact("jc", n_partitions=256) == 0
+        assert runtime.BlockJournal(str(tmp_path)).compact(
+            "jc", n_partitions=256) == 0
+
+    def test_compact_without_plan_is_noop(self, tmp_path):
+        j = runtime.BlockJournal(str(tmp_path))
+        j.put("jn", journal_lib.block_key(0, 128), self._record())
+        assert j.compact("jn") == 0
+        assert j.get("jn", journal_lib.block_key(0, 128)) is not None
+
+
+class TestQuarantineResumeAllDrivers:
+    """Crash -> corrupt one journal record on disk -> resume with a fresh
+    journal instance: the corrupt record is quarantined (never replayed),
+    the block recomputes under the same key, and the final outputs are
+    bit-identical to the fault-free run — across all four blocked/sharded
+    drivers."""
+
+    def _corrupt_one_record(self, tmp_path, job):
+        import os
+        records = sorted(p for p in os.listdir(str(tmp_path))
+                         if p.startswith(job + "__") and
+                         p.endswith(".npz") and "__plan__" not in p)
+        assert records, "crashed run journaled nothing"
+        _flip_middle_byte(str(tmp_path / records[0]))
+
+    def _check(self, tmp_path, job, run):
+        base = run(None)
+        with faults.inject(
+                faults.FaultSchedule([faults.Fault("fatal", block=3)])):
+            with pytest.raises(faults.InjectedFatalError):
+                run(runtime.BlockJournal(str(tmp_path)))
+        self._corrupt_one_record(tmp_path, job)
+        before = telemetry.snapshot()
+        resumed = run(runtime.BlockJournal(str(tmp_path)))
+        delta = telemetry.delta(before)
+        assert delta.get("journal_quarantined") == 1, delta
+        assert delta.get("journal_replays", 0) >= 1, delta
+        if isinstance(base, tuple):
+            kept, out = base
+            kept_r, out_r = resumed
+            np.testing.assert_array_equal(kept, kept_r)
+            for name in out:
+                np.testing.assert_array_equal(out[name], out_r[name],
+                                              err_msg=name)
+        else:
+            np.testing.assert_array_equal(base, resumed)
+        snap = runtime.health.for_job(job).snapshot()
+        assert snap["journal_quarantined"] >= 1
+        assert snap["state"] == "DEGRADED"
+
+    def test_aggregate_blocked(self, tmp_path):
+        P = 1000
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P)
+        pid, pk, values, valid = _data(P=P)
+
+        def run(journal):
+            return large_p.aggregate_blocked(
+                pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+                np.asarray(stds), jax.random.PRNGKey(7), cfg,
+                block_partitions=128, retry=FAST, journal=journal,
+                job_id="qa-agg")
+
+        self._check(tmp_path, "qa-agg", run)
+
+    def test_select_partitions_blocked(self, tmp_path):
+        P, l0 = 1000, 30
+        selection = selection_ops.selection_params_from_host(
+            pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC, 1e7, 1e-5,
+            l0, None)
+        rows = []
+        for p in range(0, P, 7):
+            for u in range(40):
+                rows.append((u * 100_003 + p, p))
+        pid = np.array([r[0] for r in rows], np.int64)
+        pk = np.array([r[1] for r in rows], np.int32)
+        valid = np.ones(len(rows), bool)
+
+        def run(journal):
+            return large_p.select_partitions_blocked(
+                pid, pk, valid, jax.random.PRNGKey(5), l0, P, selection,
+                block_partitions=128, retry=FAST, journal=journal,
+                job_id="qa-sel")
+
+        self._check(tmp_path, "qa-sel", run)
+
+    def test_aggregate_blocked_sharded(self, tmp_path):
+        mesh = make_mesh(n_devices=8)
+        P = 1 << 12
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P)
+        pid, pk, values, valid = _data(P=P)
+        pk = (pk.astype(np.int64) % P).astype(np.int32)
+
+        def run(journal):
+            return large_p.aggregate_blocked_sharded(
+                mesh, pid, pk, values, valid, min_v, max_v, min_s, max_s,
+                mid, np.asarray(stds), jax.random.PRNGKey(7), cfg,
+                block_partitions=1 << 9, retry=FAST, journal=journal,
+                job_id="qa-agg-sh")
+
+        self._check(tmp_path, "qa-agg-sh", run)
+
+    def test_select_partitions_blocked_sharded(self, tmp_path):
+        mesh = make_mesh(n_devices=8)
+        P, l0 = 1 << 12, 30
+        selection = selection_ops.selection_params_from_host(
+            pdp.PartitionSelectionStrategy.TRUNCATED_GEOMETRIC, 1e7, 1e-5,
+            l0, None)
+        rows = []
+        for p in range(0, P, 29):
+            for u in range(40):
+                rows.append((u * 100_003 + p, p))
+        pid = np.array([r[0] for r in rows], np.int64)
+        pk = np.array([r[1] for r in rows], np.int32)
+        valid = np.ones(len(rows), bool)
+
+        def run(journal):
+            return large_p.select_partitions_blocked_sharded(
+                mesh, pid, pk, valid, jax.random.PRNGKey(5), l0, P,
+                selection, block_partitions=1 << 9, retry=FAST,
+                journal=journal, job_id="qa-sel-sh")
+
+        self._check(tmp_path, "qa-sel-sh", run)
+
+    def test_corrupt_fault_kind_end_to_end(self, tmp_path):
+        """The scripted 'corrupt' fault (vs. manual byte surgery above):
+        a record poisoned the moment it is written is quarantined on the
+        cross-process resume and the rerun is bit-identical."""
+        P = 1000
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P)
+        pid, pk, values, valid = _data(P=P)
+
+        def run(journal):
+            return large_p.aggregate_blocked(
+                pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+                np.asarray(stds), jax.random.PRNGKey(7), cfg,
+                block_partitions=128, retry=FAST, journal=journal,
+                job_id="qa-corrupt")
+
+        base = run(None)
+        sched = faults.FaultSchedule([
+            faults.Fault("corrupt", mode="truncate"),
+            faults.Fault("fatal", block=5),
+        ])
+        with faults.inject(sched):
+            with pytest.raises(faults.InjectedFatalError):
+                run(runtime.BlockJournal(str(tmp_path)))
+        assert sched.pending() == 0
+        before = telemetry.snapshot()
+        resumed = run(runtime.BlockJournal(str(tmp_path)))
+        delta = telemetry.delta(before)
+        assert delta.get("journal_quarantined") == 1, delta
+        np.testing.assert_array_equal(base[0], resumed[0])
+        for name in base[1]:
+            np.testing.assert_array_equal(base[1][name], resumed[1][name],
+                                          err_msg=name)
+
+
 class TestBlockedSelectionFaults:
 
     def test_selection_faulted_matches(self):

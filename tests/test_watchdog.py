@@ -1,0 +1,436 @@
+"""Hang detection and deadline recovery: the watchdog monitor, the
+timeout -> same-key retry -> capacity-degradation ladder, the health
+state machine, and the runtime-knob validators (pipelinedp_tpu/runtime/
+watchdog.py + health.py).
+
+Every hang here is injected (faults.Fault("hang", ...)) and doubly
+bounded: the watchdog deadline cancels it, and the fault's own `delay`
+hard cap fires even if the watchdog never does — plus the conftest
+hard_timeout guard interrupts the whole test if BOTH fail, so a watchdog
+bug cannot hang tier-1.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import combiners, executor, input_validators, runtime
+from pipelinedp_tpu.aggregate_params import MechanismType
+from pipelinedp_tpu.ops import selection_ops
+from pipelinedp_tpu.parallel import large_p, make_mesh
+from pipelinedp_tpu.runtime import faults
+from pipelinedp_tpu.runtime import health as health_lib
+from pipelinedp_tpu.runtime import retry as retry_lib
+from pipelinedp_tpu.runtime import telemetry
+from pipelinedp_tpu.runtime import watchdog as watchdog_lib
+
+pytestmark = [pytest.mark.faults, pytest.mark.hard_timeout(120)]
+
+FAST = retry_lib.RetryPolicy(max_retries=3, base_delay=0.0, max_delay=0.0)
+
+
+def _spec(P, eps=1.0, l0=4, linf=8):
+    params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+                                 noise_kind=pdp.NoiseKind.LAPLACE,
+                                 max_partitions_contributed=l0,
+                                 max_contributions_per_partition=linf,
+                                 min_value=0.0,
+                                 max_value=5.0)
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=eps,
+                                           total_delta=1e-6)
+    compound = combiners.create_compound_combiner(params, accountant)
+    budget = accountant.request_budget(MechanismType.GENERIC)
+    accountant.compute_budgets()
+    selection = selection_ops.selection_params_from_host(
+        params.partition_selection_strategy, budget.eps, budget.delta, l0,
+        None)
+    cfg = executor.make_kernel_config(params, compound, P,
+                                      private_selection=True,
+                                      selection_params=selection)
+    stds = executor.compute_noise_stds(compound, params)
+    return cfg, stds, executor.kernel_scalars(params)
+
+
+def _data(n=20_000, n_ids=500, P=1000, seed=0):
+    rng = np.random.default_rng(seed)
+    pid = rng.integers(0, n_ids, n).astype(np.int32)
+    pk = rng.integers(0, P, n).astype(np.int32)
+    values = rng.uniform(0, 5, n)
+    return pid, pk, values, np.ones(n, bool)
+
+
+class TestWatchdogUnit:
+
+    def test_expiry_sets_cancel_and_counts(self):
+        wd = watchdog_lib.Watchdog(timeout_s=0.05, poll_interval_s=0.01)
+        before = telemetry.snapshot()
+        with pytest.raises(watchdog_lib.BlockTimeoutError):
+            with wd.guard("dispatch", 7) as g:
+                assert g.cancel.wait(2.0), "monitor never cancelled"
+                g.raise_if_expired()
+        assert telemetry.delta(before).get("watchdog_timeouts") == 1
+
+    def test_resolved_timeout_precedence(self):
+        wd = watchdog_lib.Watchdog(timeout_s=None, multiplier=4.0,
+                                   min_timeout_s=0.1)
+        # No profile, no timeout: no deadline.
+        assert wd.resolved_timeout("dispatch") == float("inf")
+        wd.seed_profile(1.0)
+        assert wd.resolved_timeout("dispatch") == pytest.approx(4.0)
+        # Per-phase observation beats the "*" seed when larger.
+        wd.observe("dispatch", 2.0)
+        assert wd.resolved_timeout("dispatch") == pytest.approx(8.0)
+        # The floor applies to tiny profiled times.
+        wd2 = watchdog_lib.Watchdog(multiplier=4.0, min_timeout_s=0.5)
+        wd2.seed_profile(1e-6)
+        assert wd2.resolved_timeout("drain") == pytest.approx(0.5)
+        # Explicit per-call and watchdog-wide timeouts win.
+        assert wd.resolved_timeout("dispatch", 0.3) == pytest.approx(0.3)
+        wd3 = watchdog_lib.Watchdog(timeout_s=2.5)
+        wd3.seed_profile(100.0)
+        assert wd3.resolved_timeout("dispatch") == pytest.approx(2.5)
+
+    def test_late_completion_kept_and_counted(self):
+        wd = watchdog_lib.Watchdog(timeout_s=0.03, poll_interval_s=0.01)
+        before = telemetry.snapshot()
+        with wd.guard("drain", 0) as g:
+            g.cancel.wait(2.0)  # deadline expired mid-operation...
+        # ...but the operation completed: no raise, counted as late.
+        delta = telemetry.delta(before)
+        assert delta.get("watchdog_timeouts") == 1
+        assert delta.get("watchdog_late_completions") == 1
+
+    def test_invalid_timeouts_rejected(self):
+        for bad in (0, -1, float("nan"), float("inf")):
+            with pytest.raises(ValueError, match="timeout_s"):
+                watchdog_lib.Watchdog(timeout_s=bad)
+        with pytest.raises(ValueError, match="multiplier"):
+            watchdog_lib.Watchdog(multiplier=0)
+
+    def test_guard_without_active_watchdog_is_noop(self):
+        with watchdog_lib.guard("dispatch", 0):
+            assert watchdog_lib.current_token() is None
+
+
+class TestRuntimeKnobValidation:
+
+    def test_backend_rejects_bad_knobs(self):
+        with pytest.raises(ValueError, match="timeout_s"):
+            pdp.TPUBackend(timeout_s=-1)
+        with pytest.raises(ValueError, match="non-empty"):
+            pdp.TPUBackend(job_id="  ")
+        with pytest.raises(ValueError, match="path"):
+            pdp.TPUBackend(job_id="../steal")
+        with pytest.raises(ValueError, match="max_retries"):
+            pdp.TPUBackend(retry=retry_lib.RetryPolicy(max_retries=-1))
+        # Valid knobs construct fine.
+        pdp.TPUBackend(timeout_s=30.0, job_id="job-1", retry=FAST)
+
+    def test_driver_rejects_bad_knobs(self):
+        P = 64
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P)
+        pid, pk, values, valid = _data(n=100, P=P)
+        args = (pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+                np.asarray(stds), jax.random.PRNGKey(0), cfg)
+        with pytest.raises(ValueError, match="timeout_s"):
+            large_p.aggregate_blocked(*args, timeout_s=0)
+        with pytest.raises(ValueError, match="path"):
+            large_p.aggregate_blocked(*args, job_id="a/b")
+        with pytest.raises(ValueError, match="max_retries"):
+            large_p.aggregate_blocked(
+                *args, retry=retry_lib.RetryPolicy(max_retries=-2))
+
+    def test_validator_messages_are_actionable(self):
+        with pytest.raises(ValueError, match="None to disable"):
+            input_validators.validate_timeout_s(-3, "T")
+        with pytest.raises(ValueError, match="file-name"):
+            input_validators.validate_job_id("x" * 500, "T")
+
+
+class TestTelemetryTiming:
+
+    def test_min_max_sum_count(self):
+        telemetry.record_duration("phase_x", 0.5)
+        telemetry.record_duration("phase_x", 1.5)
+        snap = telemetry.snapshot(timings=True)["timings"]["phase_x"]
+        assert snap["count"] == 2
+        assert snap["min"] == pytest.approx(0.5)
+        assert snap["max"] == pytest.approx(1.5)
+        assert snap["sum"] == pytest.approx(2.0)
+        # delta() stays integer-counter-only even across timing updates.
+        before = telemetry.snapshot()
+        telemetry.record_duration("phase_x", 1.0)
+        assert telemetry.delta(before) == {}
+
+
+class TestHealthStateMachine:
+
+    def test_escalation_and_recovery(self):
+        h = health_lib.JobHealth("t-job")
+        assert h.state is health_lib.HealthState.HEALTHY
+        h.observe_counter("block_retries", 1)
+        assert h.state is health_lib.HealthState.DEGRADED
+        h.note_timeout("dispatch", 3)
+        assert h.state is health_lib.HealthState.STALLED
+        h.note_recovered()
+        assert h.state is health_lib.HealthState.DEGRADED
+        h.note_failed(RuntimeError("boom"))
+        assert h.state is health_lib.HealthState.FAILED
+        # FAILED ignores further escalation...
+        h.observe_counter("watchdog_timeouts", 1)
+        assert h.state is health_lib.HealthState.FAILED
+        # ...until a later run of the job completes (journaled resume).
+        h.note_complete()
+        assert h.state is health_lib.HealthState.DEGRADED
+        snap = h.snapshot()
+        assert snap["state"] == "DEGRADED"
+        assert snap["counters"]["block_retries"] == 1
+        assert snap["last_error"] == "RuntimeError: boom"
+
+    def test_job_scope_tracks_and_completes(self):
+        with health_lib.job_scope("scope-job") as h:
+            telemetry.record("block_retries")
+        assert h.snapshot()["counters"]["block_retries"] >= 1
+        assert h.snapshot()["completed_runs"] == 1
+        assert h.state is health_lib.HealthState.DEGRADED
+
+    def test_job_scope_records_failure(self):
+        with pytest.raises(RuntimeError):
+            with health_lib.job_scope("fail-job"):
+                raise RuntimeError("kaput")
+        h = health_lib.for_job("fail-job")
+        assert h.state is health_lib.HealthState.FAILED
+        assert "kaput" in h.snapshot()["last_error"]
+
+
+class TestHangRecovery:
+    """A hang on a dispatch and on a drain each recovers within the
+    deadline and yields bit-identical outputs (same fold_in key)."""
+
+    def _run(self, **kwargs):
+        P = 1000
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P)
+        pid, pk, values, valid = _data(P=P)
+        return large_p.aggregate_blocked(pid, pk, values, valid, min_v,
+                                         max_v, min_s, max_s, mid,
+                                         np.asarray(stds),
+                                         jax.random.PRNGKey(7), cfg,
+                                         block_partitions=128, retry=FAST,
+                                         **kwargs)
+
+    def test_dispatch_and_drain_hangs_bit_identical(self):
+        base_kept, base_out = self._run()
+        before = telemetry.snapshot()
+        sched = faults.FaultSchedule([
+            faults.Fault("hang", block=1, delay=60, point="dispatch"),
+            faults.Fault("hang", block=3, delay=60, point="drain"),
+        ])
+        with faults.inject(sched):
+            kept, out = self._run(timeout_s=1.0, job_id="hang-job")
+        assert sched.pending() == 0
+        np.testing.assert_array_equal(base_kept, kept)
+        for name in base_out:
+            np.testing.assert_array_equal(base_out[name], out[name],
+                                          err_msg=name)
+        delta = telemetry.delta(before)
+        # The 60s injected hangs were cancelled BY THE DEADLINE (well
+        # under the hard_timeout guard), then retried same-key.
+        assert delta.get("watchdog_timeouts", 0) >= 2
+        assert delta.get("block_timeouts", 0) >= 2
+        assert delta.get("block_retries", 0) >= 2
+        snap = health_lib.for_job("hang-job").snapshot()
+        assert snap["state"] == "DEGRADED"  # recovered, didn't run clean
+
+    def test_hang_without_watchdog_hits_hard_cap(self):
+        base_kept, base_out = self._run()
+        sched = faults.FaultSchedule(
+            [faults.Fault("hang", block=2, delay=0.2)])
+        t0 = time.monotonic()
+        with faults.inject(sched):
+            kept, out = self._run()
+        assert time.monotonic() - t0 < 30  # the cap, not the default 30s
+        np.testing.assert_array_equal(base_kept, kept)
+        for name in base_out:
+            np.testing.assert_array_equal(base_out[name], out[name],
+                                          err_msg=name)
+
+    def test_hang_exhausts_retries_then_raises_without_journal_geometry(
+            self):
+        # With retries exhausted the timeout escalates to re-planning;
+        # at block_partitions=16 the capacity floor stops the halving and
+        # the BlockOOMError (cause: timeout) propagates.
+        sched = faults.FaultSchedule(
+            [faults.Fault("hang", delay=0.05, times=64)])
+        with faults.inject(sched):
+            with pytest.raises(retry_lib.BlockOOMError):
+                P = 1000
+                cfg, stds, scalars = _spec(P)
+                pid, pk, values, valid = _data(P=P)
+                large_p.aggregate_blocked(pid, pk, values, valid,
+                                          *scalars, np.asarray(stds),
+                                          jax.random.PRNGKey(7), cfg,
+                                          block_partitions=16, retry=FAST)
+
+
+class TestTimeoutDegradation:
+    """Repeated timeouts on one block degrade exactly like OOM: capacity
+    halves, the remaining range re-plans, results match the fault-free
+    run (key-independent noise-free data, as in TestOOMDegradation)."""
+
+    DENSE = ((np.arange(12) * 77 + 5) % 1000).astype(np.int64)
+
+    def _run_noise_free(self, **kwargs):
+        P = 1000
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P, eps=30,
+                                                             linf=64)
+        n_per = 120
+        pid = (np.repeat(np.arange(n_per), len(self.DENSE)) * 1003 +
+               np.tile(np.arange(len(self.DENSE)), n_per)).astype(np.int32)
+        pk = np.tile(self.DENSE, n_per).astype(np.int32)
+        rng = np.random.default_rng(4)
+        values = rng.uniform(0, 5, len(pk))
+        pid = np.concatenate([pid, 900_000 + np.arange(5, dtype=np.int32)])
+        pk = np.concatenate(
+            [pk, ((np.arange(5) * 311 + 9) % P).astype(np.int32)])
+        values = np.concatenate([values, np.ones(5)])
+        valid = np.ones(len(pid), bool)
+        return large_p.aggregate_blocked(pid, pk, values, valid, min_v,
+                                         max_v, min_s, max_s, mid,
+                                         np.zeros_like(np.asarray(stds)),
+                                         jax.random.PRNGKey(5), cfg,
+                                         block_partitions=128, retry=FAST,
+                                         **kwargs)
+
+    def test_repeated_timeouts_degrade_like_oom(self):
+        base_kept, base_out = self._run_noise_free()
+        before = telemetry.snapshot()
+        with faults.inject(
+                faults.FaultSchedule([
+                    faults.Fault("hang", block=3,
+                                 times=FAST.max_retries + 1, delay=0.1,
+                                 point="dispatch")
+                ])):
+            kept, out = self._run_noise_free(job_id="timeout-degrade")
+        np.testing.assert_array_equal(base_kept, kept)
+        np.testing.assert_allclose(base_out["count"], out["count"],
+                                   atol=1e-9)
+        np.testing.assert_allclose(base_out["sum"], out["sum"], rtol=1e-6)
+        delta = telemetry.delta(before)
+        assert delta.get("block_oom_degradations") == 1
+        assert delta.get("block_timeouts", 0) >= FAST.max_retries
+
+
+class TestCollectiveDeadline:
+    """A hang on the device-reshard collective falls back to the host LPT
+    permutation exactly like a collective failure."""
+
+    def test_collective_hang_falls_back_to_host(self):
+        mesh = make_mesh(n_devices=8)
+        P = 1 << 12
+        cfg, stds, (min_v, max_v, min_s, max_s, mid) = _spec(P, eps=30,
+                                                             linf=64)
+        stds = np.zeros_like(np.asarray(stds))
+        dense = (np.arange(12) * 331 + 17) % P
+        n_per = 120
+        pid = (np.repeat(np.arange(n_per), len(dense)) * 1003 +
+               np.tile(np.arange(len(dense)), n_per)).astype(np.int32)
+        pk = np.tile(dense, n_per).astype(np.int32)
+        rng = np.random.default_rng(3)
+        values = rng.uniform(0, 5, len(pk))
+        valid = np.ones(len(pid), bool)
+        key = jax.random.PRNGKey(11)
+        base_kept, base_out = large_p.aggregate_blocked_sharded(
+            mesh, pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+            stds, key, cfg, block_partitions=1 << 9)
+        dev = (jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(values),
+               jnp.asarray(valid))
+        before = telemetry.snapshot()
+        with faults.inject(
+                faults.FaultSchedule(
+                    [faults.Fault("hang", point="collective", delay=0.3)])):
+            kept, out = large_p.aggregate_blocked_sharded(
+                mesh, *dev, min_v, max_v, min_s, max_s, mid, stds, key,
+                cfg, block_partitions=1 << 9, retry=FAST, timeout_s=20.0,
+                job_id="coll-hang")
+        np.testing.assert_array_equal(base_kept, kept)
+        np.testing.assert_allclose(base_out["count"], out["count"],
+                                   atol=1e-9)
+        np.testing.assert_allclose(base_out["sum"], out["sum"], rtol=1e-6,
+                                   atol=1e-6)
+        assert telemetry.delta(before).get("reshard_host_fallbacks") == 1
+        assert health_lib.for_job("coll-hang").snapshot()["counters"].get(
+            "reshard_host_fallbacks") == 1
+
+
+class TestBackendHealth:
+    """TPUBackend(timeout_s=...) threads the watchdog through the engine,
+    and TPUBackend.health() answers for the jobs it ran."""
+
+    def _aggregate(self, backend, rows):
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            noise_kind=pdp.NoiseKind.LAPLACE,
+            max_partitions_contributed=4,
+            max_contributions_per_partition=8,
+            min_value=0.0,
+            max_value=5.0)
+        extractors = pdp.DataExtractors(
+            privacy_id_extractor=lambda r: r[0],
+            partition_extractor=lambda r: r[1],
+            value_extractor=lambda r: r[2])
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                               total_delta=1e-6)
+        engine = pdp.DPEngine(accountant, backend)
+        result = engine.aggregate(rows, params, extractors)
+        accountant.compute_budgets()
+        registered = accountant.mechanism_count
+        out = dict(result)
+        assert accountant.mechanism_count == registered
+        return out, registered
+
+    def test_engine_hang_recovers_ledger_stable_health_reports(self):
+        rng = np.random.default_rng(1)
+        rows = list(
+            zip(rng.integers(0, 300, 8000).tolist(),
+                rng.integers(0, 3000, 8000).tolist(),
+                rng.uniform(0, 5, 8000).tolist()))
+        make = lambda **kw: pdp.TPUBackend(noise_seed=13,
+                                           large_partition_threshold=1 << 10,
+                                           block_partitions=1 << 10,
+                                           retry=FAST,
+                                           **kw)
+        base, n_base = self._aggregate(make(), rows)
+        backend = make(timeout_s=5.0, job_id="engine-hang")
+        sched = faults.FaultSchedule(
+            [faults.Fault("hang", block=0, delay=0.3, point="dispatch")])
+        with faults.inject(sched):
+            faulted, n_faulted = self._aggregate(backend, rows)
+        assert sched.pending() == 0
+        assert n_base == n_faulted  # zero duplicate registrations
+        assert base == faulted
+        snaps = backend.health()
+        assert "engine-hang" in snaps
+        snap = snaps["engine-hang"]
+        assert snap["state"] == "DEGRADED"
+        assert snap["counters"].get("block_retries", 0) >= 1
+
+    def test_clean_run_reports_healthy(self):
+        rng = np.random.default_rng(2)
+        rows = list(
+            zip(rng.integers(0, 100, 2000).tolist(),
+                rng.integers(0, 2000, 2000).tolist(),
+                rng.uniform(0, 5, 2000).tolist()))
+        backend = pdp.TPUBackend(noise_seed=13,
+                                 large_partition_threshold=1 << 10,
+                                 block_partitions=1 << 10,
+                                 job_id="clean-run")
+        self._aggregate(backend, rows)
+        snap = backend.health()["clean-run"]
+        assert snap["state"] == "HEALTHY"
+        assert snap["completed_runs"] >= 1
+        assert snap["journal_quarantined"] == 0
