@@ -689,20 +689,35 @@ def main():
                      "block_oom_degradations", "reshard_host_fallbacks",
                      "journal_replays", "journal_quarantined",
                      "watchdog_timeouts", "watchdog_late_completions",
-                     "host_fetch_retries")
+                     "host_fetch_retries", "device_losses",
+                     "mesh_degradations")
     }
     # Per-phase wall-time stats (telemetry.record_duration) and the
     # health state machine's per-job verdicts: a receipt that stalled,
     # degraded or quarantined says so — and says where the time went.
+    # Timings are scoped by job (the same job_scope discipline counter
+    # forwarding uses), so a receipt covering several jobs run in this
+    # process never mixes their phases; "_process" is the unscoped
+    # aggregate for phases recorded outside any job.
+    def _rounded(stats_by_name):
+        return {
+            name: {k: round(v, 4) for k, v in stats.items()}
+            for name, stats in stats_by_name.items()
+        }
+
     phase_timings = {
-        name: {k: round(v, 4) for k, v in stats.items()}
-        for name, stats in rt_telemetry.timing_snapshot().items()
+        job: _rounded(stats)
+        for job, stats in rt_telemetry.job_timing_snapshot().items()
     }
+    phase_timings["_process"] = _rounded(rt_telemetry.timing_snapshot())
     job_health = {
         job: {
             "state": snap["state"],
             "counters": snap["counters"],
             "journal_quarantined": snap["journal_quarantined"],
+            **({"planned_devices": snap["planned_devices"],
+                "live_devices": snap["live_devices"]}
+               if snap.get("planned_devices") is not None else {}),
         }
         for job, snap in rt_health.snapshot_all().items()
     }

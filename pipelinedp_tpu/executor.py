@@ -1020,6 +1020,14 @@ def _blocked_runtime_kwargs(backend, kind: str, static_config) -> dict:
     wd = getattr(backend, "watchdog", None)
     if wd is not None:
         kwargs["watchdog"] = wd
+    # Elastic device-loss tolerance only means something on a mesh; the
+    # unsharded drivers already run at the one-device floor.
+    if getattr(backend, "mesh", None) is not None:
+        if getattr(backend, "elastic", False):
+            kwargs["elastic"] = True
+        min_devices = getattr(backend, "min_devices", 1)
+        if min_devices != 1:
+            kwargs["min_devices"] = min_devices
     # Attribute the job's health record to this backend so
     # TPUBackend.health() can answer for the aggregations it actually
     # ran. Without an explicit/derived job_id the drivers fall back to
@@ -1036,6 +1044,34 @@ def _blocked_runtime_kwargs(backend, kind: str, static_config) -> dict:
                 "select": "select_partitions_blocked_sharded"
                           if meshed else "select_partitions_blocked",
             }.get(kind, kind))
+    return kwargs
+
+
+def _dense_runtime_kwargs(backend, kind: str) -> dict:
+    """The runtime kwargs (retry, watchdog deadlines, job attribution,
+    elastic device-loss tolerance) threaded from TPUBackend into the
+    DENSE meshed drivers (sharded_aggregate_arrays /
+    sharded_select_partitions), which share the blocked drivers' runtime
+    entry but have no journal — the whole run is one program, so a
+    resume IS a re-run under the same key."""
+    kwargs = dict(retry=getattr(backend, "retry", None))
+    timeout_s = getattr(backend, "timeout_s", None)
+    if timeout_s is not None:
+        kwargs["timeout_s"] = timeout_s
+    wd = getattr(backend, "watchdog", None)
+    if wd is not None:
+        kwargs["watchdog"] = wd
+    job_id = getattr(backend, "job_id", None)
+    if job_id is not None:
+        kwargs["job_id"] = job_id
+    if getattr(backend, "elastic", False):
+        kwargs["elastic"] = True
+    min_devices = getattr(backend, "min_devices", 1)
+    if min_devices != 1:
+        kwargs["min_devices"] = min_devices
+    health_jobs = getattr(backend, "_health_jobs", None)
+    if health_jobs is not None:
+        health_jobs.add(job_id or kind)
     return kwargs
 
 
@@ -1120,7 +1156,8 @@ def lazy_select_partitions(backend, col, params, data_extractors,
                     key, params.max_partitions_contributed, n_partitions,
                     selection,
                     reshard=getattr(backend, "reshard", "auto"),
-                    retry=getattr(backend, "retry", None))
+                    **_dense_runtime_kwargs(backend,
+                                            "sharded_select_partitions"))
         else:
             # Selection never reads values; a zero-width column keeps
             # pad_rows from copying the real one. A COPY of the container —
@@ -1374,7 +1411,8 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
                     backend.mesh, pid, pk, values, valid, min_v, max_v,
                     min_s, max_s, mid, stds, key, cfg, secure_tables,
                     reshard=getattr(backend, "reshard", "auto"),
-                    retry=getattr(backend, "retry", None))
+                    **_dense_runtime_kwargs(backend,
+                                            "sharded_aggregate_arrays"))
             else:
                 outputs, keep, _ = aggregate_kernel(
                     jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(values),

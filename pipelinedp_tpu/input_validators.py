@@ -93,6 +93,67 @@ def validate_job_id(job_id, obj_name: str) -> None:
             f"the job id, so it must be path-safe.")
 
 
+def validate_elastic(elastic, obj_name: str) -> None:
+    """Validates the elastic mesh-degradation switch: a plain bool.
+
+    Raises:
+        ValueError: elastic is not a bool (a truthy non-bool — say a
+        mesh or a device count passed by mistake — would silently enable
+        or disable device-loss tolerance).
+    """
+    if not isinstance(elastic, bool):
+        raise ValueError(f"{obj_name}: elastic must be a bool, but "
+                         f"{elastic!r} given (True enables device-loss "
+                         f"mesh degradation on the meshed drivers).")
+
+
+def validate_min_devices(min_devices, obj_name: str) -> None:
+    """Validates the elastic degradation floor: an integer >= 1.
+
+    Raises:
+        ValueError: min_devices is not a positive integer.
+    """
+    if (not isinstance(min_devices, numbers.Number) or
+            isinstance(min_devices, bool) or
+            min_devices != int(min_devices) or min_devices < 1):
+        raise ValueError(
+            f"{obj_name}: min_devices must be an integer >= 1, but "
+            f"{min_devices!r} given — it is the device count below which "
+            f"an elastic run refuses to degrade further and fails with a "
+            f"resume pointer instead.")
+
+
+def validate_journal(journal, obj_name: str) -> None:
+    """Validates a BlockJournal-shaped object: get/put record accessors.
+
+    Raises:
+        ValueError: journal lacks callable get/put (e.g. a directory
+        path was passed where runtime.BlockJournal(path) was meant).
+    """
+    if not (callable(getattr(journal, "get", None)) and
+            callable(getattr(journal, "put", None))):
+        raise ValueError(
+            f"{obj_name}: journal must be a runtime.BlockJournal-like "
+            f"object with get/put, but {type(journal).__name__} given "
+            f"(pass runtime.BlockJournal(directory), not the directory).")
+
+
+def validate_watchdog(watchdog, obj_name: str) -> None:
+    """Validates a Watchdog-shaped object: guard/resolved_timeout.
+
+    Raises:
+        ValueError: watchdog lacks the monitor interface (e.g. a number
+        of seconds was passed where timeout_s= was meant).
+    """
+    if not (callable(getattr(watchdog, "guard", None)) and
+            callable(getattr(watchdog, "resolved_timeout", None))):
+        raise ValueError(
+            f"{obj_name}: watchdog must be a runtime.Watchdog-like "
+            f"object with guard/resolved_timeout, but "
+            f"{type(watchdog).__name__} given (a plain deadline in "
+            f"seconds is the timeout_s= knob).")
+
+
 def validate_retry_policy(retry, obj_name: str) -> None:
     """Validates a runtime.RetryPolicy-shaped object's budgets.
 

@@ -50,9 +50,16 @@ drain retries same-key, repeated timeouts degrade like OOM, a timed-out
 reshard collective falls back to the host permutation), and degrades on
 OOM by halving the partition block capacity and re-planning the
 remaining range (run_with_degradation; re-planned blocks draw fresh
-keys — nothing was released for them). Each run executes inside its
-job's health scope (runtime/health.py), so retries, timeouts,
-fallbacks and quarantines surface in TPUBackend.health().
+keys — nothing was released for them). The meshed drivers additionally
+take elastic=/min_devices= (device-loss tolerance: a device-fatal
+failure rebuilds a smaller mesh from the surviving devices and
+re-enters the driver — block keys are geometry-independent, so the
+degraded run replays the same release; the one-device floor falls back
+to the unsharded driver, and losses past min_devices raise
+MeshDegradationError with a resume pointer). Each run executes inside
+its job's health scope (runtime/health.py), so retries, timeouts,
+fallbacks, quarantines and mesh degradations surface in
+TPUBackend.health().
 """
 
 import dataclasses
@@ -66,12 +73,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from pipelinedp_tpu import executor
-from pipelinedp_tpu import input_validators
 # Canonical shape arithmetic lives with the mesh helpers; re-exported here
 # because the blocked path made the name public first.
 from pipelinedp_tpu.parallel.mesh import host_fetch, round_capacity
+from pipelinedp_tpu.runtime import entry as rt_entry
 from pipelinedp_tpu.runtime import faults as rt_faults
-from pipelinedp_tpu.runtime import health as rt_health
 from pipelinedp_tpu.runtime import journal as rt_journal
 from pipelinedp_tpu.runtime import retry as rt_retry
 from pipelinedp_tpu.runtime import telemetry as rt_telemetry
@@ -234,51 +240,28 @@ class _Replay:
         self.record = record
 
 
-def _runtime_entry(kind: str):
-    """Decorator giving every blocked driver the shared runtime entry
-    discipline: the timeout_s=/watchdog= knobs, runtime-knob validation
-    at the API boundary, the job's health scope (telemetry forwarding +
-    completion/failure accounting) and thread-local watchdog activation
-    (so retry_call, the drain guards, host_fetch heartbeats and the
-    device-reshard collective deadline all see it without signature
-    threading).
+# The shared runtime-entry discipline (knob validation, health scope,
+# watchdog activation, elastic mesh degradation) moved to
+# runtime/entry.py so the dense sharded drivers share it; the historical
+# name stays importable from here.
+_runtime_entry = rt_entry.runtime_entry
 
-    timeout_s: per-operation deadline in seconds. Shorthand for
-        watchdog=Watchdog(timeout_s=...); with neither, no deadlines are
-        enforced (PR-2 behavior). Passing a Watchdog without timeout_s
-        auto-derives deadlines as a multiple of the pass-1 profiled time.
-    """
 
-    def deco(fn):
+def _fallback_blocked_aggregate(args, kwargs, job):
+    """Elastic floor of aggregate_blocked_sharded: the unsharded blocked
+    driver on the surviving device. Bit-compatible by construction — both
+    drivers split rng_key the same way and derive the same
+    fold_in(final_key, b) block keys, and the D=1 pass-1 sampling key
+    (fold_in(rows_key, 0)) matches the single-chunk unsharded one."""
+    kw = {k: v for k, v in kwargs.items() if k != "reshard"}
+    return aggregate_blocked(*args[1:], job_id=job, **kw)
 
-        @functools.wraps(fn)
-        def wrapper(*args,
-                    timeout_s: Optional[float] = None,
-                    watchdog: Optional[rt_watchdog.Watchdog] = None,
-                    job_id: Optional[str] = None,
-                    **kwargs):
-            job = job_id or kind
-            input_validators.validate_job_id(job, kind)
-            if timeout_s is not None:
-                input_validators.validate_timeout_s(timeout_s, kind)
-            if kwargs.get("retry") is not None:
-                input_validators.validate_retry_policy(
-                    kwargs["retry"], kind)
-            wd = watchdog
-            if wd is None and timeout_s is not None:
-                wd = rt_watchdog.Watchdog(timeout_s=timeout_s)
-            elif wd is not None and timeout_s is not None:
-                wd.timeout_s = timeout_s
-            t0 = time.perf_counter()
-            with rt_health.job_scope(job), rt_watchdog.activate(wd):
-                result = fn(*args, job_id=job, **kwargs)
-                rt_telemetry.record_duration(kind,
-                                             time.perf_counter() - t0)
-            return result
 
-        return wrapper
-
-    return deco
+def _fallback_blocked_select(args, kwargs, job):
+    """Elastic floor of select_partitions_blocked_sharded (see
+    _fallback_blocked_aggregate)."""
+    kw = {k: v for k, v in kwargs.items() if k != "reshard"}
+    return select_partitions_blocked(*args[1:], job_id=job, **kw)
 
 
 def _sync_scalars(result) -> None:
@@ -666,7 +649,8 @@ def _block_boundaries(base: int, capacity: int, n_blocks: int) -> np.ndarray:
         np.iinfo(np.int32).max).astype(np.int32)
 
 
-@_runtime_entry("aggregate_blocked_sharded")
+@_runtime_entry("aggregate_blocked_sharded",
+                fallback=_fallback_blocked_aggregate)
 def aggregate_blocked_sharded(mesh,
                               pid,
                               pk,
@@ -714,7 +698,11 @@ def aggregate_blocked_sharded(mesh,
     failures retry under the same fold_in key (bit-identical noise), OOM
     halves the partition block capacity and re-plans the remaining range,
     and a journal records each consumed block's drained results for
-    resume — see README "Failure semantics".
+    resume — see README "Failure semantics". With elastic=True a
+    device-fatal failure additionally rebuilds a smaller mesh from the
+    surviving devices and re-enters here (block keys are independent of
+    mesh geometry, so the degraded run replays the same release) — see
+    README "Degraded-mesh semantics".
 
     Returns (kept_partition_ids int64[M], {metric: f[M]}) — identical
     contract to aggregate_blocked.
@@ -927,7 +915,8 @@ def _sharded_selection_block(spk_all, lo_r, len_r, base, c_actual, key,
     return fn(spk_all, lo_r, len_r, key)
 
 
-@_runtime_entry("select_partitions_blocked_sharded")
+@_runtime_entry("select_partitions_blocked_sharded",
+                fallback=_fallback_blocked_select)
 def select_partitions_blocked_sharded(mesh,
                                       pid,
                                       pk,

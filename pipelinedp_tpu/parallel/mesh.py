@@ -20,10 +20,12 @@ collective-reshard transfer discipline rests on:
     stage through the host.
 """
 
+import contextlib
 import logging
+import random
 import threading
 import time
-from typing import Optional, Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import numpy as np
@@ -41,6 +43,42 @@ def make_mesh(devices: Optional[Sequence] = None,
         if n_devices is not None:
             devices = devices[:n_devices]
     return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def probe_live_devices(devices: Sequence) -> List:
+    """Liveness probe backing elastic mesh degradation
+    (runtime/retry.run_with_mesh_degradation): which of `devices` can
+    still complete a trivial put-and-fetch round trip.
+
+    A dead chip fails the round trip with a runtime error; devices an
+    active fault-injection schedule has marked lost (the CPU test
+    devices never really die) are excluded up front. Returns the live
+    devices in their original order, so the rebuilt mesh keeps a stable
+    device ordering across shrinks.
+    """
+    from pipelinedp_tpu.runtime import faults as rt_faults
+    lost_ids = rt_faults.injected_lost_device_ids(devices)
+    live = []
+    for d in devices:
+        if getattr(d, "id", None) in lost_ids:
+            logging.warning(
+                "liveness probe: device %s marked lost by the active "
+                "fault schedule.", d)
+            continue
+        try:
+            # max_retries=0: the probe must answer fast — a chip that
+            # cannot ack one scalar round trip without retries is not a
+            # chip to rebuild the mesh on.
+            host_fetch(jax.device_put(np.zeros((1,), np.int32), d),
+                       max_retries=0)
+        except Exception as e:  # noqa: BLE001 - any failure = dead chip
+            logging.warning(
+                "liveness probe: device %s failed its probe round trip "
+                "(%s: %s) — treating it as lost.", d,
+                type(e).__name__, str(e).splitlines()[0][:160])
+            continue
+        live.append(d)
+    return live
 
 
 def shard_map(f, mesh: Mesh, in_specs, out_specs):
@@ -86,8 +124,36 @@ def rows_per_shard(n: int, n_shards: int) -> int:
 # tell a sanctioned control-table fetch from a smuggled row download.
 _sanctioned_fetch = threading.local()
 
+# Thread-local override of host_fetch's retry budget, scoped by the
+# drivers' runtime entry from the backend's RetryPolicy — so the retry=
+# knob governs control-plane fetches too, not just block dispatch.
+_fetch_policy = threading.local()
+_DEFAULT_FETCH_RETRIES = 2
 
-def host_fetch(arr, max_retries: int = 2) -> np.ndarray:
+# Backoff jitter source. Multi-host jobs retry control-plane fetches from
+# every host at once; a pure 0.05 * 2**attempt schedule would re-collide
+# all of them on the exact same instant, so each delay is scaled by an
+# independent uniform [0.5, 1) draw.
+_jitter = random.Random()
+
+
+@contextlib.contextmanager
+def fetch_retry_scope(max_retries: Optional[int]):
+    """Scopes a retry budget onto every host_fetch on this thread (the
+    runtime entry passes the backend RetryPolicy's max_retries; None
+    leaves the default in place)."""
+    if max_retries is None:
+        yield
+        return
+    prev = getattr(_fetch_policy, "max_retries", None)
+    _fetch_policy.max_retries = int(max_retries)
+    try:
+        yield
+    finally:
+        _fetch_policy.max_retries = prev
+
+
+def host_fetch(arr, max_retries: Optional[int] = None) -> np.ndarray:
     """Sanctioned small device->host fetch for meshed control tables.
 
     Only O(D^2) / O(n_blocks) tables may cross here — never row data. The
@@ -114,6 +180,11 @@ def host_fetch(arr, max_retries: int = 2) -> np.ndarray:
     if wd is not None:
         wd.beat("host_fetch")
 
+    if max_retries is None:
+        max_retries = getattr(_fetch_policy, "max_retries", None)
+        if max_retries is None:
+            max_retries = _DEFAULT_FETCH_RETRIES
+
     _sanctioned_fetch.active = True
     try:
         attempt = 0
@@ -123,7 +194,11 @@ def host_fetch(arr, max_retries: int = 2) -> np.ndarray:
             except Exception as e:  # noqa: BLE001 - classified below
                 if not rt_retry.is_transient(e) or attempt >= max_retries:
                     raise
-                delay = min(0.05 * 2**attempt, 1.0)
+                # Jittered bounded backoff: the exponential cap keeps the
+                # worst case at 1 s, the uniform scale decorrelates the
+                # lockstep retries of N hosts re-fetching the same table.
+                delay = min(0.05 * 2**attempt, 1.0) * (0.5 +
+                                                       0.5 * _jitter.random())
                 attempt += 1
                 rt_telemetry.record("host_fetch_retries")
                 logging.warning(

@@ -220,6 +220,16 @@ def stage_rows_to_mesh(mesh: Mesh, pid, pk, values, valid,
         balance, or a platform without all_to_all).
       * "device" — force the collective (host inputs are uploaded once,
         unbalanced, then exchanged on device).
+
+    Both permutations are pure functions of the TARGET mesh geometry:
+    the collective destination is hash(pid) mod D and the host path is
+    an LPT layout over D shards, with nothing cached against the mesh
+    the rows were previously staged for. That is what makes elastic
+    mesh degradation (runtime/retry.run_with_mesh_degradation) a plain
+    re-entry: after a device loss the driver calls this again with the
+    shrunken mesh and the permutation rebuilds for the new D — already
+    invalid-padded inputs restage correctly because every kernel masks
+    by `valid`.
     """
     if reshard not in ("auto", "host", "device"):
         raise ValueError(f"reshard must be auto|host|device, got {reshard}")
@@ -239,6 +249,13 @@ def stage_rows_to_mesh(mesh: Mesh, pid, pk, values, valid,
             # all_to_all fabric surfaces as BlockTimeoutError and degrades
             # to the host permutation exactly like a failed collective.
             with rt_watchdog.guard("collective"):
+                # A device LOST during the exchange is not a collective
+                # failure the host permutation can route around — the
+                # mesh itself contains a dead chip — so device-fatal
+                # errors propagate to the elastic degradation loop
+                # (classified below), which rebuilds a smaller mesh and
+                # re-derives this permutation for the new geometry.
+                rt_faults.maybe_fail("device_loss", point="collective")
                 rt_faults.maybe_fail("collective")
                 rt_faults.maybe_hang(point="collective")
                 return device_reshard_rows_by_pid(mesh, pid, pk, values,
@@ -275,11 +292,17 @@ def _is_collective_failure(exc: BaseException) -> bool:
     """Failures worth degrading to the host reshard for: the injected
     collective fault, a deadline expiry on the exchange, transient
     runtime failures, or an error naming the exchange itself.
-    Programming errors (shape/type) must propagate."""
+    Programming errors (shape/type) must propagate — and so must
+    device-fatal failures: a host permutation cannot route around a
+    dead chip that is still part of the mesh, so those go to the
+    elastic degradation loop instead, which rebuilds the permutation
+    for the shrunken geometry."""
     if isinstance(exc, rt_faults.InjectedCollectiveError):
         return True
     if isinstance(exc, rt_watchdog.BlockTimeoutError):
         return True
+    if rt_retry.is_device_fatal(exc):
+        return False
     if isinstance(exc, rt_faults.InjectedFault):
         return False
     if rt_retry.is_transient(exc):

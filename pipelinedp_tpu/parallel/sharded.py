@@ -26,7 +26,7 @@ compared to the reference's full data shuffle over the network.
 """
 
 from functools import partial
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +38,7 @@ from pipelinedp_tpu import executor
 from pipelinedp_tpu.ops import selection_ops
 from pipelinedp_tpu.parallel.mesh import SHARD_AXIS, round_capacity, shard_map
 from pipelinedp_tpu.parallel.reshard import stage_rows_to_mesh
+from pipelinedp_tpu.runtime import entry as rt_entry
 from pipelinedp_tpu.runtime import retry as rt_retry
 
 
@@ -161,16 +162,76 @@ def _sharded_select_kernel(pid, pk, valid, rng_key, l0: int,
     return fn(pid, pk, valid, rng_key)
 
 
+def _fallback_select_partitions(args, kwargs, job):
+    """Elastic floor of sharded_select_partitions: the single-device
+    selection kernel on the surviving device. The selection key
+    (key_sel half of the split) is replicated on the mesh, so the
+    single-device decisions are the same release."""
+
+    def go(mesh, pid, pk, valid, rng_key, l0, n_partitions, selection,
+           reshard="auto", retry=None, job_id=None):
+        del mesh, reshard, job_id
+        from pipelinedp_tpu.parallel.large_p import _pad_to
+        cap = round_capacity(len(pid))
+        return rt_retry.retry_call(
+            lambda: executor.select_partitions_kernel(
+                jnp.asarray(_pad_to(pid, cap, 0)),
+                jnp.asarray(_pad_to(pk, cap, 0)),
+                jnp.asarray(_pad_to(valid, cap, False)), rng_key, l0,
+                n_partitions, selection),
+            retry, what="single-device select_partitions dispatch")
+
+    return go(*args, **kwargs)
+
+
+def _fallback_aggregate_arrays(args, kwargs, job):
+    """Elastic floor of sharded_aggregate_arrays: the single-device
+    fused kernel (identical output contract; the finalize/noise key is
+    the replicated half of the same split, so released noise is the
+    same release)."""
+
+    def go(mesh, pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+           stds, rng_key, cfg, secure_tables=None, reshard="auto",
+           retry=None, job_id=None):
+        del mesh, reshard, job_id
+        from pipelinedp_tpu.parallel.large_p import _pad_to
+        if isinstance(values, jax.Array):
+            values = values.astype(executor._ftype())
+        else:
+            values = np.asarray(values, dtype=np.dtype(executor._ftype()))
+        cap = round_capacity(len(pid))
+        return rt_retry.retry_call(
+            lambda: executor.aggregate_kernel(
+                jnp.asarray(_pad_to(pid, cap, 0)),
+                jnp.asarray(_pad_to(pk, cap, 0)),
+                jnp.asarray(_pad_to(values, cap, 0)),
+                jnp.asarray(_pad_to(valid, cap, False)), min_v, max_v,
+                min_s, max_s, mid, jnp.asarray(stds), rng_key, cfg,
+                secure_tables),
+            retry, what="single-device aggregation dispatch")
+
+    return go(*args, **kwargs)
+
+
+@rt_entry.runtime_entry("sharded_select_partitions",
+                        fallback=_fallback_select_partitions)
 def sharded_select_partitions(mesh: Mesh, pid, pk, valid, rng_key, l0: int,
                               n_partitions: int,
                               selection: selection_ops.SelectionParams,
                               reshard: str = "auto",
-                              retry: rt_retry.RetryPolicy = None):
+                              retry: rt_retry.RetryPolicy = None,
+                              job_id: Optional[str] = None):
     """Standalone partition selection over the mesh: shard rows by privacy
     id (on-device all_to_all for device-resident inputs, host LPT
     permutation otherwise — see stage_rows_to_mesh), count shard-locally
     (executor.select_partition_counts), psum the int32[P] count vector
     over ICI, select replicated.
+
+    Runtime knobs (shared entry, runtime/entry.py): timeout_s=/watchdog=
+    deadlines, job_id= health attribution, elastic=/min_devices=
+    device-loss tolerance (the one-device floor runs the single-device
+    selection kernel — the selection key is replicated, so decisions
+    are the same release).
 
     Returns keep: bool[n_partitions], replicated across the mesh.
     """
@@ -191,11 +252,14 @@ def sharded_select_partitions(mesh: Mesh, pid, pk, valid, rng_key, l0: int,
         retry, what="sharded select_partitions dispatch")
 
 
+@rt_entry.runtime_entry("sharded_aggregate_arrays",
+                        fallback=_fallback_aggregate_arrays)
 def sharded_aggregate_arrays(mesh: Mesh, pid, pk, values, valid, min_v, max_v,
                              min_s, max_s, mid, stds, rng_key,
                              cfg: executor.KernelConfig, secure_tables=None,
                              reshard: str = "auto",
-                             retry: rt_retry.RetryPolicy = None):
+                             retry: rt_retry.RetryPolicy = None,
+                             job_id: Optional[str] = None):
     """Shards rows by pid over `mesh` and runs the two-phase fused program.
 
     Accepts host numpy arrays or device-resident jax arrays (any length);
@@ -203,6 +267,13 @@ def sharded_aggregate_arrays(mesh: Mesh, pid, pk, values, valid, min_v, max_v,
     (stage_rows_to_mesh). Returns the same (outputs, keep, row_count)
     triple as executor.aggregate_kernel, with results replicated across
     the mesh.
+
+    Runtime knobs (shared entry, runtime/entry.py): timeout_s=/watchdog=
+    deadlines, job_id= health attribution, and elastic=/min_devices=
+    device-loss tolerance — a device-fatal failure rebuilds a smaller
+    mesh from the survivors and re-enters; the one-device floor runs the
+    single-device fused kernel (the finalize/noise key is replicated, so
+    every geometry releases the same noise).
     """
     pid, pk, values, valid = stage_rows_to_mesh(
         mesh, pid, pk, values, valid, reshard,

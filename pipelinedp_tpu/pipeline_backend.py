@@ -379,6 +379,21 @@ class TPUBackend(LocalBackend):
             share/configure directly (auto-derived deadlines from the
             pass-1 profile, custom multiplier). timeout_s is shorthand
             for watchdog=Watchdog(timeout_s=...).
+        elastic: device-loss tolerance for the meshed paths. When True,
+            a device-fatal runtime failure (a chip dropping off the
+            slice) no longer kills the run: the runtime probes the mesh
+            for surviving devices, rebuilds a smaller mesh, re-derives
+            shardings and the reshard permutation for the new geometry
+            and re-enters the driver — journaled blocks replay, the
+            rest re-derive the same fold_in(final_key, b) keys, so the
+            degraded run is bit-compatible with the un-faulted one
+            (zero duplicate ledger registrations). At the one-device
+            floor the unsharded driver runs instead. Meaningless
+            without a mesh.
+        min_devices: elastic degradation floor (default 1). Losses that
+            leave fewer live devices raise
+            runtime.MeshDegradationError naming the job_id and journal
+            path a resume needs, and health() reports FAILED.
     """
 
     def __init__(self,
@@ -393,7 +408,9 @@ class TPUBackend(LocalBackend):
                  job_id: Optional[str] = None,
                  block_partitions: Optional[int] = None,
                  timeout_s: Optional[float] = None,
-                 watchdog=None):
+                 watchdog=None,
+                 elastic: bool = False,
+                 min_devices: int = 1):
         super().__init__(seed=noise_seed)
         if reshard not in ("auto", "host", "device"):
             raise ValueError(
@@ -407,6 +424,12 @@ class TPUBackend(LocalBackend):
             input_validators.validate_job_id(job_id, "TPUBackend")
         if retry is not None:
             input_validators.validate_retry_policy(retry, "TPUBackend")
+        if journal is not None:
+            input_validators.validate_journal(journal, "TPUBackend")
+        if watchdog is not None:
+            input_validators.validate_watchdog(watchdog, "TPUBackend")
+        input_validators.validate_elastic(elastic, "TPUBackend")
+        input_validators.validate_min_devices(min_devices, "TPUBackend")
         self.mesh = mesh
         self.max_partitions = max_partitions
         self.noise_seed = noise_seed
@@ -419,6 +442,8 @@ class TPUBackend(LocalBackend):
         self.block_partitions = block_partitions
         self.timeout_s = timeout_s
         self.watchdog = watchdog
+        self.elastic = elastic
+        self.min_devices = min_devices
         # Job ids whose health this backend's aggregations fed (the
         # executor records them as it resolves/derives them).
         self._health_jobs = set()

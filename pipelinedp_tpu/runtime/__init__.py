@@ -17,6 +17,15 @@ semantics:
     no budget re-spend. OOM-classified failures are never retried at the
     same shape — they surface as BlockOOMError so the driver can halve
     the partition block capacity and re-plan (run_with_degradation).
+  * entry.runtime_entry + retry.run_with_mesh_degradation — elastic
+    device-loss tolerance for the meshed drivers: device-fatal failures
+    (retry.is_device_fatal — a chip dropped off the slice) rebuild a
+    smaller mesh from the surviving devices (mesh.probe_live_devices)
+    and re-enter the driver. Block keys are fold_in(final_key, b),
+    independent of mesh geometry, so a degraded run replays the same
+    release; the one-device floor falls back to the unsharded driver,
+    and losses past min_devices raise MeshDegradationError with a
+    resume pointer.
   * watchdog — deadline/heartbeat monitoring of every block-stream step
     (dispatch, drain, collective reshard, control fetches): per-block
     deadlines (explicit timeout_s or a multiple of the pass-1 profiled
@@ -45,14 +54,18 @@ keys are pure functions of (final_key, block), so re-execution of a block
 is a replay of the same release, not a second one.
 """
 
+from pipelinedp_tpu.runtime import entry
 from pipelinedp_tpu.runtime import faults
 from pipelinedp_tpu.runtime import health
 from pipelinedp_tpu.runtime import telemetry
 from pipelinedp_tpu.runtime.health import HealthState, JobHealth
 from pipelinedp_tpu.runtime.journal import (BlockJournal,
                                             JournalCorruptionError)
-from pipelinedp_tpu.runtime.retry import (BlockOOMError, RetryPolicy,
-                                          retry_call, run_with_degradation)
+from pipelinedp_tpu.runtime.retry import (BlockOOMError,
+                                          MeshDegradationError, RetryPolicy,
+                                          is_device_fatal, retry_call,
+                                          run_with_degradation,
+                                          run_with_mesh_degradation)
 from pipelinedp_tpu.runtime.watchdog import BlockTimeoutError, Watchdog
 
 __all__ = [
@@ -62,11 +75,15 @@ __all__ = [
     "HealthState",
     "JobHealth",
     "JournalCorruptionError",
+    "MeshDegradationError",
     "RetryPolicy",
     "Watchdog",
+    "entry",
     "faults",
     "health",
+    "is_device_fatal",
     "retry_call",
     "run_with_degradation",
+    "run_with_mesh_degradation",
     "telemetry",
 ]

@@ -1,0 +1,117 @@
+"""Shared runtime-entry discipline for every meshed/blocked driver.
+
+One decorator gives the four meshed drivers (sharded_aggregate_arrays,
+sharded_select_partitions, aggregate_blocked_sharded,
+select_partitions_blocked_sharded) and the two unsharded blocked drivers
+a single API boundary for the runtime knobs:
+
+  * validation: every runtime knob (job_id, timeout_s, retry, journal,
+    watchdog, elastic, min_devices) is rejected with an actionable
+    message HERE, through input_validators, before any device work —
+    tests/test_knob_validation.py greps this module to prove no knob
+    can skip it.
+  * health scope: the run executes inside its job's health scope
+    (telemetry counter/duration forwarding + completion/failure
+    accounting) and under thread-local watchdog activation, so
+    retry_call, the drain guards, host_fetch heartbeats and the
+    device-reshard collective deadline all see them without signature
+    threading. The backend RetryPolicy's max_retries is also scoped onto
+    host_fetch (mesh.fetch_retry_scope), so the retry= knob governs
+    control-plane fetches too.
+  * elastic mesh degradation (meshed drivers only — the ones
+    constructed with a `fallback`): elastic=True wraps the run in
+    runtime/retry.run_with_mesh_degradation. A device-fatal failure
+    rebuilds a smaller mesh from the surviving devices and re-enters the
+    driver; at the one-device floor the unsharded fallback runs instead;
+    losses past min_devices raise MeshDegradationError with a resume
+    pointer. Block keys are fold_in(final_key, b) — independent of mesh
+    geometry — so every re-entry replays the same release.
+
+timeout_s: per-operation deadline in seconds. Shorthand for
+    watchdog=Watchdog(timeout_s=...); with neither, no deadlines are
+    enforced. Passing a Watchdog without timeout_s auto-derives
+    deadlines as a multiple of the pass-1 profiled time.
+"""
+
+import functools
+import logging
+import time
+from typing import Callable, Optional
+
+from pipelinedp_tpu import input_validators
+from pipelinedp_tpu.runtime import health as rt_health
+from pipelinedp_tpu.runtime import retry as rt_retry
+from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+from pipelinedp_tpu.runtime import watchdog as rt_watchdog
+
+
+def runtime_entry(kind: str, fallback: Optional[Callable] = None):
+    """Decorator for a driver entry point (see module docstring).
+
+    kind: default job id + the duration-stat name of the driver.
+    fallback: meshed drivers only — fallback(args, kwargs, job_id) runs
+        the unsharded equivalent when elastic degradation reaches the
+        one-device floor (args are the driver's positional args, mesh
+        first). Its presence marks the driver as meshed.
+    """
+    meshed = fallback is not None
+
+    def deco(fn):
+
+        @functools.wraps(fn)
+        def wrapper(*args,
+                    timeout_s: Optional[float] = None,
+                    watchdog: Optional[rt_watchdog.Watchdog] = None,
+                    job_id: Optional[str] = None,
+                    elastic: bool = False,
+                    min_devices: int = 1,
+                    **kwargs):
+            job = job_id or kind
+            input_validators.validate_job_id(job, kind)
+            if timeout_s is not None:
+                input_validators.validate_timeout_s(timeout_s, kind)
+            if kwargs.get("retry") is not None:
+                input_validators.validate_retry_policy(kwargs["retry"], kind)
+            if kwargs.get("journal") is not None:
+                input_validators.validate_journal(kwargs["journal"], kind)
+            if watchdog is not None:
+                input_validators.validate_watchdog(watchdog, kind)
+            input_validators.validate_elastic(elastic, kind)
+            input_validators.validate_min_devices(min_devices, kind)
+            if elastic and not meshed:
+                # The unsharded drivers have no mesh to degrade; the knob
+                # is accepted (one backend config drives every route) and
+                # simply has nothing to do.
+                logging.debug(
+                    "%s: elastic=True ignored — the unsharded driver "
+                    "already runs at the one-device floor.", kind)
+            wd = watchdog
+            if wd is None and timeout_s is not None:
+                wd = rt_watchdog.Watchdog(timeout_s=timeout_s)
+            elif wd is not None and timeout_s is not None:
+                wd.timeout_s = timeout_s
+            # Lazy: parallel imports runtime; the reverse edge must not
+            # run at import time.
+            from pipelinedp_tpu.parallel import mesh as mesh_lib
+            fetch_retries = getattr(kwargs.get("retry"), "max_retries",
+                                    None)
+            t0 = time.perf_counter()
+            with rt_health.job_scope(job), rt_watchdog.activate(wd), \
+                    mesh_lib.fetch_retry_scope(fetch_retries):
+                if meshed and elastic:
+                    result = rt_retry.run_with_mesh_degradation(
+                        lambda m: fn(m, *args[1:], job_id=job, **kwargs),
+                        args[0],
+                        fallback=lambda: fallback(args, kwargs, job),
+                        min_devices=min_devices,
+                        job_id=job,
+                        journal=kwargs.get("journal"))
+                else:
+                    result = fn(*args, job_id=job, **kwargs)
+                rt_telemetry.record_duration(kind,
+                                             time.perf_counter() - t0)
+            return result
+
+        return wrapper
+
+    return deco

@@ -48,6 +48,21 @@ Fault kinds and the exception they raise:
                                       "flip" a byte or "truncate" the
                                       file) — the integrity-check /
                                       quarantine test case.
+  device_loss InjectedDeviceLossError device-fatal: a chip dropped off
+                                      the slice. Never retried on the
+                                      same mesh — the elastic runtime
+                                      (retry.run_with_mesh_degradation)
+                                      rebuilds a smaller mesh from the
+                                      survivors and re-enters the driver.
+                                      `point` targets dispatch |
+                                      collective; `device` optionally
+                                      names the lost device's global id
+                                      (default: the probe marks the
+                                      highest-id live device dead), and
+                                      the schedule remembers every loss
+                                      so the mesh.probe_live_devices
+                                      liveness probe sees a consistent
+                                      dead set across re-entries.
 """
 
 import contextlib
@@ -90,12 +105,19 @@ class InjectedFatalError(InjectedFault):
     """Unrecoverable failure — the run must abort (and later resume)."""
 
 
+class InjectedDeviceLossError(InjectedFault):
+    """Device-fatal: a device dropped off the slice mid-run. The mesh
+    must shrink (retry.is_device_fatal classifies this, never transient:
+    re-dispatching the same program onto a dead chip cannot succeed)."""
+
+
 _RAISES = {
     "dispatch": InjectedDispatchError,
     "consume": InjectedConsumeError,
     "oom": InjectedOOMError,
     "collective": InjectedCollectiveError,
     "fatal": InjectedFatalError,
+    "device_loss": InjectedDeviceLossError,
 }
 
 
@@ -106,35 +128,71 @@ class Fault:
 
     delay: seconds — the sleep of a "slow" fault, or the hard cap of a
         "hang" fault (0 = the 30 s default cap).
-    point: "hang" only — restrict to one hook site ("dispatch", "drain",
-        "collective"); None fires at whichever site reaches it first.
+    point: "hang" (dispatch | drain | collective) and "device_loss"
+        (dispatch | collective) only — restrict to one hook site; None
+        fires at whichever site reaches it first.
     mode: "corrupt" only — "flip" (default) flips one payload byte,
         "truncate" cuts the file in half.
+    device: "device_loss" only — global jax device id of the lost chip.
+        None = the liveness probe marks the highest-id still-live device
+        of the probed mesh as dead (deterministic without naming ids).
     """
     kind: str
     block: Optional[int] = None
     times: int = 1
     delay: float = 0.0  # kind in ("slow", "hang") only
-    point: Optional[str] = None  # kind == "hang" only
+    point: Optional[str] = None  # kind in ("hang", "device_loss") only
     mode: str = "flip"  # kind == "corrupt" only
+    device: Optional[int] = None  # kind == "device_loss" only
 
     def __post_init__(self):
         if self.kind not in set(_RAISES) | {"slow", "hang", "corrupt"}:
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.times <= 0:
             raise ValueError("times must be positive")
-        if self.point is not None and self.point not in (
-                "dispatch", "drain", "collective"):
-            raise ValueError(f"unknown hang point {self.point!r}")
+        allowed_points = (("dispatch", "collective")
+                          if self.kind == "device_loss" else
+                          ("dispatch", "drain", "collective"))
+        if self.point is not None and self.point not in allowed_points:
+            raise ValueError(f"unknown {self.kind} point {self.point!r}")
         if self.mode not in ("flip", "truncate"):
             raise ValueError(f"unknown corrupt mode {self.mode!r}")
 
 
 class FaultSchedule:
-    """An ordered, consumable list of Faults."""
+    """An ordered, consumable list of Faults.
+
+    Fired device_loss faults additionally accumulate a dead-device set
+    (explicit `device` ids, plus a count of unassigned losses the
+    liveness probe resolves against the devices it actually probes), so
+    a "lost" device stays lost across every probe and mesh re-entry of
+    the faulted run.
+    """
 
     def __init__(self, faults: List[Fault]):
         self._remaining = [[f, f.times] for f in faults]
+        self._lost_ids = set()
+        self._unassigned_losses = 0
+
+    def note_device_loss(self, fault: Fault) -> None:
+        """Records one fired device_loss fault's victim."""
+        if fault.device is not None:
+            self._lost_ids.add(fault.device)
+        else:
+            self._unassigned_losses += 1
+
+    def assign_lost(self, devices) -> set:
+        """Resolves which of `devices` (jax device objects or ids) the
+        schedule considers dead: explicitly-named ids, plus one
+        highest-id still-live device per unassigned fired loss (assigned
+        sticky, so later probes agree)."""
+        ids = [getattr(d, "id", d) for d in devices]
+        for id_ in sorted(set(ids) - self._lost_ids, reverse=True):
+            if self._unassigned_losses <= 0:
+                break
+            self._lost_ids.add(id_)
+            self._unassigned_losses -= 1
+        return {i for i in ids if i in self._lost_ids}
 
     def take(self, kind: str, block: int,
              point: Optional[str] = None) -> Optional[Fault]:
@@ -175,17 +233,31 @@ def inject(schedule: FaultSchedule):
         _active.schedule = prev
 
 
-def maybe_fail(kind: str, block: int = 0) -> None:
+def maybe_fail(kind: str, block: int = 0,
+               point: Optional[str] = None) -> None:
     """Hook point: raises the scheduled exception if a fault is pending."""
     schedule = active()
     if schedule is None:
         return
-    fault = schedule.take(kind, block)
+    fault = schedule.take(kind, block, point)
     if fault is not None:
         telemetry.record("injected_faults")
+        if kind == "device_loss":
+            schedule.note_device_loss(fault)
         raise _RAISES[kind](
             f"injected {kind} fault at block {block} "
             f"(attempt schedule: {fault.times} firing(s))")
+
+
+def injected_lost_device_ids(devices) -> set:
+    """Device ids of `devices` the active schedule considers lost (empty
+    without a schedule). The liveness probe (mesh.probe_live_devices)
+    consults this: CPU test devices never really die, so injected losses
+    are how the elastic-mesh machinery is regression-tested."""
+    schedule = active()
+    if schedule is None:
+        return set()
+    return schedule.assign_lost(devices)
 
 
 def maybe_sleep(block: int = 0) -> None:

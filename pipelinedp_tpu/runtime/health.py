@@ -9,8 +9,10 @@ four-state machine:
     HEALTHY   no anomaly observed.
     DEGRADED  the job recovered from adversity (retries, an OOM capacity
               halving, a collective->host fallback, a quarantined journal
-              record) — results are unaffected, capacity or latency may
-              be.
+              record, an elastic mesh shrink after a device loss) —
+              results are unaffected, capacity or latency may be.
+              Meshed elastic runs additionally report planned vs live
+              device counts in the snapshot.
     STALLED   a deadline expired on an operation that has not completed:
               the job is (or recently was) not making progress. Demoted
               back to DEGRADED when the stalled operation completes or
@@ -61,6 +63,8 @@ _DEGRADING_COUNTERS = frozenset({
     "journal_quarantined",
     "host_fetch_retries",
     "watchdog_late_completions",
+    "device_losses",
+    "mesh_degradations",
 })
 _STALLING_COUNTERS = frozenset({"block_timeouts", "watchdog_timeouts"})
 _TRACKED_COUNTERS = (_DEGRADING_COUNTERS | _STALLING_COUNTERS |
@@ -80,6 +84,11 @@ class JobHealth:
         self._last_beat: Optional[float] = None
         self._started = time.time()
         self._completed_runs = 0
+        # Elastic mesh state: device count the job entered on vs devices
+        # still live after degradations (None until a meshed elastic run
+        # reports them).
+        self._planned_devices: Optional[int] = None
+        self._live_devices: Optional[int] = None
 
     # -- event intake ----------------------------------------------------
 
@@ -110,6 +119,18 @@ class JobHealth:
                 self._counters.get("watchdog_timeouts", 0) + 1)
             self._escalate(HealthState.STALLED)
             self._last_error = (f"deadline expired: {phase} block {block}")
+
+    def note_mesh(self, planned_devices: int, live_devices: int) -> None:
+        """Elastic mesh report (runtime/retry.run_with_mesh_degradation):
+        the device count the job was planned on vs the count still live.
+        A shrink is survived adversity — DEGRADED, never worse by itself;
+        losses past the elastic floor surface as a driver failure and
+        mark the job FAILED through the normal note_failed path."""
+        with self._lock:
+            self._planned_devices = int(planned_devices)
+            self._live_devices = int(live_devices)
+            if live_devices < planned_devices:
+                self._escalate(HealthState.DEGRADED)
 
     def note_recovered(self) -> None:
         """A stalled operation completed (late) or its retry succeeded:
@@ -153,6 +174,8 @@ class JobHealth:
                 "counters": dict(self._counters),
                 "journal_quarantined":
                     self._counters.get("journal_quarantined", 0),
+                "planned_devices": self._planned_devices,
+                "live_devices": self._live_devices,
                 "phase_seconds": {
                     k: round(v, 6) for k, v in self._phase_seconds.items()
                 },

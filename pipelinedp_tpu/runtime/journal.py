@@ -105,6 +105,13 @@ class BlockJournal:
             os.makedirs(directory, exist_ok=True)
             self._sweep_orphan_tmp(directory)
 
+    @property
+    def directory(self) -> Optional[str]:
+        """Backing directory (None = in-memory only). Error messages that
+        point an operator at a resume — e.g. the elastic runtime's
+        MeshDegradationError — name this path."""
+        return self._dir
+
     @staticmethod
     def _sweep_orphan_tmp(directory: str) -> None:
         """Removes ``*.tmp`` files a crashed writer left behind. They were

@@ -31,6 +31,7 @@ from typing import Callable, List, Optional
 import numpy as np
 
 from pipelinedp_tpu.runtime import faults
+from pipelinedp_tpu.runtime import health as health_lib
 from pipelinedp_tpu.runtime import journal as journal_lib
 from pipelinedp_tpu.runtime import telemetry
 from pipelinedp_tpu.runtime import watchdog as watchdog_lib
@@ -57,6 +58,25 @@ _OOM_MARKERS = (
     "Out of memory",
 )
 
+# Markers of DEVICE-FATAL runtime failures: a chip dropped off the slice
+# (died, was fenced, lost its ICI links). Neither a retry on the same
+# mesh nor a capacity halving can succeed — the program's mesh contains
+# a dead device — so these route to the elastic degradation loop
+# (run_with_mesh_degradation), which rebuilds a smaller mesh from the
+# survivors. Checked BEFORE the transient markers: real device-loss
+# status text often also carries UNAVAILABLE/ABORTED.
+_DEVICE_FATAL_MARKERS = (
+    "DEVICE_LOST",
+    "device is lost",
+    "Device lost",
+    "device failed",
+    "device halted",
+    "hardware error",
+    "uncorrectable ECC",
+    "HBM is unhealthy",
+    "chip has been disabled",
+)
+
 
 class BlockOOMError(RuntimeError):
     """A block kernel needs re-planning at a smaller capacity: it either
@@ -78,10 +98,35 @@ class BlockOOMError(RuntimeError):
         self.cause = cause
 
 
+class MeshDegradationError(RuntimeError):
+    """Device losses exhausted the elastic floor: fewer live devices
+    remain than `min_devices` allows (or none at all). The run cannot
+    continue in this process; the message names the job_id and journal
+    needed to resume elsewhere."""
+
+
+def is_device_fatal(exc: BaseException) -> bool:
+    """Whether the failure means a device dropped off the mesh.
+
+    Device-fatal failures are never transient and never OOM-degradable:
+    the compiled program's mesh contains a dead chip, so only rebuilding
+    a smaller mesh from the survivors (run_with_mesh_degradation) can
+    make progress.
+    """
+    if isinstance(exc, faults.InjectedDeviceLossError):
+        return True
+    if isinstance(exc, faults.InjectedFault):
+        return False
+    msg = str(exc)
+    return any(marker in msg for marker in _DEVICE_FATAL_MARKERS)
+
+
 def is_oom(exc: BaseException) -> bool:
     if isinstance(exc, (faults.InjectedOOMError, MemoryError)):
         return True
     if isinstance(exc, faults.InjectedFault):
+        return False
+    if is_device_fatal(exc):
         return False
     msg = str(exc)
     return any(marker in msg for marker in _OOM_MARKERS)
@@ -98,7 +143,11 @@ def is_transient(exc: BaseException) -> bool:
     # dispatcher escalates exhausted timeouts into OOM-style degradation.
     if isinstance(exc, watchdog_lib.BlockTimeoutError):
         return True
-    if isinstance(exc, faults.InjectedFault):  # oom / fatal
+    if isinstance(exc, faults.InjectedFault):  # oom / fatal / device loss
+        return False
+    # Device loss first: its status text often also says UNAVAILABLE, but
+    # re-dispatching onto a dead chip cannot succeed.
+    if is_device_fatal(exc):
         return False
     if is_oom(exc):
         return False
@@ -154,6 +203,7 @@ def retry_call(fn: Callable,
     while True:
         try:
             faults.maybe_fail("fatal", block)
+            faults.maybe_fail("device_loss", block, point="dispatch")
             faults.maybe_fail("oom", block)
             faults.maybe_fail("dispatch", block)
             faults.maybe_sleep(block)
@@ -281,3 +331,93 @@ def run_with_degradation(run_range: Callable[[int, int, int, int], None],
             _save_plan(journal, job_id, ranges)
         idx += 1
     return ranges[-1][1]
+
+
+def run_with_mesh_degradation(run: Callable,
+                              mesh,
+                              *,
+                              fallback: Optional[Callable] = None,
+                              min_devices: int = 1,
+                              job_id: str = "",
+                              journal=None):
+    """Drives a meshed driver with elastic device-loss degradation.
+
+    run(mesh) executes the full driver on the given mesh; fallback()
+    (when provided) executes the unsharded driver — the floor the mesh
+    degrades onto when only one device remains (or when the caller
+    passed a 1-device mesh to begin with).
+
+    On a device-fatal failure (is_device_fatal: an injected device_loss
+    fault, or an XLA/PJRT error whose status text names a lost chip),
+    the loop probes the current mesh's devices for liveness
+    (parallel/mesh.probe_live_devices), rebuilds a mesh over the largest
+    supported device count <= D-1 that the survivors allow, and
+    re-enters the driver. Privacy makes this safe, not just availability:
+    block noise/selection keys are fold_in(final_key, b) — pure
+    functions of the run key and block index, independent of mesh
+    geometry — so the re-entered run replays journaled blocks from the
+    host record and re-draws bit-identical noise for every block it
+    re-dispatches. A degraded run is a replay of the same release on
+    fewer chips, never a second release.
+
+    Losses past the floor — fewer survivors than max(min_devices, 1) —
+    raise MeshDegradationError naming the job_id and the journal path a
+    resume needs; the job's health record reports FAILED.
+
+    Returns whatever run()/fallback() returns.
+    """
+    from pipelinedp_tpu.parallel import mesh as mesh_lib
+
+    current = mesh
+    planned = int(mesh.devices.size)
+    floor = max(int(min_devices), 1)
+    health = health_lib.current()
+    if health is not None:
+        health.note_mesh(planned, planned)
+    while True:
+        n_live = int(current.devices.size)
+        try:
+            if n_live <= 1 and fallback is not None:
+                logging.warning(
+                    "elastic mesh floor reached for job %r: running the "
+                    "unsharded driver on the single remaining device "
+                    "(results are identical — block keys are independent "
+                    "of mesh geometry).", job_id)
+                return fallback()
+            return run(current)
+        except Exception as e:  # noqa: BLE001 - classified below
+            if not is_device_fatal(e):
+                raise
+            telemetry.record("device_losses")
+            live = mesh_lib.probe_live_devices(list(current.devices.flat))
+            # Shrink by at least one even if every device answers the
+            # probe (transiently-wedged chips can ack a trivial program):
+            # the failed dispatch names this geometry as unusable.
+            target = min(len(live), n_live - 1)
+            if health is not None:
+                health.note_mesh(planned, max(target, 0))
+            if target < floor:
+                journal_hint = (
+                    f"journal at {journal.directory!r}"
+                    if getattr(journal, "directory", None) else
+                    "no journal configured — pair journal=BlockJournal(dir) "
+                    "with a fixed noise_seed so a resume replays consumed "
+                    "blocks")
+                raise MeshDegradationError(
+                    f"job {job_id!r}: device losses exhausted the elastic "
+                    f"floor ({len(live)} live devices < "
+                    f"min_devices={floor}, planned {planned}). Resume on a "
+                    f"healthy slice with the same job_id={job_id!r} and "
+                    f"the same inputs/seed ({journal_hint}); consumed "
+                    f"blocks replay, the rest re-derive the same "
+                    f"fold_in keys.") from e
+            telemetry.record("mesh_degradations")
+            logging.warning(
+                "device loss for job %r (%s: %s); rebuilding a %d-device "
+                "mesh from %d survivors (planned %d) and re-entering the "
+                "driver — journaled blocks replay, re-dispatched blocks "
+                "re-derive the same fold_in(final_key, b) keys, so the "
+                "degraded run is a replay of the same release.", job_id,
+                type(e).__name__,
+                str(e).splitlines()[0][:160], target, len(live), planned)
+            current = mesh_lib.make_mesh(devices=live[:target])

@@ -24,15 +24,21 @@ Counter names used by the runtime:
   watchdog_late_completions guarded operations that completed after
                            their deadline had already expired
   host_fetch_retries       transient control-table fetch failures retried
+  device_losses            device-fatal failures observed (a chip
+                           dropped off the mesh)
+  mesh_degradations        elastic mesh rebuilds onto fewer devices
+                           after a device loss
   injected_faults          faults raised by the injection harness
 
 Timings (record_duration) aggregate per-phase wall time as
 (count, min, max, sum); the watchdog and the blocked drivers feed them
 so bench receipts can show where a job's wall clock went. Every counter
 increment and duration is also forwarded to the current job's health
-state machine (runtime/health.py) when one is tracked, which is how
-health aggregates retry/fallback/quarantine telemetry without the
-drivers threading a health object through every layer.
+state machine (runtime/health.py) when one is tracked, and durations
+are ADDITIONALLY aggregated under the current job's id — the same
+job_scope discipline counter forwarding uses — so timing_snapshot(job)
+/ job_timing_snapshot() report one job's phases without mixing in
+another job run in the same process.
 """
 
 import collections
@@ -43,6 +49,9 @@ _lock = threading.Lock()
 counters: "collections.Counter[str]" = collections.Counter()
 # name -> [count, min, max, sum] of recorded durations.
 _timings: Dict[str, list] = {}
+# job_id -> {name -> [count, min, max, sum]}: the same stats scoped to
+# the job that was current (health.job_scope) when they were recorded.
+_job_timings: Dict[str, Dict[str, list]] = {}
 
 
 def record(name: str, n: int = 1) -> None:
@@ -55,34 +64,61 @@ def record(name: str, n: int = 1) -> None:
     health.observe_counter(name, n)
 
 
+def _fold_timing(store: Dict[str, list], name: str, seconds: float) -> None:
+    entry = store.get(name)
+    if entry is None:
+        store[name] = [1, seconds, seconds, seconds]
+    else:
+        entry[0] += 1
+        entry[1] = min(entry[1], seconds)
+        entry[2] = max(entry[2], seconds)
+        entry[3] += seconds
+
+
 def record_duration(name: str, seconds: float) -> None:
-    """Aggregates one phase wall-time observation (min/max/sum/count)."""
+    """Aggregates one phase wall-time observation (min/max/sum/count),
+    process-wide and under the current job's id (when a job_scope is
+    active) so per-job snapshots never mix two jobs' phases."""
     seconds = float(seconds)
-    with _lock:
-        entry = _timings.get(name)
-        if entry is None:
-            _timings[name] = [1, seconds, seconds, seconds]
-        else:
-            entry[0] += 1
-            entry[1] = min(entry[1], seconds)
-            entry[2] = max(entry[2], seconds)
-            entry[3] += seconds
     from pipelinedp_tpu.runtime import health
+    h = health.current()
+    job = h.job_id if h is not None else None
+    with _lock:
+        _fold_timing(_timings, name, seconds)
+        if job is not None:
+            _fold_timing(_job_timings.setdefault(job, {}), name, seconds)
     health.observe_duration(name, seconds)
 
 
-def timing_snapshot() -> Dict[str, Dict[str, float]]:
-    """Per-phase wall-time stats recorded via record_duration."""
-    with _lock:
-        return {
-            name: {
-                "count": entry[0],
-                "min": entry[1],
-                "max": entry[2],
-                "sum": entry[3],
-            }
-            for name, entry in _timings.items()
+def _stats(store: Dict[str, list]) -> Dict[str, Dict[str, float]]:
+    return {
+        name: {
+            "count": entry[0],
+            "min": entry[1],
+            "max": entry[2],
+            "sum": entry[3],
         }
+        for name, entry in store.items()
+    }
+
+
+def timing_snapshot(
+        job_id: "str | None" = None) -> Dict[str, Dict[str, float]]:
+    """Per-phase wall-time stats recorded via record_duration. With no
+    job_id, the process-wide aggregate (every job plus unattributed
+    phases); with one, only the phases recorded while that job's
+    job_scope was current — two jobs in one process never mix."""
+    with _lock:
+        if job_id is None:
+            return _stats(_timings)
+        return _stats(_job_timings.get(job_id, {}))
+
+
+def job_timing_snapshot() -> Dict[str, Dict[str, Dict[str, float]]]:
+    """{job_id: timing_snapshot(job_id)} for every job that recorded a
+    duration — the receipt-friendly per-job view."""
+    with _lock:
+        return {job: _stats(store) for job, store in _job_timings.items()}
 
 
 def snapshot(timings: bool = False) -> Dict[str, int]:
@@ -108,3 +144,4 @@ def reset() -> None:
     with _lock:
         counters.clear()
         _timings.clear()
+        _job_timings.clear()
