@@ -447,18 +447,51 @@ def _bench_baseline_configs(jax, jnp, on_tpu):
     return detail
 
 
+def _phase_breakdown(summary, total_s):
+    """e2e phase breakdown from a trace summary: exclusive (self) wall
+    seconds per span name. Every span in the traced run nests under the
+    e2e root span, so the exclusive times PARTITION the root's inclusive
+    time — the per-phase seconds reconcile against total wall time by
+    construction (the residual is host time between instrumented
+    stages, reported as unattributed_s, plus clock skew)."""
+    phases = {
+        name: round(stats["exclusive_s"], 4)
+        for name, stats in summary["spans"].items()
+    }
+    attributed = sum(phases.values())
+    return {
+        "total_wall_s": round(total_s, 4),
+        "phases": phases,
+        "attributed_s": round(attributed, 4),
+        "unattributed_s": round(max(total_s - attributed, 0.0), 4),
+        "attributed_frac": (round(attributed / total_s, 4)
+                            if total_s else None),
+        "transfer_bytes": summary["transfer_bytes"],
+        "compile": summary["compile"],
+    }
+
+
 def _bench_end_to_end(on_tpu):
     """File -> DP result on the Netflix-format path: chunked parse ->
     incremental factorize -> overlapped upload (pipelinedp_tpu.ingest) ->
     fused kernel. The honest whole-pipeline number the kernel-only figure
     above excludes (host encode at ~3.5M rows/s on the 1-core host bounds
-    it; the overlap hides the device-transfer term)."""
+    it; the overlap hides the device-transfer term).
+
+    The WARM run executes with tracing enabled under an "e2e" root span:
+    the receipt gains e2e_phase_breakdown (per-phase exclusive seconds
+    that reconcile against total wall time, with transfer-byte and jit
+    compile attribution) and trace_summary, and the full Perfetto trace
+    is dumped next to the system tempdir — the decomposition of the
+    kernel-vs-end-to-end gap the ROADMAP's engine-pipeline refactor will
+    be judged against."""
     import os
     import tempfile
 
     import pipelinedp_tpu as pdp
     from examples.movie_view_ratings import netflix_format
     from pipelinedp_tpu import ingest
+    from pipelinedp_tpu.runtime import trace as rt_trace
 
     n = 8_000_000 if on_tpu else 400_000
     path = os.path.join(tempfile.mkdtemp(), "views.txt")
@@ -492,7 +525,19 @@ def _bench_end_to_end(on_tpu):
     # tunnel); warm re-runs the identical shapes against the compile cache
     # and is the steady-state number a long-running pipeline sees.
     cold_sec, n_kept = run_once()
-    warm_sec, n_kept_warm = run_once()
+    # Warm run under a fresh trace epoch: spans attribute the steady-state
+    # wall time; tracing is restored to its prior state afterwards so the
+    # remaining benchmarks measure the untraced hot path.
+    rt_trace.reset()
+    with rt_trace.scoped():
+        with rt_trace.span("e2e"):
+            warm_sec, n_kept_warm = run_once()
+        summary = rt_trace.trace_summary()
+        trace_path = os.path.join(tempfile.gettempdir(),
+                                  "pipelinedp_tpu_e2e_trace.json")
+        rt_trace.dump(trace_path)
+    breakdown = _phase_breakdown(summary, warm_sec)
+    rt_trace.reset()
     os.unlink(path)
     # Note for cross-round comparisons: rounds <= 4 reported a single
     # compile-inclusive "end_to_end_sec"; that old key corresponds to
@@ -504,6 +549,14 @@ def _bench_end_to_end(on_tpu):
         "end_to_end_sec_warm": round(warm_sec, 3),
         "end_to_end_rows_per_sec_warm": round(n / warm_sec),
         "end_to_end_kept_partitions": n_kept_warm,
+        "e2e_phase_breakdown": breakdown,
+        "trace_summary": {
+            "spans": dict(list(summary["spans"].items())[:12]),
+            "instants": summary["instants"],
+            "n_events": summary["n_events"],
+            "dropped_events": summary["dropped_events"],
+        },
+        "trace_file": trace_path,
     }
 
 
@@ -683,14 +736,11 @@ def main():
     # any throughput dip) instead of silently hiding it.
     from pipelinedp_tpu.runtime import health as rt_health
     from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+    # Every declared counter (telemetry.REGISTRY is the single source of
+    # truth), not a hand-maintained list that drifts as counters grow.
     fault_counters = {
         name: rt_telemetry.counters.get(name, 0)
-        for name in ("block_retries", "block_timeouts",
-                     "block_oom_degradations", "reshard_host_fallbacks",
-                     "journal_replays", "journal_quarantined",
-                     "watchdog_timeouts", "watchdog_late_completions",
-                     "host_fetch_retries", "device_losses",
-                     "mesh_degradations")
+        for name in rt_telemetry.counter_names()
     }
     # Per-phase wall-time stats (telemetry.record_duration) and the
     # health state machine's per-job verdicts: a receipt that stalled,

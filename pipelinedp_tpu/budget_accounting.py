@@ -241,6 +241,16 @@ class BudgetAccountant(abc.ABC):
     def _register_mechanism(
             self, mechanism: MechanismSpecInternal) -> MechanismSpecInternal:
         self._mechanisms.append(mechanism)
+        # Ledger registrations are runtime incidents worth a timeline
+        # mark: with tracing on, each lands as an instant event, so a
+        # double-spend bug (a registration during execution) is visible
+        # in the trace exactly where it happened. Lazy import: this
+        # module must stay importable without the runtime package.
+        from pipelinedp_tpu.runtime import telemetry
+        telemetry.record(
+            "budget_registrations",
+            mechanism_type=str(
+                getattr(mechanism.mechanism_spec, "mechanism_type", "")))
         for scope in self._scopes_stack:
             scope.mechanisms.append(mechanism)
         return mechanism

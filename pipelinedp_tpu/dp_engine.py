@@ -88,7 +88,9 @@ class DPEngine:
             public_partitions is not None, params.metrics,
             params.custom_combiners is not None)
 
-        with self._budget_accountant.scope(weight=params.budget_weight):
+        from pipelinedp_tpu.runtime import trace as rt_trace
+        with self._budget_accountant.scope(weight=params.budget_weight), \
+                rt_trace.span("graph_build"):
             self._report_generators.append(
                 report_generator.ReportGenerator(params, "aggregate",
                                                  public_partitions is not None))

@@ -53,6 +53,7 @@ from pipelinedp_tpu.ops import noise as noise_ops
 from pipelinedp_tpu.ops import secure_noise
 from pipelinedp_tpu.ops import segment_ops
 from pipelinedp_tpu.ops import selection_ops
+from pipelinedp_tpu.runtime import trace as rt_trace
 
 
 def _ftype():
@@ -893,6 +894,13 @@ def aggregate_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
     return outputs, keep, row_count
 
 
+# Compile/dispatch attribution (runtime/trace.probe_jit): calls that grow
+# the jit cache are counted as compiles with their wall seconds, per
+# entry point — the recompile bill trace summaries and the bench's
+# e2e_phase_breakdown separate from steady-state dispatch.
+aggregate_kernel = rt_trace.probe_jit("aggregate_kernel", aggregate_kernel)
+
+
 def select_partition_counts(pid, pk, valid, key: jax.Array, l0: int,
                             n_partitions: int) -> jnp.ndarray:
     """Per-partition privacy-id counts after pair dedupe + L0 sampling.
@@ -968,6 +976,10 @@ def select_kept_pair_stream(pid, pk, valid, rng_key, l0: int,
     return spk_sorted, kept_pair.sum()
 
 
+select_kept_pair_stream = rt_trace.probe_jit("select_kept_pair_stream",
+                                             select_kept_pair_stream)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("l0", "n_partitions", "selection"))
 def select_partitions_kernel(pid, pk, valid, rng_key, l0: int,
@@ -980,6 +992,10 @@ def select_partitions_kernel(pid, pk, valid, rng_key, l0: int,
     counts = select_partition_counts(pid, pk, valid, key_l0, l0,
                                      n_partitions)
     return selection_ops.sample_keep_decisions(key_sel, counts, selection)
+
+
+select_partitions_kernel = rt_trace.probe_jit("select_partitions_kernel",
+                                              select_partitions_kernel)
 
 
 def blocked_job_id(kind: str, static_config, noise_seed) -> str:
@@ -1109,7 +1125,8 @@ def lazy_select_partitions(backend, col, params, data_extractors,
     rows = col
 
     def generator():
-        encoded = columnar.encode(rows, data_extractors)
+        with rt_trace.span("encode"):
+            encoded = columnar.encode(rows, data_extractors)
         selection = selection_ops.selection_params_from_host(
             strategy, budget.eps, budget.delta,
             params.max_partitions_contributed, params.pre_threshold)
@@ -1165,14 +1182,19 @@ def lazy_select_partitions(backend, col, params, data_extractors,
             slim = dataclasses.replace(
                 encoded, values=np.zeros((encoded.n_rows, 0), np.float64))
             pid, pk, _, valid = pad_rows(slim)
-            keep = select_partitions_kernel(
-                jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(valid), key,
-                params.max_partitions_contributed, n_partitions, selection)
+            with rt_trace.span("dispatch"):
+                keep = select_partitions_kernel(
+                    jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(valid),
+                    key, params.max_partitions_contributed, n_partitions,
+                    selection)
         vocab = encoded.partition_vocab
         n_real = len(vocab)
-        for idx in np.nonzero(np.asarray(keep))[0]:
-            if idx < n_real:
-                yield vocab[idx]
+        with rt_trace.span("drain"):
+            kept_idx = np.nonzero(np.asarray(keep))[0]
+        with rt_trace.span("post_process"):
+            for idx in kept_idx:
+                if idx < n_real:
+                    yield vocab[idx]
 
     return generator()
 
@@ -1338,7 +1360,8 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
     rows = col  # materialized at execution time
 
     def generator():
-        encoded = columnar.encode(rows, data_extractors, public_list)
+        with rt_trace.span("encode"):
+            encoded = columnar.encode(rows, data_extractors, public_list)
         if Metrics.VECTOR_SUM in (params.metrics or []):
             expected = (params.vector_size,)
             got = encoded.values.shape[1:]
@@ -1398,9 +1421,10 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
                         encoded.valid, min_v, max_v, min_s, max_s, mid,
                         np.asarray(stds), key, cfg,
                         secure_tables=secure_tables, **runtime_kwargs)
-            yield from decode_blocked_results(kept_ids, blocked_outputs,
-                                              encoded.partition_vocab,
-                                              compound)
+            with rt_trace.span("post_process"):
+                yield from decode_blocked_results(kept_ids, blocked_outputs,
+                                                  encoded.partition_vocab,
+                                                  compound)
             return
         pid, pk, values, valid = pad_rows(encoded)
         with budget_accountant.no_new_mechanisms(
@@ -1414,12 +1438,15 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
                     **_dense_runtime_kwargs(backend,
                                             "sharded_aggregate_arrays"))
             else:
-                outputs, keep, _ = aggregate_kernel(
-                    jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(values),
-                    jnp.asarray(valid), min_v, max_v, min_s, max_s, mid,
-                    jnp.asarray(stds), key, cfg, secure_tables)
-        yield from decode_results(outputs, keep, encoded.partition_vocab,
-                                  compound)
+                with rt_trace.span("dispatch"):
+                    outputs, keep, _ = aggregate_kernel(
+                        jnp.asarray(pid), jnp.asarray(pk),
+                        jnp.asarray(values), jnp.asarray(valid), min_v,
+                        max_v, min_s, max_s, mid, jnp.asarray(stds), key,
+                        cfg, secure_tables)
+        with rt_trace.span("post_process"):
+            yield from decode_results(outputs, keep,
+                                      encoded.partition_vocab, compound)
 
     return generator()
 
@@ -1432,7 +1459,10 @@ def _decode_rows(outputs, row_idx_pairs, partition_vocab: Sequence[Any],
     in each child's true compute_metrics insertion order — identical to
     CompoundCombiner.compute_metrics on the generic path.
     """
-    outputs_np = {name: np.asarray(col) for name, col in outputs.items()}
+    with rt_trace.span("drain"):
+        # The np.asarray forces each output column to host: on the async
+        # dense path this wait IS the device execution + transfer time.
+        outputs_np = {name: np.asarray(col) for name, col in outputs.items()}
     field_order: List[str] = [
         name for entry in build_plan(compound) for name in entry.outputs
     ]

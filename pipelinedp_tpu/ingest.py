@@ -274,6 +274,7 @@ def stream_encode_columns(
     import jax.numpy as jnp
 
     from pipelinedp_tpu import executor
+    from pipelinedp_tpu.runtime import trace as rt_trace
     value_dtype = np.dtype(executor._ftype())
 
     pid_enc = ChunkedVocabEncoder()
@@ -282,36 +283,44 @@ def stream_encode_columns(
     if public_partitions is not None:
         partition_vocab = list(dict.fromkeys(public_partitions))
     dev_pid, dev_pk, dev_vals = [], [], []
-    for pid_raw, pk_raw, values in chunks:
-        pid = pid_enc.encode(pid_raw)
-        if partition_vocab is not None:
-            pk = columnar.encode_with_vocab(
-                columnar._as_key_array(pk_raw), partition_vocab)
-        else:
-            pk = pk_enc.encode(pk_raw)
-        values = np.asarray(values, dtype=value_dtype)
-        bad = columnar.nonfinite_value_rows(values, nonfinite)
-        if bad is not None:
-            pk = np.where(bad, np.int32(-1), pk).astype(np.int32)
-            mask = bad if values.ndim == 1 else bad[:, None]
-            values = np.where(mask, 0.0, values).astype(value_dtype)
-        # jnp.asarray dispatches the host->device copy asynchronously; the
-        # loop continues into the next chunk's parse while it lands.
-        dev_pid.append(jnp.asarray(pid))
-        dev_pk.append(jnp.asarray(pk))
-        dev_vals.append(jnp.asarray(values))
-    if not dev_pid:
-        empty = jnp.zeros(0, jnp.int32)
-        dev_pid, dev_pk = [empty], [empty]
-        dev_vals = [jnp.zeros(0, value_dtype)]
-    return columnar.EncodedData(
-        pid=jnp.concatenate(dev_pid),
-        pk=jnp.concatenate(dev_pk),
-        values=jnp.concatenate(dev_vals),
-        partition_vocab=(partition_vocab if partition_vocab is not None else
-                         pk_enc.vocabulary),
-        n_privacy_ids=len(pid_enc),
-        public_encoded=public_partitions is not None)
+    # The ingest span covers parse+factorize+upload for the whole stream;
+    # its row count attribute lets trace summaries report ingest rate.
+    with rt_trace.span("ingest") as ingest_span:
+        n_rows = 0
+        for pid_raw, pk_raw, values in chunks:
+            pid = pid_enc.encode(pid_raw)
+            if partition_vocab is not None:
+                pk = columnar.encode_with_vocab(
+                    columnar._as_key_array(pk_raw), partition_vocab)
+            else:
+                pk = pk_enc.encode(pk_raw)
+            values = np.asarray(values, dtype=value_dtype)
+            bad = columnar.nonfinite_value_rows(values, nonfinite)
+            if bad is not None:
+                pk = np.where(bad, np.int32(-1), pk).astype(np.int32)
+                mask = bad if values.ndim == 1 else bad[:, None]
+                values = np.where(mask, 0.0, values).astype(value_dtype)
+            n_rows += len(pid)
+            # jnp.asarray dispatches the host->device copy asynchronously;
+            # the loop continues into the next chunk's parse while it
+            # lands.
+            dev_pid.append(jnp.asarray(pid))
+            dev_pk.append(jnp.asarray(pk))
+            dev_vals.append(jnp.asarray(values))
+        if not dev_pid:
+            empty = jnp.zeros(0, jnp.int32)
+            dev_pid, dev_pk = [empty], [empty]
+            dev_vals = [jnp.zeros(0, value_dtype)]
+        ingest_span.set(rows=n_rows)
+        return columnar.EncodedData(
+            pid=jnp.concatenate(dev_pid),
+            pk=jnp.concatenate(dev_pk),
+            values=jnp.concatenate(dev_vals),
+            partition_vocab=(partition_vocab
+                             if partition_vocab is not None else
+                             pk_enc.vocabulary),
+            n_privacy_ids=len(pid_enc),
+            public_encoded=public_partitions is not None)
 
 
 # --- Multi-host ingest -----------------------------------------------------

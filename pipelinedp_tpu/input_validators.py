@@ -123,6 +123,21 @@ def validate_min_devices(min_devices, obj_name: str) -> None:
             f"resume pointer instead.")
 
 
+def validate_trace(trace, obj_name: str) -> None:
+    """Validates the tracing switch: a plain bool.
+
+    Raises:
+        ValueError: trace is not a bool (a truthy non-bool — say a file
+        path passed where dump_trace(path) was meant — would silently
+        enable process-wide span recording).
+    """
+    if not isinstance(trace, bool):
+        raise ValueError(
+            f"{obj_name}: trace must be a bool, but {trace!r} given "
+            f"(True enables span/instant recording; export with "
+            f"dump_trace(path)).")
+
+
 def validate_journal(journal, obj_name: str) -> None:
     """Validates a BlockJournal-shaped object: get/put record accessors.
 

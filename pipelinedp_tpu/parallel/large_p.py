@@ -81,6 +81,7 @@ from pipelinedp_tpu.runtime import faults as rt_faults
 from pipelinedp_tpu.runtime import journal as rt_journal
 from pipelinedp_tpu.runtime import retry as rt_retry
 from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+from pipelinedp_tpu.runtime import trace as rt_trace
 from pipelinedp_tpu.runtime import watchdog as rt_watchdog
 
 # One shared depth for the async block pipeline: _dispatch_blocks keeps at
@@ -138,6 +139,10 @@ def _bounded_compact_kernel(pid, pk, values, valid, min_v, max_v, min_s,
     quantile-tree leaf index through the same compaction sort."""
     return _bound_compact_trace(pid, pk, values, valid, min_v, max_v, min_s,
                                 max_s, mid, key, cfg)
+
+
+_bounded_compact_kernel = rt_trace.probe_jit("blocked_bound_compact",
+                                             _bounded_compact_kernel)
 
 
 def _block_trace(spk_s, pair_s, cols_s, leaf_s, lo, length, base, min_v,
@@ -200,6 +205,10 @@ def _block_kernel_dev(spk_s, pair_s, cols_s, leaf_s, lo, length, base, min_v,
     return _block_trace(spk_s, pair_s, cols_s, leaf_s, lo, length, base,
                         min_v, max_v, mid, stds, key, cfg, cap,
                         secure_tables)
+
+
+_block_kernel_dev = rt_trace.probe_jit("blocked_block_kernel",
+                                       _block_kernel_dev)
 
 
 def _chunk_ends(pid_sorted: np.ndarray, row_chunk: int) -> np.ndarray:
@@ -301,7 +310,10 @@ def _dispatch_blocks(block_iter, consume,
     n_dispatched = 0
 
     def start(b, make):
-        result = rt_retry.retry_call(make, policy, block=b)
+        # The per-block dispatch span gives the trace a block-granular
+        # timeline alongside the watchdog's "dispatch" heartbeats/guards.
+        with rt_trace.span("dispatch", block=b):
+            result = rt_retry.retry_call(make, policy, block=b)
         # Start the host copy of each scalar output (the n_kept gates) at
         # dispatch time: by the time consume() syncs on it, the value has
         # already crossed the link — int(n_kept) would otherwise pay one
@@ -323,7 +335,8 @@ def _dispatch_blocks(block_iter, consume,
                 # The drain sync runs under its own watchdog deadline
                 # (when one is active): an expiry surfaces as a transient
                 # BlockTimeoutError and re-dispatches the same key below.
-                with rt_watchdog.guard("drain", b):
+                with rt_watchdog.guard("drain", b), \
+                        rt_trace.span("drain", block=b):
                     rt_faults.maybe_hang(b, point="drain")
                     _sync_scalars(result)
                 break
@@ -334,8 +347,8 @@ def _dispatch_blocks(block_iter, consume,
                 delay = policy.delay(attempt)
                 attempt += 1
                 if rt_retry.is_timeout(e):
-                    rt_telemetry.record("block_timeouts")
-                rt_telemetry.record("block_retries")
+                    rt_telemetry.record("block_timeouts", block=b)
+                rt_telemetry.record("block_retries", block=b)
                 logging.warning(
                     "block %d failed at its sync point (%s); re-dispatching "
                     "under the same block key (retry %d/%d in %.2fs) — "
@@ -343,7 +356,8 @@ def _dispatch_blocks(block_iter, consume,
                     type(e).__name__, attempt, policy.max_retries, delay)
                 time.sleep(delay)
                 result = start(b, make)
-        consume(b, result)
+        with rt_trace.span("consume", block=b):
+            consume(b, result)
 
     def _degradable(err):
         # Exhausted timeouts degrade exactly like OOM: halving the block
@@ -583,6 +597,10 @@ def _sharded_bound_compact(pid, pk, values, valid, min_v, max_v, min_s,
     return fn(pid, pk, values, valid, rows_key, boundaries)
 
 
+_sharded_bound_compact = rt_trace.probe_jit("sharded_bound_compact",
+                                            _sharded_bound_compact)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "cap", "mesh"))
 def _sharded_block_kernel(spk_all, pair_all, cols_all, leaf_all, lo_r, len_r,
                           base, min_v, max_v, mid, stds, key,
@@ -620,6 +638,10 @@ def _sharded_block_kernel(spk_all, pair_all, cols_all, leaf_all, lo_r, len_r,
               secure_tables)
 
 
+_sharded_block_kernel = rt_trace.probe_jit("sharded_block_kernel",
+                                           _sharded_block_kernel)
+
+
 @functools.partial(jax.jit, static_argnames=("mesh",))
 def _sharded_block_offsets(spk_all, boundaries, mesh):
     """Per-shard block offsets of the compacted stream against a NEW set
@@ -637,6 +659,10 @@ def _sharded_block_offsets(spk_all, boundaries, mesh):
                    in_specs=(SP(SHARD_AXIS), SP()),
                    out_specs=SP(SHARD_AXIS))
     return fn(spk_all, boundaries)
+
+
+_sharded_block_offsets = rt_trace.probe_jit("sharded_block_offsets",
+                                            _sharded_block_offsets)
 
 
 def _block_boundaries(base: int, capacity: int, n_blocks: int) -> np.ndarray:
@@ -723,13 +749,15 @@ def aggregate_blocked_sharded(mesh,
     boundaries0 = _block_boundaries(0, C0, n_blocks0)
 
     t_p1 = time.perf_counter()
-    spk_all, pair_all, cols_all, leaf_all, starts = _sharded_bound_compact(
-        pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, rows_key,
-        jnp.asarray(boundaries0), cfg, mesh)
-    # The one per-aggregation host download that scales with n_blocks, not
-    # rows: each shard's block offsets (host_fetch = sanctioned under the
-    # transfer guard).
-    starts0 = host_fetch(starts).reshape(n_shards, n_blocks0 + 1)
+    with rt_trace.span("contribution_bounding"):
+        spk_all, pair_all, cols_all, leaf_all, starts = \
+            _sharded_bound_compact(
+                pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+                rows_key, jnp.asarray(boundaries0), cfg, mesh)
+        # The one per-aggregation host download that scales with n_blocks,
+        # not rows: each shard's block offsets (host_fetch = sanctioned
+        # under the transfer guard).
+        starts0 = host_fetch(starts).reshape(n_shards, n_blocks0 + 1)
     _seed_pass1(time.perf_counter() - t_p1)
 
     output_names = [name for e in cfg.plan for name in e.outputs]
@@ -792,7 +820,7 @@ def aggregate_blocked_sharded(mesh,
                     record = journal.get(job,
                                          rt_journal.block_key(b_base, C))
                     if record is not None:
-                        rt_telemetry.record("journal_replays")
+                        rt_telemetry.record("journal_replays", block=j)
                         yield (j, _Replay(record))
                         continue
                 lo = starts_r[:, j].astype(np.int32)
@@ -860,6 +888,10 @@ def _selection_block_kernel(spk_kept, lo, length, base, c_actual, key,
                                   selection, cap)
 
 
+_selection_block_kernel = rt_trace.probe_jit("selection_block_kernel",
+                                             _selection_block_kernel)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("l0", "n_partitions", "mesh"))
 def _sharded_select_compact(pid, pk, valid, rows_key, boundaries, l0: int,
@@ -891,6 +923,10 @@ def _sharded_select_compact(pid, pk, valid, rows_key, boundaries, l0: int,
     return fn(pid, pk, valid, rows_key, boundaries)
 
 
+_sharded_select_compact = rt_trace.probe_jit("sharded_select_compact",
+                                             _sharded_select_compact)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("c_actual", "selection", "cap", "mesh"))
 def _sharded_selection_block(spk_all, lo_r, len_r, base, c_actual, key,
@@ -913,6 +949,10 @@ def _sharded_selection_block(spk_all, lo_r, len_r, base, c_actual, key,
                    in_specs=(SP(SHARD_AXIS), SP(), SP(), SP()),
                    out_specs=(SP(), SP()))
     return fn(spk_all, lo_r, len_r, key)
+
+
+_sharded_selection_block = rt_trace.probe_jit("sharded_selection_block",
+                                              _sharded_selection_block)
 
 
 @_runtime_entry("select_partitions_blocked_sharded",
@@ -966,10 +1006,11 @@ def select_partitions_blocked_sharded(mesh,
     C0 = min(block_partitions, P)
     n_blocks0 = -(-P // C0)
     t_p1 = time.perf_counter()
-    spk_all, starts = _sharded_select_compact(
-        pid, pk, valid, key_l0,
-        jnp.asarray(_block_boundaries(0, C0, n_blocks0)), l0, P, mesh)
-    starts0 = host_fetch(starts).reshape(n_shards, n_blocks0 + 1)
+    with rt_trace.span("contribution_bounding"):
+        spk_all, starts = _sharded_select_compact(
+            pid, pk, valid, key_l0,
+            jnp.asarray(_block_boundaries(0, C0, n_blocks0)), l0, P, mesh)
+        starts0 = host_fetch(starts).reshape(n_shards, n_blocks0 + 1)
     _seed_pass1(time.perf_counter() - t_p1)
 
     kept_ids = []
@@ -1020,7 +1061,7 @@ def select_partitions_blocked_sharded(mesh,
                     record = journal.get(job,
                                          rt_journal.block_key(b_base, C))
                     if record is not None:
-                        rt_telemetry.record("journal_replays")
+                        rt_telemetry.record("journal_replays", block=j)
                         yield (j, _Replay(record))
                         continue
                 lo = starts_r[:, j].astype(np.int32)
@@ -1079,9 +1120,11 @@ def select_partitions_blocked(pid,
         pid, pk, valid = np.asarray(pid), np.asarray(pk), np.asarray(valid)
     cap = round_capacity(len(pid))
     t_p1 = time.perf_counter()
-    spk_sorted, _ = executor.select_kept_pair_stream(
-        jnp.asarray(_pad_to(pid, cap, 0)), jnp.asarray(_pad_to(pk, cap, 0)),
-        jnp.asarray(_pad_to(valid, cap, False)), key_l0, l0, P)
+    with rt_trace.span("contribution_bounding"):
+        spk_sorted, _ = executor.select_kept_pair_stream(
+            jnp.asarray(_pad_to(pid, cap, 0)),
+            jnp.asarray(_pad_to(pk, cap, 0)),
+            jnp.asarray(_pad_to(valid, cap, False)), key_l0, l0, P)
     _seed_pass1(time.perf_counter() - t_p1)
 
     C0 = min(block_partitions, P)
@@ -1126,7 +1169,7 @@ def select_partitions_blocked(pid,
                     record = journal.get(job,
                                          rt_journal.block_key(b_base, C))
                     if record is not None:
-                        rt_telemetry.record("journal_replays")
+                        rt_telemetry.record("journal_replays", block=j)
                         yield (j, _Replay(record))
                         continue
                 lo, hi = int(block_starts[j]), int(block_starts[j + 1])
@@ -1219,30 +1262,38 @@ def aggregate_blocked(pid,
     stds = jnp.asarray(stds)
 
     # --- Pass 1: bound rows, compact + spk-sort the survivors. ------------
-    if n <= row_chunk:
-        # Device-resident: one kernel call, rows stay in HBM for pass 2.
-        cap = round_capacity(n)
-        spk_all, pair_all, cols_all, leaf_all, _ = _bounded_compact_kernel(
-            _pad_to(pid, cap, 0), _pad_to(pk, cap, 0),
-            _pad_to(values, cap, 0), _pad_to(valid, cap, False), min_v,
-            max_v, min_s, max_s, mid, jax.random.fold_in(rows_key, 0), cfg)
-    else:
-        if device_resident:
-            # Host staging re-chunks on privacy-id boundaries with host
-            # argsorts; one download is unavoidable in this regime.
-            pid, pk, values, valid = (np.asarray(pid), np.asarray(pk),
-                                      np.asarray(values), np.asarray(valid))
-        spk_all, pair_all, cols_all, leaf_all = \
-            _bound_and_compact_host_staged(
-                pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
-                rows_key, cfg, row_chunk)
-        # Blocks gather from device-resident arrays either way; per-block
-        # inputs are O(block rows), so upload the merged stream once.
-        spk_all = jnp.asarray(spk_all)
-        pair_all = jnp.asarray(pair_all)
-        cols_all = {name: jnp.asarray(col) for name, col in cols_all.items()}
-        if leaf_all is not None:
-            leaf_all = jnp.asarray(leaf_all)
+    with rt_trace.span("contribution_bounding", rows=n):
+        if n <= row_chunk:
+            # Device-resident: one kernel call, rows stay in HBM for
+            # pass 2.
+            cap = round_capacity(n)
+            spk_all, pair_all, cols_all, leaf_all, _ = \
+                _bounded_compact_kernel(
+                    _pad_to(pid, cap, 0), _pad_to(pk, cap, 0),
+                    _pad_to(values, cap, 0), _pad_to(valid, cap, False),
+                    min_v, max_v, min_s, max_s, mid,
+                    jax.random.fold_in(rows_key, 0), cfg)
+        else:
+            if device_resident:
+                # Host staging re-chunks on privacy-id boundaries with
+                # host argsorts; one download is unavoidable here.
+                pid, pk, values, valid = (np.asarray(pid), np.asarray(pk),
+                                          np.asarray(values),
+                                          np.asarray(valid))
+            spk_all, pair_all, cols_all, leaf_all = \
+                _bound_and_compact_host_staged(
+                    pid, pk, values, valid, min_v, max_v, min_s, max_s,
+                    mid, rows_key, cfg, row_chunk)
+            # Blocks gather from device-resident arrays either way;
+            # per-block inputs are O(block rows), so upload the merged
+            # stream once.
+            spk_all = jnp.asarray(spk_all)
+            pair_all = jnp.asarray(pair_all)
+            cols_all = {
+                name: jnp.asarray(col) for name, col in cols_all.items()
+            }
+            if leaf_all is not None:
+                leaf_all = jnp.asarray(leaf_all)
     if profiling:
         # Not block_until_ready: it is a no-op on some remote platforms
         # (the tunneled axon TPU), which would shift pass-1 tail cost
@@ -1345,7 +1396,7 @@ def aggregate_blocked(pid,
                     record = journal.get(job,
                                          rt_journal.block_key(b_base, C))
                     if record is not None:
-                        rt_telemetry.record("journal_replays")
+                        rt_telemetry.record("journal_replays", block=j)
                         yield (j, _Replay(record))
                         continue
                 lo, hi = int(block_starts[j]), int(block_starts[j + 1])

@@ -171,6 +171,7 @@ def host_fetch(arr, max_retries: Optional[int] = None) -> np.ndarray:
     # Imported lazily: mesh is a leaf module most of the package imports.
     from pipelinedp_tpu.runtime import retry as rt_retry
     from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+    from pipelinedp_tpu.runtime import trace as rt_trace
     from pipelinedp_tpu.runtime import watchdog as rt_watchdog
 
     # Control-table fetches are sync points the blocked drivers pass
@@ -190,7 +191,13 @@ def host_fetch(arr, max_retries: Optional[int] = None) -> np.ndarray:
         attempt = 0
         while True:
             try:
-                return np.asarray(arr)
+                # The span carries the transferred byte count so trace
+                # summaries can attribute control-plane transfer volume
+                # (transfer_bytes) separately from compute.
+                with rt_trace.span("host_fetch") as sp:
+                    out = np.asarray(arr)
+                    sp.set(bytes=int(out.nbytes))
+                    return out
             except Exception as e:  # noqa: BLE001 - classified below
                 if not rt_retry.is_transient(e) or attempt >= max_retries:
                     raise

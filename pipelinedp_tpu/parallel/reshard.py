@@ -56,6 +56,7 @@ from pipelinedp_tpu.parallel.mesh import (SHARD_AXIS, host_fetch,
 from pipelinedp_tpu.runtime import faults as rt_faults
 from pipelinedp_tpu.runtime import retry as rt_retry
 from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+from pipelinedp_tpu.runtime import trace as rt_trace
 from pipelinedp_tpu.runtime import watchdog as rt_watchdog
 
 # Fetches at or below this many elements are control-plane sized; the
@@ -144,6 +145,18 @@ def _exchange_kernel(pid, pk, values, valid, cap_send: int, out_cap: int,
     fn = shard_map(per_shard, mesh=mesh, in_specs=(P(SHARD_AXIS),) * 4,
                    out_specs=(P(SHARD_AXIS),) * 4)
     return fn(pid, pk, values, valid)
+
+
+# Compile/dispatch attribution for the reshard entry points (trace
+# summaries separate all_to_all compiles from steady-state exchanges).
+_send_count_kernel = rt_trace.probe_jit("reshard_send_count",
+                                        _send_count_kernel)
+_exchange_kernel = rt_trace.probe_jit("reshard_exchange", _exchange_kernel)
+
+
+def _row_payload_bytes(*cols) -> int:
+    """Total byte size of the row columns a staging path moves."""
+    return int(sum(getattr(c, "nbytes", 0) for c in cols))
 
 
 def _pad_and_shard(mesh: Mesh, per_shard_cap: int, pid, pk, values, valid):
@@ -248,7 +261,12 @@ def stage_rows_to_mesh(mesh: Mesh, pid, pk, values, valid,
             # (when one is active on this thread): a hang on the
             # all_to_all fabric surfaces as BlockTimeoutError and degrades
             # to the host permutation exactly like a failed collective.
-            with rt_watchdog.guard("collective"):
+            # The span carries the exchanged row-payload byte count so
+            # trace summaries attribute collective volume.
+            with rt_watchdog.guard("collective"), \
+                    rt_trace.span(
+                        "reshard.collective",
+                        bytes=_row_payload_bytes(pid, pk, values, valid)):
                 # A device LOST during the exchange is not a collective
                 # failure the host permutation can route around — the
                 # mesh itself contains a dead chip — so device-fatal
@@ -275,17 +293,19 @@ def stage_rows_to_mesh(mesh: Mesh, pid, pk, values, valid,
             pid, pk, values, valid = (host_fetch(pid), host_fetch(pk),
                                       host_fetch(values), host_fetch(valid))
     from pipelinedp_tpu.parallel import sharded
-    values = np.asarray(values)
-    if values_dtype is not None:
-        values = values.astype(values_dtype, copy=False)
-    pid, pk, values, valid = sharded.shard_rows_by_pid(
-        np.asarray(pid), np.asarray(pk), values, np.asarray(valid),
-        mesh.devices.size)
-    sharding = row_sharding(mesh)
-    return (jax.device_put(jnp.asarray(pid), sharding),
-            jax.device_put(jnp.asarray(pk), sharding),
-            jax.device_put(jnp.asarray(values), sharding),
-            jax.device_put(jnp.asarray(valid), sharding))
+    with rt_trace.span("reshard.host") as sp:
+        values = np.asarray(values)
+        if values_dtype is not None:
+            values = values.astype(values_dtype, copy=False)
+        pid, pk, values, valid = sharded.shard_rows_by_pid(
+            np.asarray(pid), np.asarray(pk), values, np.asarray(valid),
+            mesh.devices.size)
+        sp.set(bytes=_row_payload_bytes(pid, pk, values, valid))
+        sharding = row_sharding(mesh)
+        return (jax.device_put(jnp.asarray(pid), sharding),
+                jax.device_put(jnp.asarray(pk), sharding),
+                jax.device_put(jnp.asarray(values), sharding),
+                jax.device_put(jnp.asarray(valid), sharding))
 
 
 def _is_collective_failure(exc: BaseException) -> bool:

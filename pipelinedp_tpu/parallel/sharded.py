@@ -40,6 +40,7 @@ from pipelinedp_tpu.parallel.mesh import SHARD_AXIS, round_capacity, shard_map
 from pipelinedp_tpu.parallel.reshard import stage_rows_to_mesh
 from pipelinedp_tpu.runtime import entry as rt_entry
 from pipelinedp_tpu.runtime import retry as rt_retry
+from pipelinedp_tpu.runtime import trace as rt_trace
 
 
 def shard_rows_by_pid(pid: np.ndarray, pk: np.ndarray, values: np.ndarray,
@@ -162,6 +163,12 @@ def _sharded_select_kernel(pid, pk, valid, rng_key, l0: int,
     return fn(pid, pk, valid, rng_key)
 
 
+# Compile/dispatch attribution for the dense meshed entry points.
+_sharded_kernel = rt_trace.probe_jit("sharded_kernel", _sharded_kernel)
+_sharded_select_kernel = rt_trace.probe_jit("sharded_select_kernel",
+                                            _sharded_select_kernel)
+
+
 def _fallback_select_partitions(args, kwargs, job):
     """Elastic floor of sharded_select_partitions: the single-device
     selection kernel on the surviving device. The selection key
@@ -246,10 +253,11 @@ def sharded_select_partitions(mesh: Mesh, pid, pk, valid, rng_key, l0: int,
                                            valid, reshard)
     # Retried dispatches reuse the identical rng_key: a retry is a replay
     # of the same selection decisions, never a second draw.
-    return rt_retry.retry_call(
-        lambda: _sharded_select_kernel(pid, pk, valid, rng_key, l0,
-                                       n_partitions, selection, mesh),
-        retry, what="sharded select_partitions dispatch")
+    with rt_trace.span("dispatch"):
+        return rt_retry.retry_call(
+            lambda: _sharded_select_kernel(pid, pk, valid, rng_key, l0,
+                                           n_partitions, selection, mesh),
+            retry, what="sharded select_partitions dispatch")
 
 
 @rt_entry.runtime_entry("sharded_aggregate_arrays",
@@ -280,8 +288,9 @@ def sharded_aggregate_arrays(mesh: Mesh, pid, pk, values, valid, min_v, max_v,
         values_dtype=np.dtype(executor._ftype()))
     # Retried dispatches reuse the identical rng_key, so the redrawn noise
     # is bit-identical — a retry replays the same release.
-    return rt_retry.retry_call(
-        lambda: _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s,
-                                max_s, mid, jnp.asarray(stds), rng_key, cfg,
-                                mesh, secure_tables),
-        retry, what="sharded aggregation dispatch")
+    with rt_trace.span("dispatch"):
+        return rt_retry.retry_call(
+            lambda: _sharded_kernel(pid, pk, values, valid, min_v, max_v,
+                                    min_s, max_s, mid, jnp.asarray(stds),
+                                    rng_key, cfg, mesh, secure_tables),
+            retry, what="sharded aggregation dispatch")

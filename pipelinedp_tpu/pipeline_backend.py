@@ -394,6 +394,14 @@ class TPUBackend(LocalBackend):
             leave fewer live devices raise
             runtime.MeshDegradationError naming the job_id and journal
             path a resume needs, and health() reports FAILED.
+        trace: span-based pipeline tracing (runtime/trace.py). When
+            True, every run records nested, job-scoped spans (stage
+            phases, per-block dispatch/drain, reshard collectives with
+            byte counts, jit compile attribution) and instant events for
+            every runtime incident the counters record. Export with
+            dump_trace(path) (Chrome/Perfetto trace-event JSON) or read
+            trace_summary(). Off (the default) costs one bool check per
+            call site — the blocked-driver hot path is unaffected.
     """
 
     def __init__(self,
@@ -410,7 +418,8 @@ class TPUBackend(LocalBackend):
                  timeout_s: Optional[float] = None,
                  watchdog=None,
                  elastic: bool = False,
-                 min_devices: int = 1):
+                 min_devices: int = 1,
+                 trace: bool = False):
         super().__init__(seed=noise_seed)
         if reshard not in ("auto", "host", "device"):
             raise ValueError(
@@ -430,6 +439,7 @@ class TPUBackend(LocalBackend):
             input_validators.validate_watchdog(watchdog, "TPUBackend")
         input_validators.validate_elastic(elastic, "TPUBackend")
         input_validators.validate_min_devices(min_devices, "TPUBackend")
+        input_validators.validate_trace(trace, "TPUBackend")
         self.mesh = mesh
         self.max_partitions = max_partitions
         self.noise_seed = noise_seed
@@ -444,6 +454,10 @@ class TPUBackend(LocalBackend):
         self.watchdog = watchdog
         self.elastic = elastic
         self.min_devices = min_devices
+        self.trace = trace
+        if trace:
+            from pipelinedp_tpu.runtime import trace as rt_trace
+            rt_trace.enable()
         # Job ids whose health this backend's aggregations fed (the
         # executor records them as it resolves/derives them).
         self._health_jobs = set()
@@ -451,6 +465,22 @@ class TPUBackend(LocalBackend):
     @property
     def is_tpu(self) -> bool:
         return True
+
+    def dump_trace(self, path: str, job_id: Optional[str] = None) -> str:
+        """Writes the recorded trace as Chrome/Perfetto trace-event JSON
+        (load in ui.perfetto.dev or chrome://tracing). With a job_id,
+        only that job's events. Returns the path. Requires
+        TPUBackend(trace=True) (or runtime.trace.enable()) to have been
+        on while the runs of interest executed."""
+        from pipelinedp_tpu.runtime import trace as rt_trace
+        return rt_trace.dump(path, job_id=job_id)
+
+    def trace_summary(self, job_id: Optional[str] = None) -> dict:
+        """In-memory trace rollup: top spans by inclusive/exclusive wall
+        time, instant-event counts, transferred bytes and per-entry-point
+        jit compile stats — see runtime/trace.trace_summary."""
+        from pipelinedp_tpu.runtime import trace as rt_trace
+        return rt_trace.trace_summary(job_id=job_id)
 
     def health(self) -> dict:
         """Health snapshots of the jobs this backend has run (or, before

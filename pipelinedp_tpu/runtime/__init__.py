@@ -42,9 +42,20 @@ semantics:
     collective failures, slow blocks, bounded hangs, journal corruption)
     by schedule, used by the tests and the multichip dryrun to prove the
     above under adversity.
-  * telemetry — process-wide counters (retries, timeouts, degradations,
-    fallbacks, replays, quarantines) and per-phase timing stats recorded
-    into bench receipts.
+  * telemetry — a declared metrics registry (REGISTRY: name, kind, help
+    — record() validates against it) of process-wide counters (retries,
+    timeouts, degradations, fallbacks, replays, quarantines, budget
+    registrations, jit cache misses) and per-phase timing stats
+    recorded into bench receipts. reset() is a coordinated epoch reset:
+    counters, timings, job timings, trace buffers and per-job health
+    states clear together.
+  * trace — span-based pipeline tracing: nested thread- and job-scoped
+    spans (near-zero cost when disabled), instant events for every
+    counter incident, a jit compile/dispatch probe, Chrome/Perfetto
+    trace-event export (TPUBackend.dump_trace) and an in-memory
+    trace_summary (top spans by inclusive/exclusive wall time,
+    transferred bytes, compile seconds per entry point) — the layer
+    that attributes the kernel-vs-end-to-end throughput gap.
 
 The privacy invariants this package leans on are documented in README
 "Failure semantics": mechanisms register with the BudgetAccountant at
@@ -58,6 +69,7 @@ from pipelinedp_tpu.runtime import entry
 from pipelinedp_tpu.runtime import faults
 from pipelinedp_tpu.runtime import health
 from pipelinedp_tpu.runtime import telemetry
+from pipelinedp_tpu.runtime import trace
 from pipelinedp_tpu.runtime.health import HealthState, JobHealth
 from pipelinedp_tpu.runtime.journal import (BlockJournal,
                                             JournalCorruptionError)
@@ -86,4 +98,5 @@ __all__ = [
     "run_with_degradation",
     "run_with_mesh_degradation",
     "telemetry",
+    "trace",
 ]

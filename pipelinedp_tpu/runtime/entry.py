@@ -42,6 +42,7 @@ from pipelinedp_tpu import input_validators
 from pipelinedp_tpu.runtime import health as rt_health
 from pipelinedp_tpu.runtime import retry as rt_retry
 from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+from pipelinedp_tpu.runtime import trace as rt_trace
 from pipelinedp_tpu.runtime import watchdog as rt_watchdog
 
 
@@ -97,7 +98,8 @@ def runtime_entry(kind: str, fallback: Optional[Callable] = None):
                                     None)
             t0 = time.perf_counter()
             with rt_health.job_scope(job), rt_watchdog.activate(wd), \
-                    mesh_lib.fetch_retry_scope(fetch_retries):
+                    mesh_lib.fetch_retry_scope(fetch_retries), \
+                    rt_trace.span(kind, job=job):
                 if meshed and elastic:
                     result = rt_retry.run_with_mesh_degradation(
                         lambda m: fn(m, *args[1:], job_id=job, **kwargs),

@@ -145,18 +145,25 @@ class BlockJournal:
         # Atomic + durable write: fsync BEFORE the rename so a crash can
         # leave the old record or none — never a named-but-unflushed file
         # whose content is at the kernel's mercy — and never a truncated
-        # npz that poisons the resume.
-        fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                np.savez(f, **payload)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self._path(job_id, key))
-        except BaseException:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-            raise
+        # npz that poisons the resume. The span attributes the
+        # fsync-bound journal-write time (a real cost of journaled runs)
+        # on the trace timeline, with the payload byte volume.
+        from pipelinedp_tpu.runtime import trace as rt_trace
+        with rt_trace.span(
+                "journal.put", key=str(key),
+                bytes=int(sum(np.asarray(a).nbytes
+                              for a in payload.values()))):
+            fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    np.savez(f, **payload)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self._path(job_id, key))
+            except BaseException:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+                raise
         # Fault-injection hook: 'corrupt' faults damage the record that
         # was just durably written (bit-flip / truncation between write
         # and replay — the integrity machinery's test case).
@@ -222,9 +229,9 @@ class BlockJournal:
         # event on the job's registry entry directly instead.
         if rt_health.current() is None:
             with rt_health.track(rt_health.for_job(job_id)):
-                telemetry.record("journal_quarantined")
+                telemetry.record("journal_quarantined", key=str(key))
         else:
-            telemetry.record("journal_quarantined")
+            telemetry.record("journal_quarantined", key=str(key))
         logging.warning(
             "journal: record %s for job %r block %r failed integrity "
             "verification (%s: %s); quarantined to %s. The block will "

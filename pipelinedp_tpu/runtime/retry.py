@@ -220,8 +220,8 @@ def retry_call(fn: Callable,
             delay = policy.delay(attempt)
             attempt += 1
             if is_timeout(e):
-                telemetry.record("block_timeouts")
-            telemetry.record(counter)
+                telemetry.record("block_timeouts", block=block)
+            telemetry.record(counter, block=block, what=what)
             logging.warning(
                 "%s failed transiently at block %d (%s: %s); retry %d/%d "
                 "in %.2fs — the retried kernel re-derives the same block "
@@ -317,7 +317,8 @@ def run_with_degradation(run_range: Callable[[int, int, int, int], None],
             if capacity // 2 < min_block_partitions:
                 raise
             capacity //= 2
-            telemetry.record("block_oom_degradations")
+            telemetry.record("block_oom_degradations", block=e.block,
+                             capacity=capacity)
             logging.warning(
                 "block kernel OOM (or exhausted deadline) at partition "
                 "base %d; halving partition "

@@ -1,4 +1,4 @@
-"""Process-wide fault-tolerance counters and phase timings.
+"""Process-wide metrics registry, counters and phase timings.
 
 A flat Counter rather than per-run stats objects: the drivers that
 increment these live several layers below the entry points that want to
@@ -7,28 +7,12 @@ through every signature would couple all of them to the runtime. Counters
 are monotonically increasing per process; callers that want per-run deltas
 snapshot() before and after.
 
-Counter names used by the runtime:
-  block_retries            transient dispatch/sync failures retried
-  block_timeouts           blocks whose deadline expired (watchdog verdict
-                           or runtime DEADLINE_EXCEEDED surfaced)
-  block_oom_degradations   partition block capacity halvings after OOM
-                           (or after repeated deadline expiries)
-  reshard_host_fallbacks   device collective reshard -> host permutation
-  journal_replays          blocks served from the journal instead of
-                           re-dispatching
-  journal_quarantined      corrupt/truncated journal records renamed
-                           aside and never replayed
-  journal_compacted        superseded journal records dropped by
-                           BlockJournal.compact()
-  watchdog_timeouts        deadline expiries observed by the monitor
-  watchdog_late_completions guarded operations that completed after
-                           their deadline had already expired
-  host_fetch_retries       transient control-table fetch failures retried
-  device_losses            device-fatal failures observed (a chip
-                           dropped off the mesh)
-  mesh_degradations        elastic mesh rebuilds onto fewer devices
-                           after a device loss
-  injected_faults          faults raised by the injection harness
+Every counter is DECLARED in REGISTRY (name, kind, help text) and
+record() validates against it, so a typo'd counter name is a loud error
+at the increment site instead of a silently forked metric; the
+structural test in tests/test_trace.py greps the source tree to prove
+every recorded literal is declared and every declared counter is
+recorded somewhere. The full table is rendered in README "Observability".
 
 Timings (record_duration) aggregate per-phase wall time as
 (count, min, max, sum); the watchdog and the blocked drivers feed them
@@ -38,12 +22,79 @@ state machine (runtime/health.py) when one is tracked, and durations
 are ADDITIONALLY aggregated under the current job's id — the same
 job_scope discipline counter forwarding uses — so timing_snapshot(job)
 / job_timing_snapshot() report one job's phases without mixing in
-another job run in the same process.
+another job run in the same process. With tracing enabled
+(runtime/trace.py), every record() additionally lands as an instant
+event on the trace timeline, so runtime incidents (retries, timeouts,
+degradations, replays, device losses, budget registrations) appear in
+causal order between the spans they interrupted.
 """
 
 import collections
 import threading
-from typing import Dict
+from typing import Any, Dict
+
+from pipelinedp_tpu.runtime import trace
+
+Metric = collections.namedtuple("Metric", ["name", "kind", "help"])
+
+
+def _counter(name: str, help_text: str) -> Metric:
+    return Metric(name, "counter", help_text)
+
+
+# The declared metrics registry: every record() name must appear here.
+REGISTRY: Dict[str, Metric] = {
+    m.name: m
+    for m in (
+        _counter("block_retries",
+                 "transient dispatch/sync failures retried"),
+        _counter("block_timeouts",
+                 "blocks whose deadline expired (watchdog verdict or "
+                 "runtime DEADLINE_EXCEEDED surfaced)"),
+        _counter("block_oom_degradations",
+                 "partition block capacity halvings after OOM (or after "
+                 "repeated deadline expiries)"),
+        _counter("reshard_host_fallbacks",
+                 "device collective reshard -> host permutation"),
+        _counter("journal_replays",
+                 "blocks served from the journal instead of "
+                 "re-dispatching"),
+        _counter("journal_quarantined",
+                 "corrupt/truncated journal records renamed aside and "
+                 "never replayed"),
+        _counter("journal_compacted",
+                 "superseded journal records dropped by "
+                 "BlockJournal.compact()"),
+        _counter("watchdog_timeouts",
+                 "deadline expiries observed by the monitor"),
+        _counter("watchdog_late_completions",
+                 "guarded operations that completed after their deadline "
+                 "had already expired"),
+        _counter("host_fetch_retries",
+                 "transient control-table fetch failures retried"),
+        _counter("device_losses",
+                 "device-fatal failures observed (a chip dropped off the "
+                 "mesh)"),
+        _counter("mesh_degradations",
+                 "elastic mesh rebuilds onto fewer devices after a "
+                 "device loss"),
+        _counter("injected_faults",
+                 "faults raised by the injection harness"),
+        _counter("budget_registrations",
+                 "mechanisms registered with a BudgetAccountant ledger "
+                 "(graph-build time only; execution-time registrations "
+                 "are the double-spend bug no_new_mechanisms guards)"),
+        _counter("jit_cache_misses",
+                 "probed jit entry-point calls that compiled (grew the "
+                 "jit cache) instead of hitting it"),
+    )
+}
+
+
+def counter_names() -> "tuple[str, ...]":
+    """Declared counter names, for receipt builders that want them all."""
+    return tuple(m.name for m in REGISTRY.values() if m.kind == "counter")
+
 
 _lock = threading.Lock()
 counters: "collections.Counter[str]" = collections.Counter()
@@ -54,9 +105,23 @@ _timings: Dict[str, list] = {}
 _job_timings: Dict[str, Dict[str, list]] = {}
 
 
-def record(name: str, n: int = 1) -> None:
+def record(name: str, n: int = 1, **attrs) -> None:
+    """Increments a DECLARED counter (REGISTRY membership is enforced).
+
+    Extra keyword attributes (e.g. block=b) attach to the instant event
+    emitted on the trace timeline when tracing is enabled; they are not
+    stored in the counter itself.
+    """
+    if name not in REGISTRY:
+        raise ValueError(
+            f"telemetry.record({name!r}): not a declared metric. Declare "
+            f"it in telemetry.REGISTRY (name, kind, help) first — "
+            f"undeclared counters silently fork the metric namespace. "
+            f"Declared: {sorted(REGISTRY)}")
     with _lock:
         counters[name] += n
+    if trace.enabled():
+        trace.instant(name, **attrs)
     # Forward to the current job's health state machine (lazy import:
     # health imports telemetry for durations, so the top-level import
     # would be circular; the hook only fires on failure-path events).
@@ -78,7 +143,9 @@ def _fold_timing(store: Dict[str, list], name: str, seconds: float) -> None:
 def record_duration(name: str, seconds: float) -> None:
     """Aggregates one phase wall-time observation (min/max/sum/count),
     process-wide and under the current job's id (when a job_scope is
-    active) so per-job snapshots never mix two jobs' phases."""
+    active) so per-job snapshots never mix two jobs' phases. Timing
+    names are free-form (phases are dynamic: watchdog_<phase>, driver
+    kinds) — only counters validate against the registry."""
     seconds = float(seconds)
     from pipelinedp_tpu.runtime import health
     h = health.current()
@@ -121,27 +188,43 @@ def job_timing_snapshot() -> Dict[str, Dict[str, Dict[str, float]]]:
         return {job: _stats(store) for job, store in _job_timings.items()}
 
 
-def snapshot(timings: bool = False) -> Dict[str, int]:
-    """Counter values (plus, with timings=True, a nested "timings" key
-    holding the record_duration stats — leave False when the result is
-    fed to delta(), which subtracts integer counters only)."""
+def snapshot() -> Dict[str, int]:
+    """Counter values only — a flat {name: int} safe to feed delta()."""
     with _lock:
-        out = dict(counters)
-    if timings:
-        out["timings"] = timing_snapshot()
-    return out
+        return dict(counters)
+
+
+def full_snapshot() -> Dict[str, Any]:
+    """Counters AND timing stats in one structured snapshot:
+    {"counters": {name: int}, "timings": timing_snapshot(),
+    "job_timings": job_timing_snapshot()}. Use snapshot() when the
+    result feeds delta(), which subtracts integer counters only."""
+    return {
+        "counters": snapshot(),
+        "timings": timing_snapshot(),
+        "job_timings": job_timing_snapshot(),
+    }
 
 
 def delta(before: Dict[str, int]) -> Dict[str, int]:
     """Counter increments since a snapshot() (zero-valued keys omitted)."""
     now = snapshot()
-    keys = {k for k in set(now) | set(before) if k != "timings"}
-    out = {k: now.get(k, 0) - before.get(k, 0) for k in keys}
+    out = {k: now.get(k, 0) - before.get(k, 0)
+           for k in set(now) | set(before)}
     return {k: v for k, v in out.items() if v}
 
 
 def reset() -> None:
+    """Coordinated epoch reset: counters, timings, job timings, trace
+    buffers AND per-job health states clear together, so test isolation
+    and long-running processes can never mix epochs (a counter from one
+    epoch attributed to another job's health, or a stale trace buffer
+    leaking into the next run's export)."""
     with _lock:
         counters.clear()
         _timings.clear()
         _job_timings.clear()
+    # Lazy import: health imports telemetry at module load.
+    from pipelinedp_tpu.runtime import health
+    health.reset()
+    trace.reset()

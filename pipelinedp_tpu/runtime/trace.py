@@ -1,0 +1,374 @@
+"""Span-based pipeline tracing: where the wall clock of a run goes.
+
+The kernel headline (BENCH_r05_builder.json: 60.8M rec/s/chip) and the
+warm end-to-end number (292K rows/s) differ by ~200x, and until this
+module nothing in the repo could *prove where* the other ~199x goes:
+telemetry was a flat counter bag plus coarse min/max/sum phase timings —
+no causality, no per-block timeline, no transfer or compile attribution.
+This module turns every run into an exportable, attributable trace:
+
+  * **Spans** — ``with trace.span("drain", block=b):`` records one timed,
+    nested, thread- and job-scoped interval. Spans carry arbitrary
+    attributes (set at creation or via ``sp.set(bytes=n)`` on the yielded
+    token), nest naturally per thread, and self-account exclusive time
+    (inclusive minus the time spent in child spans) at close — so a
+    summary needs no tree reconstruction. When tracing is disabled,
+    ``span()`` returns a shared null token: one module-global bool check
+    and no allocation — near-zero cost on the hot block stream
+    (tests/test_trace.py guards the disabled overhead).
+  * **Instants** — ``trace.instant(name, **attrs)`` marks a point event.
+    telemetry.record() forwards every counter increment here, so every
+    runtime incident the counters already record (retry, timeout, OOM
+    degradation, journal replay/quarantine, device loss, mesh rebuild,
+    budget registration) lands on the timeline automatically.
+  * **jit probe** — ``probe_jit(name, jitted_fn)`` wraps a jit entry
+    point: each traced call records a ``jit:<name>`` span, and a call
+    that grows the jit cache is counted as a compile (cache miss) with
+    its wall seconds attributed to that entry point — the
+    dispatch-vs-compile attribution the device-resident-pipeline
+    refactor will be judged against.
+  * **Export** — ``dump(path)`` writes Chrome/Perfetto trace-event JSON
+    (load in ui.perfetto.dev or chrome://tracing); ``trace_summary()``
+    returns the in-memory rollup: top spans by inclusive/exclusive wall
+    time, instant counts, transferred bytes (the sum of ``bytes=`` span
+    attributes — host_fetch and the reshard staging set them) and
+    per-entry-point compile stats. Both reach operators through
+    ``TPUBackend.dump_trace(path)`` / ``TPUBackend.trace_summary()`` and
+    the bench receipt's ``e2e_phase_breakdown`` / ``trace_summary`` keys.
+
+Epoch discipline: buffers are process-wide and bounded (``buffer_limit``
+events; excess events are counted in ``dropped_events``, never silently
+lost). telemetry.reset() clears them together with counters, timings and
+health states so long-running processes and tests cannot mix epochs.
+"""
+
+import contextlib
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+# Module-global fast path: span()/instant() check this one bool before
+# doing anything else, so disabled tracing costs a dict-free function
+# call per call site and nothing more.
+_enabled = False
+
+_lock = threading.Lock()
+_events: list = []
+_buffer_limit = 1_000_000
+_dropped = 0
+_t0 = time.perf_counter()
+_PID = os.getpid()
+# entry point -> [cache misses, compile seconds] (probe_jit).
+_compile: Dict[str, list] = {}
+
+_local = threading.local()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(buffer_limit: int = 1_000_000) -> None:
+    """Turns span/instant recording on (process-wide)."""
+    global _enabled, _buffer_limit, _t0
+    with _lock:
+        _buffer_limit = int(buffer_limit)
+        if not _events:
+            _t0 = time.perf_counter()
+    _enabled = True
+
+
+def disable() -> None:
+    """Stops recording; buffered events stay exportable until reset()."""
+    global _enabled
+    _enabled = False
+
+
+def reset() -> None:
+    """Drops all buffered events and compile stats (epoch boundary).
+
+    Called by telemetry.reset() so one coordinated reset clears counters,
+    timings, health states and trace buffers together.
+    """
+    global _dropped, _t0
+    with _lock:
+        _events.clear()
+        _compile.clear()
+        _dropped = 0
+        _t0 = time.perf_counter()
+
+
+def _current_job() -> Optional[str]:
+    # Lazy import: health -> telemetry -> trace is the module order; the
+    # reverse edge must not run at import time.
+    from pipelinedp_tpu.runtime import health
+    h = health.current()
+    return h.job_id if h is not None else None
+
+
+def _append(event: tuple) -> None:
+    global _dropped
+    with _lock:
+        if len(_events) >= _buffer_limit:
+            _dropped += 1
+            return
+        _events.append(event)
+
+
+class _NullSpan:
+    """Shared no-op token returned while tracing is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One open span on the current thread (returned by span())."""
+
+    __slots__ = ("name", "attrs", "_start", "_child_s", "_job", "_tid")
+
+    def __init__(self, name: str, attrs: Optional[dict]):
+        self.name = name
+        self.attrs = attrs or None
+
+    def set(self, **attrs) -> None:
+        """Attaches/overwrites attributes on the open span (e.g. a byte
+        count known only once the transfer finished)."""
+        if self.attrs is None:
+            self.attrs = attrs
+        else:
+            self.attrs.update(attrs)
+
+    def __enter__(self):
+        stack = getattr(_local, "stack", None)
+        if stack is None:
+            stack = _local.stack = []
+        self._job = _current_job()
+        self._tid = threading.get_ident()
+        self._child_s = 0.0
+        stack.append(self)
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._start
+        stack = getattr(_local, "stack", None)
+        if stack and stack[-1] is self:
+            stack.pop()
+        if stack:
+            stack[-1]._child_s += dur
+        exclusive = max(dur - self._child_s, 0.0)
+        _append(("X", self.name, self._tid, self._job,
+                 self._start, dur, exclusive, self.attrs))
+        return False
+
+
+def span(name: str, **attrs):
+    """Context manager timing one nested, attributed interval.
+
+    ``with trace.span("drain", block=b, rows=n) as sp: ...`` — the token
+    supports ``sp.set(**attrs)`` for values known only at close. Returns
+    a shared no-op token when tracing is disabled.
+    """
+    if not _enabled:
+        return _NULL_SPAN
+    return _Span(name, attrs or None)
+
+
+def instant(name: str, **attrs) -> None:
+    """Records a point event (a runtime incident) on the timeline."""
+    if not _enabled:
+        return
+    _append(("i", name, threading.get_ident(), _current_job(),
+             time.perf_counter(), attrs or None))
+
+
+def probe_jit(name: str, fn):
+    """Wraps a jitted entry point with dispatch/compile attribution.
+
+    Traced calls record a ``jit:<name>`` span; a call that grew the jit
+    cache is a compile (cache miss): its wall seconds accumulate under
+    `name` in compile_stats(), a ``jit_compile:<name>`` instant lands on
+    the timeline, and the ``jit_cache_misses`` telemetry counter
+    increments. With tracing disabled the wrapper is one bool check and
+    a tail call. The underlying jit attributes (clear_cache, lower,
+    _cache_size) are re-exposed on the wrapper.
+    """
+    cache_size = getattr(fn, "_cache_size", None)
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if not _enabled:
+            return fn(*args, **kwargs)
+        before = cache_size() if cache_size is not None else -1
+        start = time.perf_counter()
+        with span("jit:" + name):
+            out = fn(*args, **kwargs)
+        if cache_size is not None and cache_size() > before:
+            dt = time.perf_counter() - start
+            with _lock:
+                entry = _compile.setdefault(name, [0, 0.0])
+                entry[0] += 1
+                entry[1] += dt
+            instant("jit_compile:" + name, seconds=round(dt, 6))
+            from pipelinedp_tpu.runtime import telemetry
+            telemetry.record("jit_cache_misses")
+        return out
+
+    for attr in ("_cache_size", "clear_cache", "lower"):
+        if hasattr(fn, attr):
+            setattr(wrapper, attr, getattr(fn, attr))
+    return wrapper
+
+
+def compile_stats() -> Dict[str, Dict[str, float]]:
+    """{entry point: {"misses": n, "compile_s": seconds}} from probe_jit."""
+    with _lock:
+        return {
+            name: {"misses": entry[0], "compile_s": round(entry[1], 6)}
+            for name, entry in _compile.items()
+        }
+
+
+def _snapshot_events(job_id: Optional[str] = None) -> list:
+    with _lock:
+        events = list(_events)
+    if job_id is None:
+        return events
+    return [ev for ev in events if ev[3] == job_id]
+
+
+def trace_summary(job_id: Optional[str] = None) -> Dict[str, Any]:
+    """In-memory rollup: top spans by inclusive/exclusive wall time.
+
+    Returns {"spans": {name: {count, inclusive_s, exclusive_s, max_s}}
+    ordered by inclusive time descending, "instants": {name: count},
+    "transfer_bytes": total of ``bytes=`` attributes, "compile":
+    compile_stats(), "n_events", "dropped_events"}. With a job_id, only
+    events recorded while that job's scope was current.
+    """
+    spans: Dict[str, list] = {}
+    instants: Dict[str, int] = {}
+    transfer_bytes = 0
+    events = _snapshot_events(job_id)
+    for ev in events:
+        if ev[0] == "X":
+            _, name, _tid, _job, _start, dur, excl, attrs = ev
+            entry = spans.setdefault(name, [0, 0.0, 0.0, 0.0])
+            entry[0] += 1
+            entry[1] += dur
+            entry[2] += excl
+            entry[3] = max(entry[3], dur)
+        else:
+            _, name, _tid, _job, _ts, attrs = ev
+            instants[name] = instants.get(name, 0) + 1
+        if attrs and isinstance(attrs.get("bytes"), int):
+            transfer_bytes += attrs["bytes"]
+    ordered = dict(
+        sorted(spans.items(), key=lambda kv: -kv[1][1]))
+    with _lock:
+        dropped = _dropped
+    return {
+        "spans": {
+            name: {
+                "count": entry[0],
+                "inclusive_s": round(entry[1], 6),
+                "exclusive_s": round(entry[2], 6),
+                "max_s": round(entry[3], 6),
+            }
+            for name, entry in ordered.items()
+        },
+        "instants": dict(sorted(instants.items())),
+        "transfer_bytes": transfer_bytes,
+        "compile": compile_stats(),
+        "n_events": len(events),
+        "dropped_events": dropped,
+    }
+
+
+def to_trace_events(job_id: Optional[str] = None) -> Dict[str, Any]:
+    """The buffered events as a Chrome/Perfetto trace-event JSON object
+    ({"traceEvents": [...], "displayTimeUnit": "ms"})."""
+    out = [{
+        "name": "process_name",
+        "ph": "M",
+        "pid": _PID,
+        "tid": 0,
+        "ts": 0,
+        "args": {"name": "pipelinedp-tpu"},
+    }]
+    for ev in _snapshot_events(job_id):
+        if ev[0] == "X":
+            _, name, tid, job, start, dur, excl, attrs = ev
+            args = dict(attrs) if attrs else {}
+            if job is not None:
+                args["job"] = job
+            args["exclusive_us"] = round(excl * 1e6, 3)
+            out.append({
+                "name": name,
+                "cat": "span",
+                "ph": "X",
+                "pid": _PID,
+                "tid": tid,
+                "ts": round((start - _t0) * 1e6, 3),
+                "dur": round(dur * 1e6, 3),
+                "args": args,
+            })
+        else:
+            _, name, tid, job, ts, attrs = ev
+            args = dict(attrs) if attrs else {}
+            if job is not None:
+                args["job"] = job
+            out.append({
+                "name": name,
+                "cat": "instant",
+                "ph": "i",
+                "s": "t",
+                "pid": _PID,
+                "tid": tid,
+                "ts": round((ts - _t0) * 1e6, 3),
+                "args": args,
+            })
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def dump(path: str, job_id: Optional[str] = None) -> str:
+    """Writes the buffered trace as Chrome/Perfetto trace-event JSON.
+
+    Load the file in ui.perfetto.dev or chrome://tracing. Returns the
+    path. Atomic (write-then-rename) so a crash mid-dump never leaves a
+    half-written file where a trace was expected.
+    """
+    payload = to_trace_events(job_id)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    return path
+
+
+@contextlib.contextmanager
+def scoped(buffer_limit: int = 1_000_000):
+    """Enables tracing for the scope, restoring the prior state on exit
+    (the dryrun/tests convenience; buffers are NOT cleared on exit)."""
+    was = _enabled
+    enable(buffer_limit)
+    try:
+        yield
+    finally:
+        if not was:
+            disable()

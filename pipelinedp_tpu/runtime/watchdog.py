@@ -212,9 +212,14 @@ class Watchdog:
 
     def beat(self, phase: str = "") -> None:
         """Heartbeat from an unguarded step (e.g. host_fetch): updates the
-        liveness timestamp surfaced in health snapshots."""
+        liveness timestamp surfaced in health snapshots. With tracing
+        enabled the beat also lands as an instant on the trace timeline,
+        so the per-block spans interleave with the liveness signal."""
         from pipelinedp_tpu.runtime import health as rt_health
+        from pipelinedp_tpu.runtime import trace as rt_trace
         self._last_beat = (phase, time.monotonic())
+        if rt_trace.enabled():
+            rt_trace.instant("heartbeat", phase=phase)
         h = rt_health.current()
         if h is not None:
             h.beat()

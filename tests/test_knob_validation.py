@@ -31,6 +31,7 @@ KNOB_VALIDATORS = {
     "elastic": "validate_elastic",
     "min_devices": "validate_min_devices",
     "job_id": "validate_job_id",
+    "trace": "validate_trace",
 }
 
 # Data-plane parameters: configuration, not failure semantics — adding

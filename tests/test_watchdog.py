@@ -157,7 +157,7 @@ class TestTelemetryTiming:
     def test_min_max_sum_count(self):
         telemetry.record_duration("phase_x", 0.5)
         telemetry.record_duration("phase_x", 1.5)
-        snap = telemetry.snapshot(timings=True)["timings"]["phase_x"]
+        snap = telemetry.full_snapshot()["timings"]["phase_x"]
         assert snap["count"] == 2
         assert snap["min"] == pytest.approx(0.5)
         assert snap["max"] == pytest.approx(1.5)
