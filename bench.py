@@ -771,6 +771,22 @@ def main():
         }
         for job, snap in rt_health.snapshot_all().items()
     }
+    # Static-analysis gate state rides along with the perf numbers: the
+    # finding count + rule version in every receipt means a lint
+    # regression (or a rule-set change that re-opens triage) shows up
+    # next to the throughput it ships with.
+    try:
+        from pipelinedp_tpu import staticcheck as sc
+        _sc_analysis, sc_active, sc_baselined, sc_stale, _sc_mods = \
+            sc.run_tree()
+        staticcheck_detail = {
+            "findings": len(sc_active),
+            "baselined": len(sc_baselined),
+            "stale_baseline_entries": len(sc_stale),
+            "rules_version": sc.RULES_VERSION,
+        }
+    except Exception as e:  # noqa: BLE001 - the receipt must survive analyzer breakage; tests/test_staticcheck.py owns failing on it
+        staticcheck_detail = {"error": f"{type(e).__name__}: {e}"}
     builder_receipt = _builder_receipt_summary() if fallback else None
     print(
         json.dumps({
@@ -799,6 +815,7 @@ def main():
                 "runtime_fault_counters": fault_counters,
                 "runtime_phase_timings": phase_timings,
                 "runtime_job_health": job_health,
+                "staticcheck": staticcheck_detail,
                 **({"device_fallback": fallback} if fallback else {}),
                 # CPU-fallback runs carry the newest committed device
                 # evidence so a tunnel-dropped driver round still shows it.

@@ -27,6 +27,7 @@ import numpy as np
 from pipelinedp_tpu import aggregate_params as agg
 from pipelinedp_tpu.analysis import error_model as em
 from pipelinedp_tpu.ops import selection_ops
+from pipelinedp_tpu.runtime import trace as rt_trace
 
 
 def _generate_bucket_bounds() -> Tuple[int, ...]:
@@ -357,6 +358,12 @@ def sweep_kernel(counts,
         result["stats"] = unchunk(outs[2])
         result["keep_prob"] = unchunk(outs[3])
     return result
+
+
+# Compile/dispatch attribution (runtime/trace.probe_jit, enforced by
+# staticcheck's jit-boundary rule): sweep compiles are real wall time in
+# utility-analysis runs and must show up in the e2e gap accounting.
+sweep_kernel = rt_trace.probe_jit("sweep_kernel", sweep_kernel)
 
 
 def sharded_sweep(mesh,

@@ -78,7 +78,7 @@ def _canonical_key(key):
     try:
         if key != key:  # NaN is the only self-unequal value
             return _NAN_KEY
-    except Exception:  # exotic __ne__ — ordinary key
+    except Exception:  # noqa: BLE001 - exotic user __ne__ may raise anything; treat as an ordinary (non-NaN) key
         pass
     return key
 

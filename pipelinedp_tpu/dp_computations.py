@@ -26,14 +26,29 @@ from pipelinedp_tpu import aggregate_params
 from pipelinedp_tpu import budget_accounting
 from pipelinedp_tpu.aggregate_params import NoiseKind, NormKind
 
-# Module-level RNG for host-side mechanisms. Seedable for tests.
-_rng = np.random.default_rng()
+# Host-side mechanism RNG: created lazily with explicit entropy, never a
+# module-import side effect — staticcheck's host-rng rule forbids
+# module-global default_rng() instances because their seed is
+# unobservable (a resumed job could not replay the same release and
+# nothing would say so). Seedable AND injectable for tests.
+_rng: Optional[np.random.Generator] = None
 
 
-def seed_mechanism_rng(seed: Optional[int]) -> None:
-    """Seeds the host-side mechanism RNG (tests / reproducibility)."""
+def seed_mechanism_rng(
+        seed: "Union[None, int, np.random.Generator]") -> None:
+    """Seeds (or injects) the host-side mechanism RNG."""
     global _rng
-    _rng = np.random.default_rng(seed)
+    _rng = (seed if isinstance(seed, np.random.Generator) else
+            np.random.default_rng(seed))
+
+
+def mechanism_rng() -> np.random.Generator:
+    """The host-side mechanism generator, created on first use from an
+    explicit fresh SeedSequence when no seed was injected."""
+    global _rng
+    if _rng is None:
+        _rng = np.random.default_rng(np.random.SeedSequence())
+    return _rng
 
 
 # Secure-noise mode: host-side mechanisms sample snapped discrete noise from
@@ -185,7 +200,7 @@ def apply_laplace_mechanism(value: float, eps: float, l1_sensitivity: float):
         return float(
             native.secure_laplace_add(np.asarray([float(value)]),
                                       l1_sensitivity / eps)[0])
-    return value + _rng.laplace(0, l1_sensitivity / eps)
+    return value + mechanism_rng().laplace(0, l1_sensitivity / eps)
 
 
 def apply_gaussian_mechanism(value: float, eps: float, delta: float,
@@ -196,7 +211,7 @@ def apply_gaussian_mechanism(value: float, eps: float, delta: float,
         from pipelinedp_tpu import native
         return float(
             native.secure_gaussian_add(np.asarray([float(value)]), sigma)[0])
-    return value + _rng.normal(0, sigma)
+    return value + mechanism_rng().normal(0, sigma)
 
 
 def _add_random_noise(value: float, eps: float, delta: float,
@@ -476,7 +491,7 @@ class LaplaceMechanism(AdditiveMechanism):
             return float(
                 native.secure_laplace_add(np.asarray([float(value)]),
                                           self.noise_parameter)[0])
-        return float(value) + _rng.laplace(0, self.noise_parameter)
+        return float(value) + mechanism_rng().laplace(0, self.noise_parameter)
 
     @property
     def epsilon(self) -> float:
@@ -538,7 +553,7 @@ class GaussianMechanism(AdditiveMechanism):
             return float(
                 native.secure_gaussian_add(np.asarray([float(value)]),
                                            self._sigma)[0])
-        return float(value) + _rng.normal(0, self._sigma)
+        return float(value) + mechanism_rng().normal(0, self._sigma)
 
     @property
     def epsilon(self) -> float:
@@ -726,7 +741,7 @@ class ExponentialMechanism:
         precomputed (vectorized) scores for all inputs; otherwise score()
         is called per input."""
         probs = self._calculate_probabilities(eps, inputs_to_score_col, scores)
-        index = _rng.choice(len(inputs_to_score_col), p=probs)
+        index = mechanism_rng().choice(len(inputs_to_score_col), p=probs)
         return inputs_to_score_col[index]
 
     def _calculate_probabilities(self,

@@ -523,7 +523,7 @@ def _is_falsey_local(col) -> bool:
     # local list/tuple emptiness is checked.
     try:
         return not col
-    except Exception:
+    except Exception:  # noqa: BLE001 - truthiness probe: distributed collections may raise anything from __bool__; non-local input is simply not length-checkable
         return False
 
 

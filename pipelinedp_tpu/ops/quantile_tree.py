@@ -75,7 +75,7 @@ class DenseQuantileTree:
         if counts is None:
             self.counts = np.zeros(self.n_nodes, dtype=np.float64)
         else:
-            counts = np.asarray(counts, dtype=np.float64)
+            counts = np.asarray(counts, dtype=np.float64)  # staticcheck: disable=host-transfer — host-side tree constructor; input is host numpy, O(n_nodes)
             if counts.shape != (self.n_nodes,):
                 raise ValueError(
                     f"counts must have shape ({self.n_nodes},)")
@@ -101,7 +101,7 @@ class DenseQuantileTree:
 
     def add_entries(self, values) -> None:
         """Vectorized bulk insert."""
-        values = np.asarray(values, dtype=np.float64)
+        values = np.asarray(values, dtype=np.float64)  # staticcheck: disable=host-transfer — host-side tree insert; values are host numpy, never traced
         if values.size == 0:
             return
         frac = (values - self.min_value) / (self.max_value - self.min_value)

@@ -114,7 +114,7 @@ def build_table(std: float, noise_kind: NoiseKind,
 def build_tables(stds, noise_kind: NoiseKind,
                  max_atoms: int = DEFAULT_MAX_ATOMS, sensitivities=None):
     """Stacked tables for all noise slots: (S, 2K+1) u32 x2 and (S,) f32."""
-    stds = np.asarray(stds, dtype=np.float64)
+    stds = np.asarray(stds, dtype=np.float64)  # staticcheck: disable=host-transfer — graph-build-time table construction on host scalars, O(slots)
     if sensitivities is None:
         sensitivities = [None] * len(stds)
     his, los, grans = [], [], []
@@ -125,7 +125,7 @@ def build_tables(stds, noise_kind: NoiseKind,
         los.append(lo)
         grans.append(g)
     return (np.stack(his), np.stack(los),
-            np.asarray(grans, dtype=np.float64))
+            np.asarray(grans, dtype=np.float64))  # staticcheck: disable=host-transfer — graph-build-time granularity vector, O(slots) host floats
 
 
 def _lex_search(thr_hi: jnp.ndarray, thr_lo: jnp.ndarray, uhi: jnp.ndarray,

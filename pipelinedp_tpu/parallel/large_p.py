@@ -370,7 +370,7 @@ def _dispatch_blocks(block_iter, consume,
     def consume_or_oom(b, entry, make):
         try:
             consume_one(b, entry, make)
-        except Exception as err:
+        except Exception as err:  # noqa: BLE001 - classified below: degradable (OOM/timeout) converts to BlockOOMError, the rest re-raise
             if make is not None and _degradable(err):
                 raise rt_retry.BlockOOMError(b, err) from err
             raise
@@ -385,7 +385,7 @@ def _dispatch_blocks(block_iter, consume,
             n_dispatched += 1
             try:
                 result = start(b, entry)
-            except Exception as err:
+            except Exception as err:  # noqa: BLE001 - classified below after the in-flight drain: degradable -> BlockOOMError, the rest re-raise
                 # Drain the earlier in-flight blocks first: their results
                 # (and journal records) must survive the abort so a
                 # degradation or resume continues from this block, not

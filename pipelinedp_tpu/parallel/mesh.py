@@ -42,7 +42,7 @@ def make_mesh(devices: Optional[Sequence] = None,
         devices = jax.devices()
         if n_devices is not None:
             devices = devices[:n_devices]
-    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))  # staticcheck: disable=host-transfer — O(D) device HANDLES at mesh build, not array data
 
 
 def probe_live_devices(devices: Sequence) -> List:
@@ -134,7 +134,7 @@ _DEFAULT_FETCH_RETRIES = 2
 # every host at once; a pure 0.05 * 2**attempt schedule would re-collide
 # all of them on the exact same instant, so each delay is scaled by an
 # independent uniform [0.5, 1) draw.
-_jitter = random.Random()
+_jitter = random.Random()  # staticcheck: disable=host-rng — backoff jitter only: per-process independent seeding is the POINT (de-collides multi-host retries); never touches DP noise or sampling
 
 
 @contextlib.contextmanager

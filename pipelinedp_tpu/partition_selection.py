@@ -36,12 +36,23 @@ from scipy import special
 from pipelinedp_tpu.aggregate_params import PartitionSelectionStrategy
 from pipelinedp_tpu import dp_computations
 
-_rng = np.random.default_rng()
+# Lazily created with explicit entropy (staticcheck host-rng: no
+# module-global RNG instances — the seed must be observable/injectable).
+_rng: Optional[np.random.Generator] = None
 
 
-def seed_selection_rng(seed: Optional[int]) -> None:
+def seed_selection_rng(seed) -> None:
+    """Seeds (or injects a np.random.Generator as) the selection RNG."""
     global _rng
-    _rng = np.random.default_rng(seed)
+    _rng = (seed if isinstance(seed, np.random.Generator) else
+            np.random.default_rng(seed))
+
+
+def selection_rng() -> np.random.Generator:
+    global _rng
+    if _rng is None:
+        _rng = np.random.default_rng(np.random.SeedSequence())
+    return _rng
 
 
 class PartitionSelector(abc.ABC):
@@ -103,7 +114,8 @@ class PartitionSelector(abc.ABC):
 
     def should_keep(self, num_privacy_ids: int) -> bool:
         """Samples the DP keep decision."""
-        return bool(_rng.uniform() < self.probability_of_keep(num_privacy_ids))
+        return bool(selection_rng().uniform() <
+                    self.probability_of_keep(num_privacy_ids))
 
     @abc.abstractmethod
     def _probability_of_keep_shifted(self, n: np.ndarray) -> np.ndarray:

@@ -32,6 +32,7 @@ import numpy as np
 
 from pipelinedp_tpu import combiners as dp_combiners
 from pipelinedp_tpu import input_validators
+from pipelinedp_tpu import sampling_utils
 
 try:
     import apache_beam as beam
@@ -796,7 +797,8 @@ if pyspark is not None:
         def sample_fixed_per_key(self, col, n, stage_name=None):
             # Uniformity caveat matches the reference (:446-449).
             return col.groupByKey().mapValues(
-                lambda vals: random.sample(list(vals), min(n, len(list(vals)))))
+                lambda vals: sampling_utils.
+                choose_from_list_without_replacement(list(vals), n))
 
         def count_per_element(self, col, stage_name=None):
             return col.map(lambda x: (x, 1)).reduceByKey(operator.add)

@@ -68,7 +68,7 @@ class ExplainComputationReport:
                              " passed as an argument to DP aggregation method?")
         try:
             return self._report_generator.report()
-        except Exception as e:
+        except Exception as e:  # noqa: BLE001 - wrap-and-reraise: any stage-formatting failure becomes one actionable ValueError
             raise ValueError(
                 "Explain computation report failed to be generated.\n"
                 "Was BudgetAccountant.compute_budgets() called?") from e

@@ -1,0 +1,46 @@
+"""Lock-discipline declarations read by the static analyzer.
+
+Five runtime modules share mutable state across the driver, watchdog
+monitor and test threads. The locking is easy to get right at write time
+and easy to break in review — a new method that touches ``self._mem``
+without taking ``self._lock`` is a silent data race, not a test failure.
+``guarded_by`` makes the discipline *declared*: a module or class states
+which attributes a lock guards, and ``pipelinedp_tpu.staticcheck``'s
+``lock-discipline`` rule proves every access happens inside
+``with <lock>:`` (``__init__`` and module-scope initialization are
+exempt — construction happens-before publication).
+
+Class form (instance attributes guarded by an instance lock)::
+
+    class BlockJournal:
+        _GUARDED_BY = guarded_by("_lock", "_mem")
+
+Module form (globals guarded by a module-global lock)::
+
+    _GUARDED_BY = guarded_by("_lock", "counters", "_timings")
+
+A method that is documented as "caller holds the lock" carries an inline
+suppression on its ``def`` line::
+
+    def _escalate(self, ...):  # staticcheck: disable=lock-discipline — caller holds self._lock
+
+Deliberately lock-free attributes (single-writer monotonic publishes like
+``trace._enabled``) are simply not declared; the declaration is the
+contract.
+"""
+
+from typing import Tuple
+
+
+def guarded_by(lock: str, *attrs: str) -> Tuple[str, Tuple[str, ...]]:
+    """Declares that ``attrs`` may only be touched under ``with <lock>:``.
+
+    Returns the declaration as data so the convention is greppable at
+    runtime too; the enforcement happens statically (staticcheck's
+    ``lock-discipline`` rule parses the call, it never imports the
+    module).
+    """
+    if not attrs:
+        raise ValueError("guarded_by(lock, *attrs): declare at least one "
+                         "guarded attribute")
+    return (lock, attrs)

@@ -42,6 +42,7 @@ import time
 from typing import Dict, Optional
 
 from pipelinedp_tpu.runtime import telemetry
+from pipelinedp_tpu.runtime.concurrency import guarded_by
 
 
 class HealthState(enum.IntEnum):
@@ -74,6 +75,14 @@ _TRACKED_COUNTERS = (_DEGRADING_COUNTERS | _STALLING_COUNTERS |
 class JobHealth:
     """Thread-safe health record of one job (keyed by journal job_id)."""
 
+    # Written by the driver thread, the watchdog monitor (note_timeout)
+    # and telemetry forwarding; read by snapshot builders. staticcheck's
+    # lock-discipline rule enforces the declaration.
+    _GUARDED_BY = guarded_by("_lock", "_state", "_counters",
+                             "_phase_seconds", "_last_error", "_last_beat",
+                             "_planned_devices", "_live_devices",
+                             "_completed_runs")
+
     def __init__(self, job_id: str):
         self.job_id = job_id
         self._lock = threading.Lock()
@@ -92,7 +101,7 @@ class JobHealth:
 
     # -- event intake ----------------------------------------------------
 
-    def _escalate(self, state: HealthState) -> None:
+    def _escalate(self, state: HealthState) -> None:  # staticcheck: disable=lock-discipline — caller holds self._lock (observe_counter/note_timeout/note_mesh)
         if self._state is not HealthState.FAILED and state > self._state:
             self._state = state
 
@@ -155,7 +164,10 @@ class JobHealth:
                 self._state = HealthState.DEGRADED
 
     def beat(self) -> None:
-        self._last_beat = time.monotonic()
+        # Shares _last_beat with snapshot() readers on other threads —
+        # a finding the lock-discipline rule surfaced on its first run.
+        with self._lock:
+            self._last_beat = time.monotonic()
 
     # -- queries ---------------------------------------------------------
 
@@ -190,6 +202,7 @@ class JobHealth:
 _registry_lock = threading.Lock()
 _registry: Dict[str, JobHealth] = {}
 _current = threading.local()
+_GUARDED_BY = guarded_by("_registry_lock", "_registry")
 
 
 def for_job(job_id: str) -> JobHealth:

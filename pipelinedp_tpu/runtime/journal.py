@@ -40,10 +40,13 @@ import logging
 import os
 import re
 import tempfile
+import threading
 import zlib
 from typing import Dict, Iterable, Optional, Tuple
 
 import numpy as np
+
+from pipelinedp_tpu.runtime.concurrency import guarded_by
 
 _OUT_PREFIX = "out__"
 _CRC_KEY = "__crc32__"
@@ -95,10 +98,18 @@ class BlockJournal:
 
     Single-writer per (directory, job_id): the crash-recovery sweep and
     compact() assume no concurrent process is mid-write in the same
-    directory.
+    directory. WITHIN a process the in-memory cache is shared between
+    the driver thread and late watchdog completions, so `_mem` is
+    lock-guarded (file I/O happens outside the lock — the atomic-rename
+    discipline already serializes the directory).
     """
 
+    # Enforced by staticcheck's lock-discipline rule; `_dir` is
+    # immutable after construction and stays undeclared.
+    _GUARDED_BY = guarded_by("_lock", "_mem")
+
     def __init__(self, directory: Optional[str] = None):
+        self._lock = threading.Lock()
         self._mem: Dict[Tuple[str, str], BlockRecord] = {}
         self._dir = directory
         if directory is not None:
@@ -135,7 +146,8 @@ class BlockJournal:
         return os.path.join(self._dir, f"{_safe(job_id)}__{_safe(key)}.npz")
 
     def put(self, job_id: str, key: str, record: BlockRecord) -> None:
-        self._mem[(job_id, key)] = record
+        with self._lock:
+            self._mem[(job_id, key)] = record
         if self._dir is None:
             return
         payload = {"ids": record.ids}
@@ -241,7 +253,8 @@ class BlockJournal:
             str(error).splitlines()[0][:200], quarantine)
 
     def get(self, job_id: str, key: str) -> Optional[BlockRecord]:
-        record = self._mem.get((job_id, key))
+        with self._lock:
+            record = self._mem.get((job_id, key))
         if record is not None or self._dir is None:
             return record
         path = self._path(job_id, key)
@@ -256,14 +269,16 @@ class BlockJournal:
             # thing: this record cannot be trusted as released truth.
             self._quarantine(job_id, key, path, e)
             return None
-        self._mem[(job_id, key)] = record
+        with self._lock:
+            self._mem[(job_id, key)] = record
         return record
 
     def keys(self, job_id: str) -> Iterable[str]:
         """Block keys recorded for a job (memory + directory; disk-only
         records surface under their sanitized file-name form, which get()
         resolves to the same file)."""
-        mem = {key for jid, key in self._mem if jid == job_id}
+        with self._lock:
+            mem = {key for jid, key in self._mem if jid == job_id}
         keys = set(mem)
         if self._dir is not None:
             sanitized_mem = {_safe(key) for key in mem}
@@ -328,11 +343,12 @@ class BlockJournal:
         return dropped
 
     def _drop(self, job_id: str, key: str) -> None:
-        self._mem.pop((job_id, key), None)
-        # The sanitized forms of the raw and disk-listed key spellings
-        # land on the same file.
-        for variant in {key, key.replace("_", ":", 1)}:
-            self._mem.pop((job_id, variant), None)
+        with self._lock:
+            self._mem.pop((job_id, key), None)
+            # The sanitized forms of the raw and disk-listed key
+            # spellings land on the same file.
+            for variant in {key, key.replace("_", ":", 1)}:
+                self._mem.pop((job_id, variant), None)
         if self._dir is not None:
             path = self._path(job_id, key)
             if os.path.exists(path):
@@ -340,9 +356,10 @@ class BlockJournal:
 
     def clear(self, job_id: Optional[str] = None) -> None:
         """Drops records — all of them, or one job's."""
-        for jid, key in list(self._mem):
-            if job_id is None or jid == job_id:
-                del self._mem[(jid, key)]
+        with self._lock:
+            for jid, key in list(self._mem):
+                if job_id is None or jid == job_id:
+                    del self._mem[(jid, key)]
         if self._dir is None:
             return
         prefix = None if job_id is None else _safe(job_id) + "__"

@@ -34,6 +34,7 @@ import threading
 from typing import Any, Dict
 
 from pipelinedp_tpu.runtime import trace
+from pipelinedp_tpu.runtime.concurrency import guarded_by
 
 Metric = collections.namedtuple("Metric", ["name", "kind", "help"])
 
@@ -103,6 +104,10 @@ _timings: Dict[str, list] = {}
 # job_id -> {name -> [count, min, max, sum]}: the same stats scoped to
 # the job that was current (health.job_scope) when they were recorded.
 _job_timings: Dict[str, Dict[str, list]] = {}
+# Drivers record from worker threads while the watchdog monitor and
+# receipt builders read; staticcheck's lock-discipline rule enforces the
+# declaration (readers use snapshot()/delta(), never the bare maps).
+_GUARDED_BY = guarded_by("_lock", "counters", "_timings", "_job_timings")
 
 
 def record(name: str, n: int = 1, **attrs) -> None:

@@ -50,6 +50,8 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
+from pipelinedp_tpu.runtime.concurrency import guarded_by
+
 # Module-global fast path: span()/instant() check this one bool before
 # doing anything else, so disabled tracing costs a dict-free function
 # call per call site and nothing more.
@@ -65,6 +67,13 @@ _PID = os.getpid()
 _compile: Dict[str, list] = {}
 
 _local = threading.local()
+
+# Spans close on driver/worker threads while exporters read; staticcheck
+# enforces the declaration. `_enabled` (the disabled-path bool) and
+# `_t0` (monotonic epoch base, re-set only under the lock, read
+# tear-free as a float) are deliberately lock-free publishes.
+_GUARDED_BY = guarded_by("_lock", "_events", "_compile", "_dropped",
+                         "_buffer_limit")
 
 
 def enabled() -> bool:

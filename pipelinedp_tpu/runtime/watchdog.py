@@ -48,6 +48,7 @@ from typing import Dict, Optional
 
 from pipelinedp_tpu import input_validators
 from pipelinedp_tpu.runtime import telemetry
+from pipelinedp_tpu.runtime.concurrency import guarded_by
 
 
 class BlockTimeoutError(RuntimeError):
@@ -110,6 +111,14 @@ class Watchdog:
         would flag healthy blocks).
     poll_interval_s: monitor thread scan period.
     """
+
+    # Shared between guard-holding driver threads and the monitor
+    # thread; enforced by staticcheck's lock-discipline rule.
+    # `_last_beat` (tuple publish, read tear-free) and `_closed` (the
+    # monitor-shutdown bool) are deliberately lock-free single-writer
+    # publishes and stay undeclared.
+    _GUARDED_BY = guarded_by("_lock", "_guards", "_profile", "_next_id",
+                             "_monitor")
 
     def __init__(self,
                  timeout_s: Optional[float] = None,
@@ -230,8 +239,7 @@ class Watchdog:
 
     # -- monitor ---------------------------------------------------------
 
-    def _ensure_monitor(self) -> None:
-        # Called under self._lock.
+    def _ensure_monitor(self) -> None:  # staticcheck: disable=lock-discipline — caller holds self._lock (guard() acquires before the call)
         if self._monitor is None or not self._monitor.is_alive():
             self._monitor = threading.Thread(target=self._run_monitor,
                                              name="pdp-watchdog",

@@ -11,6 +11,37 @@ from typing import Optional
 
 import numpy as np
 
+# Lazily created with explicit entropy (staticcheck host-rng: no
+# module-global RNG instances, no draws from numpy's process-global
+# state). Every helper below takes an injectable Generator and falls
+# back to this one.
+_rng: Optional[np.random.Generator] = None
+
+
+def seed_sampling_rng(seed) -> None:
+    """Seeds (or injects a np.random.Generator as) the sampling RNG."""
+    global _rng
+    _rng = (seed if isinstance(seed, np.random.Generator) else
+            np.random.default_rng(seed))
+
+
+def sampling_rng() -> np.random.Generator:
+    """The host-side sampling generator, created on first use from an
+    explicit fresh SeedSequence when no seed was injected."""
+    global _rng
+    if _rng is None:
+        _rng = np.random.default_rng(np.random.SeedSequence())
+    return _rng
+
+
+def keep_with_probability(probability: float,
+                          rng: Optional[np.random.Generator] = None) -> bool:
+    """One Bernoulli(probability) keep decision from an injectable
+    generator (the sampled L0-bounding filters use this instead of the
+    process-global np.random state)."""
+    gen = rng if rng is not None else sampling_rng()
+    return bool(gen.uniform() < probability)
+
 
 def choose_from_list_without_replacement(a: list,
                                          size: int,
@@ -24,10 +55,8 @@ def choose_from_list_without_replacement(a: list,
     """
     if len(a) <= size:
         return a
-    if rng is None:
-        sampled = np.random.choice(np.arange(len(a)), size, replace=False)
-    else:
-        sampled = rng.choice(np.arange(len(a)), size, replace=False)
+    gen = rng if rng is not None else sampling_rng()
+    sampled = gen.choice(np.arange(len(a)), size, replace=False)
     return [a[i] for i in sampled]
 
 

@@ -1,0 +1,30 @@
+"""AST-based DP-invariant analyzer (``python -m pipelinedp_tpu.staticcheck``).
+
+The system's correctness rests on invariants no unit test can observe
+locally: noise keys must be pure ``fold_in(final_key, b)`` derivations,
+every mechanism must hit the budget ledger exactly once, device-resident
+paths must not smuggle host transfers, and the runtime modules share
+state across monitor threads under declared locks. This package parses
+every module once into a shared AST model (:mod:`model`) and runs
+pluggable rules (:mod:`rules`) over it, producing
+``Finding(rule_id, file, line, message)`` records, with inline
+suppressions, a committed baseline for grandfathered findings
+(:mod:`baseline`) and a CLI (:mod:`cli`). The tier-1 gate
+(tests/test_staticcheck.py) fails on any non-baselined finding.
+
+See README "Static analysis" for the rule table, the suppression syntax
+and the baseline workflow.
+"""
+
+from pipelinedp_tpu.staticcheck.baseline import DEFAULT_BASELINE_PATH
+from pipelinedp_tpu.staticcheck.cli import default_paths, main, run_tree
+from pipelinedp_tpu.staticcheck.core import (Analysis, RULES_VERSION,
+                                             analyze, rule_help, rule_ids)
+from pipelinedp_tpu.staticcheck.model import (Finding, Module, load_tree,
+                                              parse_source)
+
+__all__ = [
+    "Analysis", "DEFAULT_BASELINE_PATH", "Finding", "Module",
+    "RULES_VERSION", "analyze", "default_paths", "load_tree", "main",
+    "parse_source", "rule_help", "rule_ids", "run_tree",
+]

@@ -1,0 +1,6 @@
+import sys
+
+from pipelinedp_tpu.staticcheck.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

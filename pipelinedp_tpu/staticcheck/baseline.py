@@ -1,0 +1,102 @@
+"""Committed baseline: grandfathered findings that do not fail the gate.
+
+A baseline entry matches a finding by ``(rule, file, source-line text)``
+— NOT by line number, so unrelated edits that shift lines never
+invalidate it, while any edit to the flagged line itself (the thing the
+rule actually looks at) re-surfaces the finding for fresh triage. Each
+entry carries a ``note`` explaining why the finding is tolerated; the
+tier-1 gate (tests/test_staticcheck.py) fails entries with an empty
+note, so a baseline can never silently absorb findings.
+
+``--update-baseline`` rewrites the file from the current active
+findings, PRESERVING the notes of entries that still match — updating a
+line number never loses its justification.
+"""
+
+import collections
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from pipelinedp_tpu.staticcheck.model import Finding, Module
+
+DEFAULT_BASELINE_PATH = os.path.join(os.path.dirname(__file__),
+                                     "baseline.json")
+
+
+def _key(rule: str, file: str, text: str) -> Tuple[str, str, str]:
+    return (rule, file, " ".join(text.split()))
+
+
+def load(path: str = DEFAULT_BASELINE_PATH) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        payload = json.load(f)
+    return payload.get("entries", [])
+
+
+def split(findings: Sequence[Finding], modules: Sequence[Module],
+          entries: Sequence[dict]
+          ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """(still-active, baselined, stale-entries).
+
+    Each baseline entry absorbs at most one finding; entries that match
+    nothing are stale (the flagged code changed or went away) and should
+    be pruned with --update-baseline.
+    """
+    by_rel = {m.rel: m for m in modules}
+    pool = collections.Counter(
+        _key(e["rule"], e["file"], e.get("text", "")) for e in entries)
+    active: List[Finding] = []
+    baselined: List[Finding] = []
+    for f in findings:
+        mod = by_rel.get(f.file)
+        text = mod.line_text(f.line) if mod is not None else ""
+        key = _key(f.rule_id, f.file, text)
+        if pool[key] > 0:
+            pool[key] -= 1
+            baselined.append(f)
+        else:
+            active.append(f)
+    stale = []
+    for e in entries:
+        key = _key(e["rule"], e["file"], e.get("text", ""))
+        if pool[key] > 0:
+            pool[key] -= 1
+            stale.append(e)
+    return active, baselined, stale
+
+
+def save(findings: Sequence[Finding], modules: Sequence[Module],
+         path: str = DEFAULT_BASELINE_PATH,
+         previous: Optional[Sequence[dict]] = None,
+         rules_version: str = "") -> int:
+    """Writes `findings` as the new baseline, carrying over the notes of
+    previous entries that still match. Returns the entry count."""
+    by_rel = {m.rel: m for m in modules}
+    notes: Dict[Tuple[str, str, str], List[str]] = {}
+    for e in (previous if previous is not None else load(path)):
+        key = _key(e["rule"], e["file"], e.get("text", ""))
+        if e.get("note"):
+            notes.setdefault(key, []).append(e["note"])
+    entries = []
+    for f in sorted(findings, key=lambda f: (f.file, f.line, f.rule_id)):
+        mod = by_rel.get(f.file)
+        text = mod.line_text(f.line) if mod is not None else ""
+        key = _key(f.rule_id, f.file, text)
+        carried = notes.get(key)
+        entries.append({
+            "rule": f.rule_id,
+            "file": f.file,
+            "line": f.line,
+            "text": text,
+            "note": carried.pop(0) if carried else "",
+        })
+    payload = {"rules_version": rules_version, "entries": entries}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    return len(entries)

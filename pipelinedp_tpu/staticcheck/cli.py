@@ -1,0 +1,118 @@
+"""CLI: ``python -m pipelinedp_tpu.staticcheck [paths...]``.
+
+Exit codes: 0 = clean (after suppressions and baseline), 1 = active
+findings, 2 = usage error. ``--update-baseline`` rewrites the committed
+baseline from the current active findings (preserving notes of entries
+that still match) and exits 0.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from pipelinedp_tpu.staticcheck import baseline as baseline_mod
+from pipelinedp_tpu.staticcheck import core
+from pipelinedp_tpu.staticcheck import model
+
+_PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_paths() -> List[str]:
+    """The default analysis target: the installed package tree.
+
+    benchmarks/ (and other non-product dirs) are excluded by
+    model.DEFAULT_EXCLUDED_DIRS whether reached through this default or
+    through an explicit repo-root path argument.
+    """
+    return [_PACKAGE_ROOT]
+
+
+def run_tree(paths: Optional[List[str]] = None,
+             baseline_path: str = baseline_mod.DEFAULT_BASELINE_PATH,
+             only_rules: Optional[List[str]] = None):
+    """One full pass: (analysis, active-after-baseline, baselined,
+    stale-baseline-entries, modules). The programmatic entry the tier-1
+    gate and the bench receipt share with the CLI."""
+    modules = model.load_tree(paths or default_paths())
+    analysis = core.analyze(modules, only_rules=only_rules)
+    entries = baseline_mod.load(baseline_path) if baseline_path else []
+    active, baselined, stale = baseline_mod.split(
+        analysis.active, modules, entries)
+    return analysis, active, baselined, stale, modules
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m pipelinedp_tpu.staticcheck",
+        description="AST-based DP-invariant analyzer (key hygiene, "
+                    "ledger discipline, host-transfer & lock lints).")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to analyze "
+                             "(default: the pipelinedp_tpu package)")
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--baseline",
+                        default=baseline_mod.DEFAULT_BASELINE_PATH,
+                        help="baseline file (default: the committed "
+                             "staticcheck/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: report everything")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from the current "
+                             "active findings (notes of still-matching "
+                             "entries are preserved)")
+    parser.add_argument("--rules", default=None,
+                        help="comma-separated rule ids to run "
+                             "(default: all)")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid, help_text in core.rule_help().items():
+            print(f"{rid}: {help_text}")
+        return 0
+
+    only = ([r.strip() for r in args.rules.split(",") if r.strip()]
+            if args.rules else None)
+    try:
+        analysis, active, baselined, stale, modules = run_tree(
+            args.paths or None,
+            baseline_path=None if args.no_baseline else args.baseline,
+            only_rules=only)
+    except (ValueError, SyntaxError, OSError) as e:
+        print(f"staticcheck: {e}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        n = baseline_mod.save(analysis.active, modules,
+                              path=args.baseline,
+                              rules_version=core.RULES_VERSION)
+        print(f"staticcheck: baseline updated — {n} entr"
+              f"{'y' if n == 1 else 'ies'} at {args.baseline}",
+              file=sys.stderr)
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "rules_version": core.RULES_VERSION,
+            "findings": [f.__dict__ for f in active],
+            "n_findings": len(active),
+            "n_baselined": len(baselined),
+            "n_suppressed": len(analysis.suppressed),
+            "stale_baseline_entries": stale,
+        }, indent=1))
+    else:
+        for f in active:
+            print(f.render())
+        for e in stale:
+            print(f"staticcheck: stale baseline entry "
+                  f"{e['rule']}@{e['file']} ({e.get('text', '')!r}) — "
+                  f"the flagged code changed; prune with "
+                  f"--update-baseline", file=sys.stderr)
+        print(f"staticcheck: {len(active)} finding(s), "
+              f"{len(baselined)} baselined, "
+              f"{len(analysis.suppressed)} suppressed "
+              f"(rules v{core.RULES_VERSION})", file=sys.stderr)
+    return 1 if active else 0

@@ -1,0 +1,73 @@
+"""Analysis driver: rules x modules -> findings, suppressions applied.
+
+``analyze(modules)`` runs every registered rule over the shared model and
+splits the raw findings into *active* (fail the build), *suppressed*
+(silenced inline with a valid ``# staticcheck: disable=...`` comment) and
+*ignored suppressions* (a reason-required rule suppressed without a
+reason — the finding stays active, amended so the author knows why).
+Baseline subtraction is layered on top by :mod:`baseline`.
+"""
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+from pipelinedp_tpu.staticcheck import rules as rules_mod
+from pipelinedp_tpu.staticcheck.model import (Finding, Module,
+                                              REASON_REQUIRED)
+
+# Bump when rules are added/removed or their semantics change enough to
+# invalidate baselines; surfaced in receipts so a finding-count change
+# can be told apart from a rule-set change.
+RULES_VERSION = "1"
+
+
+@dataclasses.dataclass
+class Analysis:
+    """Outcome of one pass: what fails, what was waived, and why."""
+    active: List[Finding]
+    suppressed: List[Finding]
+
+    @property
+    def ok(self) -> bool:
+        return not self.active
+
+
+def rule_ids() -> List[str]:
+    return sorted(rules_mod.RULES)
+
+
+def rule_help() -> Dict[str, str]:
+    return {rid: r.help for rid, r in sorted(rules_mod.RULES.items())}
+
+
+def analyze(modules: Sequence[Module],
+            only_rules: Optional[Sequence[str]] = None) -> Analysis:
+    """Runs the (optionally restricted) rule set over parsed modules."""
+    selected = rule_ids() if only_rules is None else list(only_rules)
+    unknown = set(selected) - set(rules_mod.RULES)
+    if unknown:
+        raise ValueError(
+            f"unknown rule id(s) {sorted(unknown)}; shipped rules: "
+            f"{rule_ids()}")
+    by_rel = {m.rel: m for m in modules}
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    for rid in selected:
+        for finding in rules_mod.RULES[rid].fn(list(modules)):
+            mod = by_rel.get(finding.file)
+            sup = (mod.suppression_for(finding.rule_id, finding.line)
+                   if mod is not None else None)
+            if sup is None:
+                active.append(finding)
+            elif finding.rule_id in REASON_REQUIRED and not sup.reason:
+                active.append(dataclasses.replace(
+                    finding,
+                    message=finding.message +
+                    " [suppression ignored: this rule requires a reason "
+                    "— `# staticcheck: disable=" + finding.rule_id +
+                    " — <why>`]"))
+            else:
+                suppressed.append(finding)
+    active.sort(key=lambda f: (f.file, f.line, f.rule_id))
+    suppressed.sort(key=lambda f: (f.file, f.line, f.rule_id))
+    return Analysis(active=active, suppressed=suppressed)

@@ -1,0 +1,223 @@
+"""Shared source model for the static analyzer.
+
+Every analyzed module is parsed ONCE into a :class:`Module` — AST, source
+lines, import-alias map and inline suppressions — and every rule runs
+over the same model, so a full-tree pass costs one ``ast.parse`` per file
+regardless of how many rules ship.
+
+Suppressions
+------------
+A finding is silenced in place with::
+
+    some_call()  # staticcheck: disable=rule-id — reason
+
+* Several rules: ``disable=rule-a,rule-b``. ``disable=all`` silences
+  every rule on the line.
+* The reason follows an em-dash (``—``) or a double dash (``--``). For
+  rules in :data:`REASON_REQUIRED` a suppression WITHOUT a reason is
+  ignored (and says so in the finding message): those rules guard DP
+  invariants, and an unexplained waiver is indistinguishable from a
+  mistake two reviews later.
+* A suppression on a ``def``/``class`` header line applies to the whole
+  body — the form used for helpers documented as "caller holds the
+  lock".
+* A suppression on a comment-only line applies to the next line.
+"""
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# Rules whose suppressions must carry a reason (see module docstring).
+REASON_REQUIRED = frozenset({
+    "host-transfer",
+    "lock-discipline",
+    "key-hygiene",
+})
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*staticcheck:\s*disable=([a-z0-9,\- ]+?)"
+    r"(?:\s*(?:—|--)\s*(?P<reason>\S.*?))?\s*$")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+    rule_id: str
+    file: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule_id}] {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Suppression:
+    rules: Tuple[str, ...]  # ("all",) silences everything
+    reason: Optional[str]
+    line: int               # line the comment sits on
+
+    def covers(self, rule_id: str) -> bool:
+        return "all" in self.rules or rule_id in self.rules
+
+
+class Module:
+    """One parsed source file plus the lookup structures rules share."""
+
+    def __init__(self, rel: str, source: str):
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel)
+        self.aliases = _import_aliases(self.tree)
+        # line -> suppressions active on exactly that line.
+        self._line_suppressions: Dict[int, List[Suppression]] = {}
+        # (start, end, suppression) ranges from def/class-header comments.
+        self._range_suppressions: List[Tuple[int, int, Suppression]] = []
+        self._collect_suppressions()
+
+    # -- suppressions ----------------------------------------------------
+
+    def _collect_suppressions(self) -> None:
+        comments: Dict[int, Tuple[str, bool]] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.source).readline):
+                if tok.type == tokenize.COMMENT:
+                    only = not tok.line[:tok.start[1]].strip()
+                    comments[tok.start[0]] = (tok.string, only)
+        except tokenize.TokenError:
+            pass
+        header_lines = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                header_lines[node.lineno] = node.end_lineno
+        for lineno, (text, comment_only) in comments.items():
+            m = _SUPPRESS_RE.search(text)
+            if not m:
+                continue
+            rules = tuple(
+                r.strip() for r in m.group(1).split(",") if r.strip())
+            sup = Suppression(rules=rules, reason=m.group("reason"),
+                              line=lineno)
+            if comment_only:
+                # A standalone comment suppresses the line below it.
+                self._line_suppressions.setdefault(lineno + 1, []).append(sup)
+            else:
+                self._line_suppressions.setdefault(lineno, []).append(sup)
+                end = header_lines.get(lineno)
+                if end is not None:
+                    self._range_suppressions.append((lineno, end, sup))
+
+    def suppression_for(self, rule_id: str,
+                        line: int) -> Optional[Suppression]:
+        """The suppression covering (rule, line), if any — reason
+        requirements are enforced by the caller (core.run)."""
+        for sup in self._line_suppressions.get(line, []):
+            if sup.covers(rule_id):
+                return sup
+        for start, end, sup in self._range_suppressions:
+            if start <= line <= end and sup.covers(rule_id):
+                return sup
+        return None
+
+    # -- shared lookups --------------------------------------------------
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of a Name/Attribute chain, with the root
+        segment resolved through this module's import aliases — so
+        ``np.asarray`` canonicalizes to ``numpy.asarray`` and
+        ``jnp.asarray`` to ``jax.numpy.asarray`` regardless of how the
+        module spelled its imports."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.aliases.get(node.id, node.id)
+        parts.append(root)
+        return ".".join(reversed(parts))
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    @property
+    def parts(self) -> Tuple[str, ...]:
+        return tuple(self.rel.split("/"))
+
+
+def _import_aliases(tree: ast.AST) -> Dict[str, str]:
+    aliases: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                aliases[alias.asname or alias.name.split(".")[0]] = \
+                    alias.name if alias.asname else alias.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module and \
+                node.level == 0:
+            for alias in node.names:
+                aliases[alias.asname or alias.name] = \
+                    f"{node.module}.{alias.name}"
+    return aliases
+
+
+def canonical_rel(path: str) -> str:
+    """Stable module identity: the path from the ``pipelinedp_tpu``
+    package segment onward (posix-separated), or the cwd-relative path
+    for files outside the package."""
+    parts = os.path.abspath(path).split(os.sep)
+    if "pipelinedp_tpu" in parts:
+        return "/".join(parts[parts.index("pipelinedp_tpu"):])
+    return os.path.relpath(path).replace(os.sep, "/")
+
+
+def parse_source(rel: str, source: str) -> Module:
+    """Parses an in-memory snippet as a module (fixtures, tests)."""
+    return Module(rel, source)
+
+
+def parse_file(path: str) -> Module:
+    with open(path, encoding="utf-8") as f:
+        return Module(canonical_rel(path), f.read())
+
+
+DEFAULT_EXCLUDED_DIRS = frozenset({
+    "__pycache__", ".git", "build", "dist", "node_modules",
+    # Perf-harness code is measured, not analyzed: benchmarks stage data
+    # to/from the host by design, so every transfer lint there is noise.
+    "benchmarks",
+})
+
+
+def iter_python_files(paths: Iterable[str],
+                      excluded_dirs: frozenset = DEFAULT_EXCLUDED_DIRS
+                      ) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in excluded_dirs)
+            for fname in sorted(filenames):
+                if fname.endswith(".py"):
+                    out.append(os.path.join(dirpath, fname))
+    return out
+
+
+def load_tree(paths: Iterable[str]) -> List[Module]:
+    """Parses every .py under the given paths into the shared model."""
+    modules = []
+    for path in iter_python_files(paths):
+        modules.append(parse_file(path))
+    return modules

@@ -1,0 +1,913 @@
+"""The shipped rules: AST checks for the invariants no unit test can see.
+
+Each rule is a generator over the shared :mod:`model` tree, registered in
+:data:`RULES`. Rules are *structural*: they prove properties of the
+source (a key is never drawn twice, a guarded attribute is only touched
+under its lock, every knob maps to an invoked validator), which is
+exactly the class of DP-correctness property that runtime tests cannot
+establish — a test observes one execution; the invariant quantifies over
+all of them.
+"""
+
+import ast
+import collections
+import re
+from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from pipelinedp_tpu.staticcheck.model import Finding, Module
+
+Rule = collections.namedtuple("Rule", ["rule_id", "help", "fn"])
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(rule_id: str, help_text: str):
+    def deco(fn: Callable[[List[Module]], Iterator[Finding]]):
+        RULES[rule_id] = Rule(rule_id, help_text, fn)
+        return fn
+    return deco
+
+
+def _walk_no_nested_scopes(root: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk (root included) that does not descend into nested
+    function/lambda bodies — they are separate scopes, visited on their
+    own."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        if node is root or not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _function_scopes(tree: ast.AST) -> Iterator[ast.AST]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.Module)):
+            yield node
+
+
+def _stored_names(node: ast.AST) -> Set[str]:
+    return {
+        n.id
+        for n in _walk_no_nested_scopes(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store,
+                                                          ast.Del))
+    }
+
+
+# ---------------------------------------------------------------------------
+# (1) key-hygiene
+# ---------------------------------------------------------------------------
+
+# jax.random functions that CONSUME a key (a draw); split/fold_in DERIVE.
+_KEY_DRAWS = frozenset({
+    "uniform", "normal", "laplace", "exponential", "bits", "bernoulli",
+    "gumbel", "randint", "choice", "permutation", "categorical",
+    "truncated_normal", "poisson", "gamma", "beta", "cauchy", "logistic",
+    "rademacher", "shuffle", "t", "dirichlet", "multivariate_normal",
+})
+
+# The one sanctioned PRNGKey constructor: every other key in product code
+# must arrive through the seed plumbing and be derived via split/fold_in.
+_SANCTIONED_KEY_CONSTRUCTORS = frozenset({"make_noise_key"})
+
+
+def _draw_key_name(mod: Module, node: ast.AST) -> Optional[Tuple[str, int]]:
+    """(key variable name, line) when node is a jax.random draw keyed by a
+    bare variable."""
+    if not (isinstance(node, ast.Call) and node.args):
+        return None
+    name = mod.dotted(node.func)
+    if name is None or not name.startswith("jax.random."):
+        return None
+    if name.rsplit(".", 1)[1] not in _KEY_DRAWS:
+        return None
+    key = node.args[0]
+    if isinstance(key, ast.Name):
+        return key.id, node.lineno
+    return None
+
+
+def _check_scope_key_reuse(mod: Module, scope: ast.AST
+                           ) -> Iterator[Finding]:
+    versions: Dict[str, int] = {}
+    # (name, version) -> first draw line.
+    seen: Dict[Tuple[str, int], int] = {}
+
+    if isinstance(scope, ast.Lambda):
+        draws: Dict[str, int] = {}
+        for node in _walk_no_nested_scopes(scope.body):
+            hit = _draw_key_name(mod, node)
+            if hit is None:
+                continue
+            name, line = hit
+            if name in draws:
+                yield Finding(
+                    "key-hygiene", mod.rel, line,
+                    f"PRNG key {name!r} consumed by a second jax.random "
+                    f"draw (first at line {draws[name]}) without an "
+                    f"intervening split/fold_in — correlated noise is a "
+                    f"privacy failure, not a statistics bug")
+            else:
+                draws[name] = line
+        return
+
+    body = scope.body if not isinstance(scope, ast.Module) else scope.body
+
+    def bump(target: ast.AST) -> None:
+        for n in ast.walk(target):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store,
+                                                              ast.Del)):
+                versions[n.id] = versions.get(n.id, 0) + 1
+                seen.pop((n.id, versions[n.id]), None)
+
+    def expr_draws(node: Optional[ast.AST], loop_stores: Set[str],
+                   out: List[Finding]) -> None:
+        if node is None:
+            return
+        for n in _walk_no_nested_scopes(node):
+            hit = _draw_key_name(mod, n)
+            if hit is None:
+                continue
+            name, line = hit
+            if loop_stores and name not in loop_stores:
+                out.append(Finding(
+                    "key-hygiene", mod.rel, line,
+                    f"PRNG key {name!r} consumed inside a loop without a "
+                    f"per-iteration split/fold_in derivation — every "
+                    f"iteration draws the same randomness"))
+                continue
+            ver = versions.get(name, 0)
+            if (name, ver) in seen:
+                out.append(Finding(
+                    "key-hygiene", mod.rel, line,
+                    f"PRNG key {name!r} consumed by a second jax.random "
+                    f"draw (first at line {seen[(name, ver)]}) without an "
+                    f"intervening split/fold_in — correlated noise is a "
+                    f"privacy failure, not a statistics bug"))
+            else:
+                seen[(name, ver)] = line
+
+    def walk(stmts: Iterable[ast.stmt], loop_stores: Set[str],
+             out: List[Finding]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # separate scope / own pass
+            if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                expr_draws(stmt.value, loop_stores, out)
+                targets = (stmt.targets
+                           if isinstance(stmt, ast.Assign) else
+                           [stmt.target])
+                for t in targets:
+                    bump(t)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                expr_draws(stmt.iter, loop_stores, out)
+                inner = loop_stores | _stored_names(stmt)
+                bump(stmt.target)
+                walk(stmt.body, inner, out)
+                walk(stmt.orelse, loop_stores, out)
+            elif isinstance(stmt, ast.While):
+                expr_draws(stmt.test, loop_stores, out)
+                walk(stmt.body, loop_stores | _stored_names(stmt), out)
+                walk(stmt.orelse, loop_stores, out)
+            elif isinstance(stmt, ast.If):
+                expr_draws(stmt.test, loop_stores, out)
+                fork = dict(seen)
+                walk(stmt.body, loop_stores, out)
+                after_body = dict(seen)
+                seen.clear()
+                seen.update(fork)
+                walk(stmt.orelse, loop_stores, out)
+                seen.update(after_body)  # post-if reuse collides with either
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    expr_draws(item.context_expr, loop_stores, out)
+                    if item.optional_vars is not None:
+                        bump(item.optional_vars)
+                walk(stmt.body, loop_stores, out)
+            elif isinstance(stmt, ast.Try):
+                walk(stmt.body, loop_stores, out)
+                for handler in stmt.handlers:
+                    walk(handler.body, loop_stores, out)
+                walk(stmt.orelse, loop_stores, out)
+                walk(stmt.finalbody, loop_stores, out)
+            else:
+                expr_draws(stmt, loop_stores, out)
+
+    out: List[Finding] = []
+    walk(body, set(), out)
+    yield from out
+
+
+@rule(
+    "key-hygiene",
+    "A PRNG key must never be consumed by two jax.random draws without "
+    "an intervening split/fold_in, and jax.random.PRNGKey may only be "
+    "constructed by the sanctioned seed plumbing (ops/noise.py "
+    "make_noise_key) — ad-hoc keys bypass the fold_in(final_key, b) "
+    "derivation the bit-identical-retry guarantee rests on.")
+def key_hygiene(modules: List[Module]) -> Iterator[Finding]:
+    for mod in modules:
+        for scope in _function_scopes(mod.tree):
+            yield from _check_scope_key_reuse(mod, scope)
+        func_stack: List[str] = []
+
+        def visit(node: ast.AST) -> Iterator[Finding]:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func_stack.append(node.name)
+                for child in ast.iter_child_nodes(node):
+                    yield from visit(child)
+                func_stack.pop()
+                return
+            if (isinstance(node, ast.Call) and
+                    mod.dotted(node.func) == "jax.random.PRNGKey" and
+                    not (set(func_stack) &
+                         _SANCTIONED_KEY_CONSTRUCTORS)):
+                yield Finding(
+                    "key-hygiene", mod.rel, node.lineno,
+                    "jax.random.PRNGKey constructed outside "
+                    "make_noise_key — product keys must come through the "
+                    "seed plumbing and be derived via split/fold_in so "
+                    "retries and resumes replay the same release")
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child)
+
+        yield from visit(mod.tree)
+
+
+# ---------------------------------------------------------------------------
+# (2) host-rng
+# ---------------------------------------------------------------------------
+
+_GLOBAL_NP_DRAWS = frozenset({
+    "rand", "randn", "random", "random_sample", "ranf", "sample",
+    "randint", "random_integers", "choice", "shuffle", "permutation",
+    "normal", "laplace", "uniform", "binomial", "poisson", "exponential",
+    "geometric", "beta", "gamma", "gumbel", "logistic",
+    "standard_normal", "standard_cauchy", "standard_exponential", "seed",
+    "bytes",
+})
+_STDLIB_RANDOM_DRAWS = frozenset({
+    "random", "randint", "randrange", "uniform", "sample", "choice",
+    "choices", "shuffle", "gauss", "normalvariate", "lognormvariate",
+    "expovariate", "betavariate", "gammavariate", "triangular",
+    "vonmisesvariate", "paretovariate", "weibullvariate", "seed",
+    "getrandbits", "randbytes",
+})
+_RNG_CONSTRUCTORS = frozenset({
+    "numpy.random.default_rng", "numpy.random.RandomState",
+    "numpy.random.Generator", "random.Random", "random.SystemRandom",
+})
+
+
+@rule(
+    "host-rng",
+    "No hidden host randomness: module-global RNG instances and draws "
+    "from the process-global numpy/stdlib RNG state are forbidden — "
+    "noise and sampling must come from explicitly seeded, injectable "
+    "generators (or the device-side counter-based keys), or a resumed "
+    "job cannot replay the same release.")
+def host_rng(modules: List[Module]) -> Iterator[Finding]:
+    for mod in modules:
+        in_function = [False]
+
+        def visit(node: ast.AST) -> Iterator[Finding]:
+            entered = isinstance(node, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef, ast.Lambda))
+            if entered:
+                in_function.append(True)
+            if isinstance(node, ast.Call):
+                name = mod.dotted(node.func)
+                if name in _RNG_CONSTRUCTORS and not in_function[-1]:
+                    yield Finding(
+                        "host-rng", mod.rel, node.lineno,
+                        f"module-global RNG instance ({name}) — shared "
+                        f"mutable RNG state hides the seed; use an "
+                        f"explicitly seeded, injectable generator "
+                        f"created at (or passed into) the call site")
+                elif name is not None and name.startswith("numpy.random."):
+                    fn = name.rsplit(".", 1)[1]
+                    if fn in _GLOBAL_NP_DRAWS:
+                        yield Finding(
+                            "host-rng", mod.rel, node.lineno,
+                            f"{name}() draws from numpy's process-global "
+                            f"RNG — route through an injectable "
+                            f"np.random.Generator (sampling_utils / the "
+                            f"module's seeded rng) instead")
+                elif name is not None and name.startswith("random."):
+                    fn = name.split(".", 1)[1]
+                    if fn in _STDLIB_RANDOM_DRAWS:
+                        yield Finding(
+                            "host-rng", mod.rel, node.lineno,
+                            f"stdlib {name}() draws from the "
+                            f"process-global RNG — use an injectable "
+                            f"generator instead")
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child)
+            if entered:
+                in_function.pop()
+
+        yield from visit(mod.tree)
+
+
+# ---------------------------------------------------------------------------
+# (3) host-transfer
+# ---------------------------------------------------------------------------
+
+_TRANSFER_CALLS = frozenset({
+    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+    "jax.device_get",
+})
+_TRANSFER_METHODS = frozenset({"item", "tolist"})
+# The sanctioned device->host routing points: transfers INSIDE these
+# functions are the implementation of the routing itself.
+_SANCTIONED_FETCH_FUNCS = frozenset({
+    ("pipelinedp_tpu/parallel/mesh.py", "host_fetch"),
+    ("pipelinedp_tpu/parallel/mesh.py", "sync_fetch"),
+})
+
+
+def _is_device_resident(mod: Module) -> bool:
+    dirs = mod.parts[:-1]
+    return "parallel" in dirs or "ops" in dirs
+
+
+@rule(
+    "host-transfer",
+    "Device-resident modules (parallel/, ops/) must not smuggle host "
+    "transfers: np.asarray/np.array/jax.device_get/.item()/.tolist() on "
+    "device values block on a device->host copy. Route control-plane "
+    "fetches through mesh.host_fetch (retried, watchdog-guarded, "
+    "traced); O(kept)/O(D) post-drain staging is baselined with a note "
+    "or suppressed with a reason — the runtime counterpart is "
+    "reshard.forbid_row_fetches.")
+def host_transfer(modules: List[Module]) -> Iterator[Finding]:
+    for mod in modules:
+        if not _is_device_resident(mod):
+            continue
+        func_stack: List[str] = []
+
+        def visit(node: ast.AST) -> Iterator[Finding]:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func_stack.append(node.name)
+                for child in ast.iter_child_nodes(node):
+                    yield from visit(child)
+                func_stack.pop()
+                return
+            sanctioned = any((mod.rel, fn) in _SANCTIONED_FETCH_FUNCS
+                             for fn in func_stack)
+            if isinstance(node, ast.Call) and not sanctioned:
+                name = mod.dotted(node.func)
+                if name in _TRANSFER_CALLS:
+                    yield Finding(
+                        "host-transfer", mod.rel, node.lineno,
+                        f"{name}() in a device-resident module forces a "
+                        f"blocking device->host transfer — route through "
+                        f"mesh.host_fetch, or suppress with a reason / "
+                        f"baseline with a note if the volume is bounded "
+                        f"(O(kept), O(D))")
+                elif (isinstance(node.func, ast.Attribute) and
+                      node.func.attr in _TRANSFER_METHODS and
+                      not node.args and not node.keywords):
+                    yield Finding(
+                        "host-transfer", mod.rel, node.lineno,
+                        f".{node.func.attr}() in a device-resident module "
+                        f"forces a blocking device->host transfer — "
+                        f"route through mesh.host_fetch, or suppress "
+                        f"with a reason")
+            for child in ast.iter_child_nodes(node):
+                yield from visit(child)
+
+        yield from visit(mod.tree)
+
+
+# ---------------------------------------------------------------------------
+# (4) lock-discipline
+# ---------------------------------------------------------------------------
+
+def _guarded_decl(mod: Module, stmt: ast.stmt
+                  ) -> Optional[Tuple[str, Tuple[str, ...]]]:
+    """Parses ``_GUARDED_BY = guarded_by("<lock>", "<attr>", ...)``."""
+    if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and
+            isinstance(stmt.targets[0], ast.Name) and
+            stmt.targets[0].id == "_GUARDED_BY" and
+            isinstance(stmt.value, ast.Call)):
+        return None
+    callee = mod.dotted(stmt.value.func) or ""
+    if callee.rsplit(".", 1)[-1] != "guarded_by":
+        return None
+    names = []
+    for arg in stmt.value.args:
+        if not (isinstance(arg, ast.Constant) and
+                isinstance(arg.value, str)):
+            return None
+        names.append(arg.value)
+    if len(names) < 2:
+        return None
+    return names[0], tuple(names[1:])
+
+
+def _with_locks(mod: Module, stmt: ast.stmt, self_form: bool) -> Set[str]:
+    locks: Set[str] = set()
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            name = mod.dotted(item.context_expr)
+            if name is None:
+                continue
+            if self_form and name.startswith("self."):
+                locks.add(name[len("self."):])
+            elif not self_form and "." not in name:
+                locks.add(name)
+    return locks
+
+
+def _check_guarded_body(mod: Module, body: Iterable[ast.stmt], lock: str,
+                        attrs: Tuple[str, ...], self_form: bool,
+                        where: str) -> Iterator[Finding]:
+
+    def visit(node: ast.AST, held: bool) -> Iterator[Finding]:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # A nested function/lambda runs later, outside the lock that
+            # was held at definition time.
+            body_nodes = (node.body if isinstance(node.body, list)
+                          else [node.body])
+            for child in body_nodes:
+                yield from visit(child, False)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquires = lock in _with_locks(mod, node, self_form)
+            for item in node.items:
+                yield from visit(item.context_expr, held)
+                if item.optional_vars is not None:
+                    yield from visit(item.optional_vars, held)
+            for child in node.body:
+                yield from visit(child, held or acquires)
+            return
+        touched = None
+        if self_form:
+            if (isinstance(node, ast.Attribute) and
+                    isinstance(node.value, ast.Name) and
+                    node.value.id == "self" and node.attr in attrs):
+                touched = f"self.{node.attr}"
+        else:
+            if isinstance(node, ast.Name) and node.id in attrs:
+                touched = node.id
+        if touched is not None and not held:
+            lock_name = f"self.{lock}" if self_form else lock
+            yield Finding(
+                "lock-discipline", mod.rel, node.lineno,
+                f"{touched} is declared guarded_by({lock!r}) in {where} "
+                f"but is touched outside `with {lock_name}:` — a silent "
+                f"data race with the watchdog/monitor threads")
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, held)
+
+    for stmt in body:
+        yield from visit(stmt, False)
+
+
+@rule(
+    "lock-discipline",
+    "Attributes declared via `_GUARDED_BY = guarded_by(\"_lock\", ...)` "
+    "(runtime/concurrency.py) must only be touched inside "
+    "`with <lock>:`. __init__ and module-scope initialization are "
+    "exempt (construction happens-before publication); helpers whose "
+    "caller holds the lock carry a def-line suppression with a reason.")
+def lock_discipline(modules: List[Module]) -> Iterator[Finding]:
+    for mod in modules:
+        # Module-scope declaration: guarded globals, checked inside every
+        # function of the module (module-level statements initialize).
+        for stmt in mod.tree.body:
+            decl = _guarded_decl(mod, stmt)
+            if decl is None:
+                continue
+            lock, attrs = decl
+            for node in mod.tree.body:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    yield from _check_guarded_body(
+                        mod, [node], lock, attrs, self_form=False,
+                        where=f"module {mod.rel}")
+        # Class-scope declarations: guarded instance attributes.
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for stmt in cls.body:
+                decl = _guarded_decl(mod, stmt)
+                if decl is None:
+                    continue
+                lock, attrs = decl
+                for method in cls.body:
+                    if not isinstance(method, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef)):
+                        continue
+                    if method.name == "__init__":
+                        continue
+                    yield from _check_guarded_body(
+                        mod, method.body, lock, attrs, self_form=True,
+                        where=f"class {cls.name}")
+
+
+# ---------------------------------------------------------------------------
+# (5) jit-boundary
+# ---------------------------------------------------------------------------
+
+_SHAPE_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "sharding",
+                          "aval"})
+
+
+def _jit_decorator_info(mod: Module, dec: ast.AST
+                        ) -> Optional[Tuple[Set[str], Set[int]]]:
+    """(static_argnames, static_argnums) when `dec` jit-compiles, else
+    None. Handles @jax.jit and @functools.partial(jax.jit, ...)."""
+    if mod.dotted(dec) == "jax.jit":
+        return set(), set()
+    if not isinstance(dec, ast.Call):
+        return None
+    callee = mod.dotted(dec.func)
+    if callee == "jax.jit":
+        call = dec
+    elif callee in ("functools.partial", "partial") and dec.args and \
+            mod.dotted(dec.args[0]) == "jax.jit":
+        call = dec
+    else:
+        return None
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+        elif kw.arg == "static_argnums":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    nums.add(n.value)
+    return names, nums
+
+
+def _probe_wrapped_names(mod: Module) -> Set[str]:
+    wrapped: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            callee = mod.dotted(node.func) or ""
+            if callee.rsplit(".", 1)[-1] == "probe_jit":
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        wrapped.add(arg.id)
+    return wrapped
+
+
+def _traced_if_findings(mod: Module, fn: ast.AST, traced: Set[str]
+                        ) -> Iterator[Finding]:
+    shielded: Set[ast.AST] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and \
+                node.attr in _SHAPE_ATTRS and \
+                isinstance(node.value, ast.Name):
+            shielded.add(node.value)
+        if isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name):
+                    shielded.add(n)
+    for node in _walk_no_nested_scopes(fn):
+        if not isinstance(node, (ast.If, ast.While)):
+            continue
+        for n in ast.walk(node.test):
+            if isinstance(n, ast.Name) and n.id in traced and \
+                    n not in shielded:
+                yield Finding(
+                    "jit-boundary", mod.rel, node.lineno,
+                    f"Python `if`/`while` on traced argument {n.id!r} "
+                    f"inside a jitted body — tracing evaluates this once "
+                    f"at compile time, not per value; use lax.cond / "
+                    f"jnp.where, or declare the argument static")
+                break
+
+
+@rule(
+    "jit-boundary",
+    "Every jax.jit/pjit entry point must be wrapped in trace.probe_jit "
+    "(compile/dispatch attribution — an unwrapped kernel's compiles are "
+    "invisible in the e2e gap accounting), and jitted bodies must not "
+    "branch in Python on traced arguments.")
+def jit_boundary(modules: List[Module]) -> Iterator[Finding]:
+    for mod in modules:
+        wrapped = _probe_wrapped_names(mod)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                info = _jit_decorator_info(mod, dec)
+                if info is None:
+                    continue
+                static_names, static_nums = info
+                if node.name not in wrapped:
+                    yield Finding(
+                        "jit-boundary", mod.rel, node.lineno,
+                        f"jit entry point {node.name!r} is not wrapped "
+                        f"in trace.probe_jit — its compiles and "
+                        f"dispatches are invisible to the compile/"
+                        f"dispatch attribution (reassign: {node.name} = "
+                        f"rt_trace.probe_jit({node.name!r}, "
+                        f"{node.name}))")
+                args = node.args
+                traced = {
+                    a.arg
+                    for i, a in enumerate(args.posonlyargs + args.args)
+                    if a.arg not in static_names and i not in static_nums
+                } | {a.arg for a in args.kwonlyargs
+                     if a.arg not in static_names}
+                yield from _traced_if_findings(mod, node, traced)
+                break
+
+
+# ---------------------------------------------------------------------------
+# (6a) registry-drift
+# ---------------------------------------------------------------------------
+
+_TELEMETRY_REL = "pipelinedp_tpu/runtime/telemetry.py"
+
+
+def _declared_metrics(mod: Module) -> Dict[str, int]:
+    declared: Dict[str, int] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            callee = mod.dotted(node.func) or ""
+            if callee.rsplit(".", 1)[-1] in ("_counter", "Metric") and \
+                    node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                declared[node.args[0].value] = node.lineno
+    return declared
+
+
+def _recorded_literals(modules: List[Module]
+                       ) -> Dict[str, List[Tuple[str, int]]]:
+    recorded: Dict[str, List[Tuple[str, int]]] = {}
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            is_record = (isinstance(func, ast.Attribute) and
+                         func.attr == "record") or \
+                        (isinstance(func, ast.Name) and
+                         func.id == "record")
+            if not is_record:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str)\
+                    and arg.value.isidentifier():
+                recorded.setdefault(arg.value, []).append(
+                    (mod.rel, node.lineno))
+    return recorded
+
+
+@rule(
+    "registry-drift",
+    "telemetry.REGISTRY and the source tree must agree in BOTH "
+    "directions: every telemetry.record(\"name\") literal names a "
+    "declared metric, and every declared counter is recorded somewhere "
+    "— dead metrics mislead receipt readers, undeclared ones fork the "
+    "namespace.")
+def registry_drift(modules: List[Module]) -> Iterator[Finding]:
+    telemetry = next((m for m in modules if m.rel == _TELEMETRY_REL), None)
+    if telemetry is None:
+        return
+    declared = _declared_metrics(telemetry)
+    recorded = _recorded_literals(modules)
+    for name, sites in sorted(recorded.items()):
+        if name not in declared:
+            rel, line = sites[0]
+            yield Finding(
+                "registry-drift", rel, line,
+                f"telemetry.record({name!r}) has no REGISTRY declaration "
+                f"— declare it (name, kind, help) in runtime/telemetry.py "
+                f"first")
+    for name, line in sorted(declared.items()):
+        if name not in recorded:
+            yield Finding(
+                "registry-drift", _TELEMETRY_REL, line,
+                f"REGISTRY declares {name!r} but no source file records "
+                f"it — a dead metric misleads receipt readers; drop it "
+                f"or wire it up")
+
+
+# ---------------------------------------------------------------------------
+# (6b) knob-validation
+# ---------------------------------------------------------------------------
+
+_ENTRY_REL = "pipelinedp_tpu/runtime/entry.py"
+_VALIDATORS_REL = "pipelinedp_tpu/input_validators.py"
+_BACKEND_REL = "pipelinedp_tpu/pipeline_backend.py"
+
+# Runtime knob -> the input_validators function that must vet it.
+KNOB_VALIDATORS: Dict[str, str] = {
+    "retry": "validate_retry_policy",
+    "journal": "validate_journal",
+    "timeout_s": "validate_timeout_s",
+    "watchdog": "validate_watchdog",
+    "elastic": "validate_elastic",
+    "min_devices": "validate_min_devices",
+    "job_id": "validate_job_id",
+    "trace": "validate_trace",
+}
+
+# Data-plane parameters: configuration, not failure semantics — adding
+# one here is a deliberate reviewed decision, not a default.
+KNOB_EXEMPT = frozenset({
+    # driver data/geometry knobs
+    "block_partitions", "row_chunk", "secure_tables", "reshard",
+    "phase_times",
+    # TPUBackend configuration
+    "mesh", "max_partitions", "noise_seed", "secure_noise",
+    "large_partition_threshold",
+})
+
+_DRIVER_FUNCS: Dict[str, Tuple[str, ...]] = {
+    "pipelinedp_tpu/parallel/large_p.py": (
+        "aggregate_blocked", "aggregate_blocked_sharded",
+        "select_partitions_blocked", "select_partitions_blocked_sharded"),
+    "pipelinedp_tpu/parallel/sharded.py": (
+        "sharded_aggregate_arrays", "sharded_select_partitions"),
+}
+
+
+def _keyword_knobs(fn: ast.FunctionDef) -> Dict[str, int]:
+    """Defaulted-positional + keyword-only parameter names -> line."""
+    knobs: Dict[str, int] = {}
+    args = fn.args
+    defaulted = args.args[len(args.args) - len(args.defaults):] \
+        if args.defaults else []
+    for a in defaulted:
+        knobs[a.arg] = a.lineno
+    for a in args.kwonlyargs:
+        knobs[a.arg] = a.lineno
+    return knobs
+
+
+def _find_funcdef(mod: Module, name: str,
+                  cls: Optional[str] = None) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef) and cls is not None and \
+                node.name == cls:
+            for sub in node.body:
+                if isinstance(sub, ast.FunctionDef) and sub.name == name:
+                    return sub
+        elif cls is None and isinstance(node, ast.FunctionDef) and \
+                node.name == name:
+            return node
+    return None
+
+
+def _invoked_validators(node: ast.AST, mod: Module) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            callee = mod.dotted(n.func) or ""
+            leaf = callee.rsplit(".", 1)[-1]
+            if leaf.startswith("validate_"):
+                out.add(leaf)
+    return out
+
+
+@rule(
+    "knob-validation",
+    "Every runtime knob on the drivers, the shared runtime_entry wrapper "
+    "and TPUBackend must map to an input_validators.validate_* function "
+    "that exists and is invoked at the API boundary (runtime/entry.py "
+    "for drivers, TPUBackend.__init__ for the backend); stale map "
+    "entries are flagged in the reverse direction.")
+def knob_validation(modules: List[Module]) -> Iterator[Finding]:
+    by_rel = {m.rel: m for m in modules}
+    entry = by_rel.get(_ENTRY_REL)
+    validators_mod = by_rel.get(_VALIDATORS_REL)
+    backend_mod = by_rel.get(_BACKEND_REL)
+
+    defined_validators = None
+    if validators_mod is not None:
+        defined_validators = {
+            node.name
+            for node in ast.walk(validators_mod.tree)
+            if isinstance(node, ast.FunctionDef)
+        }
+
+    all_knobs: Dict[str, Tuple[str, int]] = {}
+
+    def check_knobs(knobs: Dict[str, int], rel: str, owner: str,
+                    invoked: Set[str], boundary: str) -> Iterator[Finding]:
+        for knob, line in sorted(knobs.items()):
+            all_knobs.setdefault(knob, (rel, line))
+            if knob in KNOB_EXEMPT:
+                continue
+            if knob not in KNOB_VALIDATORS:
+                yield Finding(
+                    "knob-validation", rel, line,
+                    f"{owner} grew a runtime knob {knob!r} with no "
+                    f"validator mapping — add input_validators."
+                    f"validate_{knob}, map it in staticcheck/rules.py "
+                    f"KNOB_VALIDATORS and invoke it at {boundary} (or "
+                    f"exempt it deliberately as a data-plane parameter)")
+                continue
+            validator = KNOB_VALIDATORS[knob]
+            if defined_validators is not None and \
+                    validator not in defined_validators:
+                yield Finding(
+                    "knob-validation", rel, line,
+                    f"input_validators.{validator} (mapped for knob "
+                    f"{knob!r}) does not exist")
+            if validator not in invoked:
+                yield Finding(
+                    "knob-validation", rel, line,
+                    f"{boundary} never invokes {validator} for "
+                    f"{knob!r} — the knob skips validation at the API "
+                    f"boundary")
+
+    if entry is not None:
+        wrapper = _find_funcdef(entry, "wrapper")
+        entry_invoked = _invoked_validators(entry.tree, entry)
+        if wrapper is not None:
+            yield from check_knobs(
+                _keyword_knobs(wrapper), entry.rel,
+                "the runtime_entry wrapper", entry_invoked,
+                "runtime/entry.py")
+        for rel, names in _DRIVER_FUNCS.items():
+            driver_mod = by_rel.get(rel)
+            if driver_mod is None:
+                continue
+            for name in names:
+                fn = _find_funcdef(driver_mod, name)
+                if fn is None:
+                    yield Finding(
+                        "knob-validation", rel, 1,
+                        f"driver {name!r} expected in {rel} but not "
+                        f"found — update staticcheck/rules.py "
+                        f"_DRIVER_FUNCS")
+                    continue
+                yield from check_knobs(
+                    _keyword_knobs(fn), rel, f"driver {name}",
+                    entry_invoked, "runtime/entry.py")
+
+    if backend_mod is not None:
+        init = _find_funcdef(backend_mod, "__init__", cls="TPUBackend")
+        if init is not None:
+            knobs = {a.arg: a.lineno
+                     for a in init.args.args if a.arg != "self"}
+            knobs.update(_keyword_knobs(init))
+            knobs.pop("self", None)
+            yield from check_knobs(
+                knobs, backend_mod.rel, "TPUBackend",
+                _invoked_validators(init, backend_mod),
+                "TPUBackend.__init__")
+
+    # Reverse direction: a mapping whose knob no longer exists anywhere
+    # is stale — it would silently pass while guarding nothing.
+    if entry is not None and backend_mod is not None:
+        for knob in sorted(set(KNOB_VALIDATORS) - set(all_knobs)):
+            yield Finding(
+                "knob-validation", _ENTRY_REL, 1,
+                f"KNOB_VALIDATORS maps {knob!r} -> "
+                f"{KNOB_VALIDATORS[knob]!r} but no driver, wrapper or "
+                f"TPUBackend parameter with that name exists — stale "
+                f"mapping; drop it or restore the knob")
+
+
+# ---------------------------------------------------------------------------
+# (7) broad-except
+# ---------------------------------------------------------------------------
+
+_BLE_OK = re.compile(r"#\s*noqa:\s*BLE001\s*[-—]\s*\S")
+
+
+@rule(
+    "broad-except",
+    "`except Exception` / bare `except:` must carry a classification "
+    "comment (`# noqa: BLE001 - <why this breadth is safe>`): the "
+    "runtime's retry/degradation machinery depends on exceptions being "
+    "CLASSIFIED (transient/oom/timeout/device-fatal), and an "
+    "unclassified broad except swallows the classification.")
+def broad_except(modules: List[Module]) -> Iterator[Finding]:
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = node.type is None
+            if node.type is not None:
+                types = node.type.elts if isinstance(node.type, ast.Tuple)\
+                    else [node.type]
+                broad = any(mod.dotted(t) == "Exception" for t in types)
+            if not broad:
+                continue
+            if _BLE_OK.search(mod.line_text(node.lineno)):
+                continue
+            yield Finding(
+                "broad-except", mod.rel, node.lineno,
+                "broad `except Exception` without a classification "
+                "comment — classify-and-reraise (see runtime/retry.py "
+                "sites) or annotate `# noqa: BLE001 - <reason>`")

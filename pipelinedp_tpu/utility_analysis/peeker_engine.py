@@ -19,6 +19,7 @@ from pipelinedp_tpu import budget_accounting
 from pipelinedp_tpu import combiners as dp_combiners
 from pipelinedp_tpu import partition_selection
 from pipelinedp_tpu import pipeline_backend
+from pipelinedp_tpu import sampling_utils
 
 
 def aggregate_sketch_true(backend: pipeline_backend.PipelineBackend, col,
@@ -93,7 +94,8 @@ def _cross_partition_filter_fn(max_partitions: int,
     _, _, partition_count = row
     if partition_count <= max_partitions:
         return True
-    return np.random.rand() < max_partitions / partition_count
+    return sampling_utils.keep_with_probability(
+        max_partitions / partition_count)
 
 
 def _per_partition_bounding(max_contributions_per_partition: int, pk: Any,

@@ -46,6 +46,11 @@ def pytest_configure(config):
         "hard_timeout(seconds): outer hard timeout enforced by the "
         "conftest guard — a watchdog BUG in the code under test cannot "
         "hang tier-1")
+    config.addinivalue_line(
+        "markers",
+        "staticcheck: the AST DP-invariant analyzer gate and its "
+        "fixtures (always-on tier-1, NOT slow; select alone with "
+        "-m staticcheck)")
 
 
 @pytest.fixture(autouse=True)

@@ -2,11 +2,14 @@
 
 Three contracts:
 
-  * REGISTRY discipline (structural, same pattern as
-    test_knob_validation.py): every ``telemetry.record("...")`` literal
-    in the source tree names a declared registry metric, and every
-    declared counter is recorded somewhere — the registry and the code
-    cannot drift apart in either direction.
+  * REGISTRY discipline: every ``telemetry.record("...")`` literal in
+    the source tree names a declared registry metric, and every declared
+    counter is recorded somewhere — the registry and the code cannot
+    drift apart in either direction. Since PR 7 this is enforced by
+    staticcheck's ``registry-drift`` AST rule (the source-scraping grep
+    this file used to carry is gone); the tests here pin the rule's
+    verdict on the real tree and prove both drift directions on
+    fixtures.
   * Exporter validity: a dumped trace is valid Chrome/Perfetto
     trace-event JSON (json.loads + the required keys on every event),
     and trace_summary's inclusive/exclusive accounting is coherent.
@@ -15,42 +18,16 @@ Three contracts:
 """
 
 import json
-import os
-import re
 import time
 
 import numpy as np
 import pytest
 
 import pipelinedp_tpu as pdp
-from pipelinedp_tpu import input_validators, pipeline_backend
+from pipelinedp_tpu import input_validators, pipeline_backend, staticcheck
 from pipelinedp_tpu.runtime import health as rt_health
 from pipelinedp_tpu.runtime import telemetry
 from pipelinedp_tpu.runtime import trace
-
-PACKAGE_ROOT = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "pipelinedp_tpu")
-
-# telemetry.record("name"...) / rt_telemetry.record("name"...) literals;
-# record_duration( does not match (no literal-name registry for the
-# free-form timing phases).
-_RECORD_LITERAL = re.compile(r"""\brecord\(\s*["']([A-Za-z0-9_]+)["']""")
-
-
-def _recorded_literals():
-    found = {}
-    for dirpath, _dirs, files in os.walk(PACKAGE_ROOT):
-        if "__pycache__" in dirpath:
-            continue
-        for fname in files:
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            with open(path) as f:
-                for name in _RECORD_LITERAL.findall(f.read()):
-                    found.setdefault(name, []).append(
-                        os.path.relpath(path, PACKAGE_ROOT))
-    return found
 
 
 @pytest.fixture(autouse=True)
@@ -64,21 +41,58 @@ def _trace_epoch():
 
 class TestRegistry:
 
-    def test_every_recorded_literal_is_declared(self):
-        recorded = _recorded_literals()
-        undeclared = set(recorded) - set(telemetry.REGISTRY)
-        assert not undeclared, (
-            f"telemetry.record() literals with no REGISTRY declaration: "
-            f"{ {n: recorded[n] for n in undeclared} } — declare them "
-            f"(name, kind, help) in runtime/telemetry.py")
+    @pytest.mark.staticcheck
+    def test_registry_and_source_agree_both_directions(self):
+        """The analyzer's registry-drift rule over the REAL tree: no
+        record() literal without a declaration, no declaration without a
+        recording site."""
+        tree = staticcheck.load_tree(staticcheck.default_paths())
+        found = staticcheck.analyze(
+            tree, only_rules=["registry-drift"]).active
+        assert found == [], "\n".join(f.render() for f in found)
 
-    def test_every_declared_counter_is_recorded(self):
-        recorded = _recorded_literals()
-        unrecorded = set(telemetry.REGISTRY) - set(recorded)
-        assert not unrecorded, (
-            f"REGISTRY declares counters no source file records: "
-            f"{sorted(unrecorded)} — dead metrics mislead receipt "
-            f"readers; drop them or wire them up")
+    @pytest.mark.staticcheck
+    def test_recorded_but_undeclared_literal_is_caught(self):
+        mods = [
+            staticcheck.parse_source(
+                "pipelinedp_tpu/runtime/telemetry.py",
+                "def _counter(name, help_text):\n"
+                "    return (name, 'counter', help_text)\n"
+                "REGISTRY = dict(a=_counter('used_counter', 'h'))\n"),
+            staticcheck.parse_source(
+                "pipelinedp_tpu/fix_user.py",
+                "from pipelinedp_tpu.runtime import telemetry\n"
+                "def f():\n"
+                "    telemetry.record('used_counter')\n"
+                "    telemetry.record('undeclared_counter')\n"),
+        ]
+        found = staticcheck.analyze(
+            mods, only_rules=["registry-drift"]).active
+        assert len(found) == 1
+        assert "undeclared_counter" in found[0].message
+        assert found[0].file == "pipelinedp_tpu/fix_user.py"
+
+    @pytest.mark.staticcheck
+    def test_declared_but_unrecorded_counter_is_caught(self):
+        mods = [
+            staticcheck.parse_source(
+                "pipelinedp_tpu/runtime/telemetry.py",
+                "def _counter(name, help_text):\n"
+                "    return (name, 'counter', help_text)\n"
+                "REGISTRY = dict(\n"
+                "    a=_counter('used_counter', 'h'),\n"
+                "    b=_counter('ghost_counter', 'h'))\n"),
+            staticcheck.parse_source(
+                "pipelinedp_tpu/fix_user.py",
+                "from pipelinedp_tpu.runtime import telemetry\n"
+                "def f():\n"
+                "    telemetry.record('used_counter')\n"),
+        ]
+        found = staticcheck.analyze(
+            mods, only_rules=["registry-drift"]).active
+        assert len(found) == 1
+        assert "ghost_counter" in found[0].message
+        assert found[0].file == "pipelinedp_tpu/runtime/telemetry.py"
 
     def test_registry_entries_are_complete(self):
         for name, metric in telemetry.REGISTRY.items():
