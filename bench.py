@@ -447,6 +447,29 @@ def _bench_baseline_configs(jax, jnp, on_tpu):
     return detail
 
 
+# Span names whose exclusive time is device-side work (or the wait for
+# it): the fused-kernel dispatch/drain pair, the streaming accumulator's
+# append/grow, and every probed jit entry point.
+_DEVICE_SPANS = ("dispatch", "drain", "pipeline_append", "pipeline_grow")
+
+
+def _overlap_efficiency(summary, total_s):
+    """Device-busy fraction of a pipelined run, from span exclusive
+    times: the share of total wall time spent in device-side spans
+    (dispatch/drain/append/grow + jit:* probes). 1.0 means the device
+    never waited on host encode — the streaming executor's target; the
+    serial path's value is bounded by the host-encode share. Worker
+    -thread encode spans run on their own threads, so they do NOT
+    deflate this figure — overlap shows up as device spans covering
+    wall time that a serial run would spend blocked in `ingest`."""
+    if not total_s:
+        return None
+    busy = sum(stats["exclusive_s"]
+               for name, stats in summary["spans"].items()
+               if name in _DEVICE_SPANS or name.startswith("jit:"))
+    return round(min(busy / total_s, 1.0), 4)
+
+
 def _phase_breakdown(summary, total_s):
     """e2e phase breakdown from a trace summary: exclusive (self) wall
     seconds per span name. Every span in the traced run nests under the
@@ -491,6 +514,7 @@ def _bench_end_to_end(on_tpu):
     import pipelinedp_tpu as pdp
     from examples.movie_view_ratings import netflix_format
     from pipelinedp_tpu import ingest
+    from pipelinedp_tpu.runtime import telemetry as rt_telemetry
     from pipelinedp_tpu.runtime import trace as rt_trace
 
     n = 8_000_000 if on_tpu else 400_000
@@ -538,6 +562,41 @@ def _bench_end_to_end(on_tpu):
         rt_trace.dump(trace_path)
     breakdown = _phase_breakdown(summary, warm_sec)
     rt_trace.reset()
+
+    # --- Pipelined end-to-end: the device-resident streaming executor
+    # (ChunkSource -> thread-pool encode -> bounded staging queue ->
+    # donated device accumulator). The serial warm number above stays in
+    # the receipt as the comparison baseline. Two warm runs: the first
+    # warms the pipeline-specific jit entries (append/grow), the second
+    # measures steady state AND proves the persistent compile cache —
+    # its jit_cache_misses delta must be 0 (bucketed padding lands every
+    # row shape on the bucket the serial warm run already compiled).
+    def run_pipelined():
+        start = time.perf_counter()
+        chunks = ((u, m, r.astype(np.float32)) for u, m, r in
+                  netflix_format.parse_file_chunks(path))
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                               total_delta=1e-6)
+        engine = pdp.DPEngine(
+            accountant,
+            pdp.TPUBackend(noise_seed=13, encode_threads=2))
+        result = engine.aggregate(pdp.ChunkSource(chunks), params,
+                                  extractors)
+        accountant.compute_budgets()
+        n_kept = sum(1 for _ in result)
+        return time.perf_counter() - start, n_kept
+
+    with rt_trace.scoped():
+        pipelined_warm1_sec, _ = run_pipelined()
+    rt_trace.reset()
+    misses_before = rt_telemetry.snapshot()
+    with rt_trace.scoped():
+        with rt_trace.span("e2e_pipelined"):
+            pipelined_sec, n_kept_pipelined = run_pipelined()
+        pipelined_summary = rt_trace.trace_summary()
+    second_warm_misses = rt_telemetry.delta(misses_before).get(
+        "jit_cache_misses", 0)
+    rt_trace.reset()
     os.unlink(path)
     # Note for cross-round comparisons: rounds <= 4 reported a single
     # compile-inclusive "end_to_end_sec"; that old key corresponds to
@@ -549,6 +608,15 @@ def _bench_end_to_end(on_tpu):
         "end_to_end_sec_warm": round(warm_sec, 3),
         "end_to_end_rows_per_sec_warm": round(n / warm_sec),
         "end_to_end_kept_partitions": n_kept_warm,
+        "e2e_sec_pipelined": round(pipelined_sec, 3),
+        "e2e_sec_pipelined_first_warm": round(pipelined_warm1_sec, 3),
+        "e2e_rows_per_sec_pipelined": round(n / pipelined_sec),
+        "e2e_overlap_efficiency": _overlap_efficiency(pipelined_summary,
+                                                      pipelined_sec),
+        "e2e_pipelined_kept_partitions": n_kept_pipelined,
+        # 0 == every row shape of the second warm pipelined call hit the
+        # persistent compile cache (the bucketed-padding guarantee).
+        "e2e_pipelined_second_warm_jit_cache_misses": second_warm_misses,
         "e2e_phase_breakdown": breakdown,
         "trace_summary": {
             "spans": dict(list(summary["spans"].items())[:12]),

@@ -54,6 +54,12 @@ from pipelinedp_tpu.pipeline_backend import (
     register_annotator,
     Annotator,
 )
+# The chunked streaming entry for DPEngine.aggregate/select_partitions:
+# wrap an iterable of (pid_raw, pk_raw, values) column chunks and the
+# executor streams it through the device-resident pipeline
+# (runtime/pipeline.py) under the backend's encode_threads /
+# pipeline_depth knobs.
+from pipelinedp_tpu.runtime.pipeline import ChunkSource
 
 # Beam/Spark backends exist only when the corresponding framework is
 # importable (reference exports them unconditionally from

@@ -20,6 +20,14 @@ privacy id's rows are co-located on one shard — device-resident
 streamed-ingest columns take the on-device all_to_all reshard
 (parallel/reshard.py) and never revisit the host between ingest and
 dispatch; host rows take the exact load-balanced host permutation.
+
+Streamed input: passing a runtime.pipeline.ChunkSource (an iterable of
+(pid_raw, pk_raw, values) column chunks) as `col` routes encoding
+through the device-resident streaming executor — host thread-pool
+factorization feeding a bounded staging queue, rows accumulating into
+donated device buffers — under TPUBackend(encode_threads=,
+pipeline_depth=). Pipelined and serial execution are bit-identical
+(README "End-to-end pipeline").
 """
 
 import functools
@@ -71,7 +79,11 @@ class DPEngine:
         """Computes DP aggregate metrics.
 
         Args:
-          col: collection of same-typed elements.
+          col: collection of same-typed elements — or, on a TPUBackend,
+            a pre-encoded columnar.EncodedData or a
+            runtime.pipeline.ChunkSource of raw column chunks (streamed
+            through the device-resident pipeline; extractors are not
+            consulted for either).
           params: metrics to compute and computation parameters.
           data_extractors: how to obtain (privacy_id, partition_key, value)
             from an element.

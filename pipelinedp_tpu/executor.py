@@ -53,7 +53,9 @@ from pipelinedp_tpu.ops import noise as noise_ops
 from pipelinedp_tpu.ops import secure_noise
 from pipelinedp_tpu.ops import segment_ops
 from pipelinedp_tpu.ops import selection_ops
+from pipelinedp_tpu.runtime import pipeline as rt_pipeline
 from pipelinedp_tpu.runtime import trace as rt_trace
+from pipelinedp_tpu.runtime import watchdog as rt_watchdog
 
 
 def _ftype():
@@ -1103,6 +1105,42 @@ def resolve_n_partitions(backend, n_partitions: int) -> int:
     return n_partitions
 
 
+def stream_chunk_source(backend, source, public_list=None):
+    """Chunked entry of the lazy drivers: encodes a runtime.pipeline
+    ChunkSource through the streaming executor (thread-pool encode +
+    bounded staging queue + device-resident bucket accumulation) under
+    the backend's encode_threads / pipeline_depth knobs and watchdog.
+
+    Returns a device-resident EncodedData pre-padded to the pad_rows
+    bucket — bit-identical kernel inputs to the serial encode of the
+    same chunks, so pipelined and serial runs release the same noise.
+    """
+    wd = getattr(backend, "watchdog", None)
+    timeout_s = getattr(backend, "timeout_s", None)
+    if wd is None and timeout_s is not None:
+        wd = rt_watchdog.Watchdog(timeout_s=timeout_s)
+    threads = getattr(backend, "encode_threads", None)
+    if threads is None:
+        threads = rt_pipeline.default_encode_threads()
+    from pipelinedp_tpu import ingest
+    with rt_watchdog.activate(wd):
+        return ingest.stream_encode_columns(
+            source.chunks,
+            public_partitions=public_list,
+            nonfinite=source.nonfinite,
+            encode_threads=threads,
+            pipeline_depth=getattr(backend, "pipeline_depth", None))
+
+
+def _encode_input(backend, rows, data_extractors, public_list=None):
+    """Shared encode stage of the lazy drivers: ChunkSource streams
+    through the pipeline, everything else takes columnar.encode."""
+    if isinstance(rows, rt_pipeline.ChunkSource):
+        return stream_chunk_source(backend, rows, public_list)
+    with rt_trace.span("encode"):
+        return columnar.encode(rows, data_extractors, public_list)
+
+
 def lazy_select_partitions(backend, col, params, data_extractors,
                            budget_accountant, report_generator):
     """Graph-time setup + lazily executed device partition selection.
@@ -1125,8 +1163,7 @@ def lazy_select_partitions(backend, col, params, data_extractors,
     rows = col
 
     def generator():
-        with rt_trace.span("encode"):
-            encoded = columnar.encode(rows, data_extractors)
+        encoded = _encode_input(backend, rows, data_extractors)
         selection = selection_ops.selection_params_from_host(
             strategy, budget.eps, budget.delta,
             params.max_partitions_contributed, params.pre_threshold)
@@ -1277,14 +1314,30 @@ def _round_up_pow2(n: int) -> int:
     return 1 << max(0, (n - 1).bit_length())
 
 
+def row_bucket(n: int) -> int:
+    """Power-of-two row-count bucket (floor 8).
+
+    THE row-shape bucketing of the whole package: pad_rows pads datasets
+    to it, and the streaming executor's device accumulator
+    (runtime/pipeline.DeviceRowAccumulator) sizes its chunk buffers and
+    final columns to it — so every row shape entering the persistent jit
+    entry points lands on one of ~log2(n) buckets and repeated calls
+    with varying chunk/dataset sizes hit the compile cache instead of
+    retracing (the `jit_cache_misses` delta in the bench receipt proves
+    it: 0 on the second warm end-to-end call)."""
+    return max(8, _round_up_pow2(n))
+
+
 def pad_rows(encoded: columnar.EncodedData):
-    """Pads row arrays to the next power of two (invalid-marked), so jit
-    compilation is reused across datasets of similar size.
+    """Pads row arrays to the power-of-two row bucket (invalid-marked),
+    so jit compilation is reused across datasets of similar size.
 
     Device-resident encodings (ingest.stream_encode_columns) pad with jnp
-    on device — a host round-trip here would undo the streamed upload."""
+    on device — a host round-trip here would undo the streamed upload.
+    Pipelined encodings arrive already padded to exactly this bucket
+    (DeviceRowAccumulator.finalize), so this is a no-op for them."""
     n = encoded.n_rows
-    n_pad = max(8, _round_up_pow2(n))
+    n_pad = row_bucket(n)
     if n_pad == n:
         return (encoded.pid, encoded.pk, encoded.values,
                 encoded.valid)
@@ -1360,8 +1413,7 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
     rows = col  # materialized at execution time
 
     def generator():
-        with rt_trace.span("encode"):
-            encoded = columnar.encode(rows, data_extractors, public_list)
+        encoded = _encode_input(backend, rows, data_extractors, public_list)
         if Metrics.VECTOR_SUM in (params.metrics or []):
             expected = (params.vector_size,)
             got = encoded.values.shape[1:]
@@ -1460,8 +1512,15 @@ def _decode_rows(outputs, row_idx_pairs, partition_vocab: Sequence[Any],
     CompoundCombiner.compute_metrics on the generic path.
     """
     with rt_trace.span("drain"):
-        # The np.asarray forces each output column to host: on the async
-        # dense path this wait IS the device execution + transfer time.
+        # Start every output column's device->host copy before the first
+        # blocking materialization: the transfers overlap each other (and
+        # any remaining device execution), and the np.asarray barrier
+        # below then waits once for the batch instead of paying one
+        # serial round trip per column. On the async dense path that one
+        # wait IS the device execution + transfer time.
+        for col in outputs.values():
+            if isinstance(col, jax.Array):
+                rt_pipeline.copy_to_host_async(col)
         outputs_np = {name: np.asarray(col) for name, col in outputs.items()}
     field_order: List[str] = [
         name for entry in build_plan(compound) for name in entry.outputs

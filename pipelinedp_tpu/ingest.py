@@ -8,6 +8,17 @@ chunk i overlaps the host parse/factorization of chunk i+1. On the 1-core
 bench host that overlap — not host parallelism — is what moves end-to-end
 time toward max(host encode, device transfer) instead of their sum.
 
+With encode_threads >= 1 the same entry point routes through the
+device-resident streaming executor (runtime/pipeline.py): the heavy,
+order-independent half of vocabulary encoding (chunk_factorize) runs per
+chunk on a host thread pool feeding a bounded staging queue, the cheap
+sequential half (ChunkedVocabEncoder.merge) stitches the global
+vocabulary in stream order on the consumer, and rows accumulate into
+persistent, buffer-donated device buffers (DeviceRowAccumulator) sized
+to the executor.pad_rows power-of-two buckets — so the pipelined
+encoding is bit-identical to the serial one, down to the padded kernel
+input arrays.
+
 The result is a device-resident EncodedData whose columns are jax arrays;
 the executor pads it on device (executor.pad_rows) and the engine accepts
 it directly in place of a row collection (columnar.encode passthrough), so
@@ -50,6 +61,37 @@ def _kind_group(dtype) -> str:
     return "obj"
 
 
+def chunk_factorize(raw) -> Tuple[np.ndarray, np.ndarray]:
+    """Chunk-local factorization: (int32 codes, uniques in
+    first-occurrence order).
+
+    The order-independent, C-speed half of ChunkedVocabEncoder.encode —
+    pure and thread-safe, so the streaming executor
+    (runtime/pipeline.py) can run it per chunk on the host thread pool
+    while the cheap sequential half (``ChunkedVocabEncoder.merge``)
+    stitches the global vocabulary in stream order on the consumer.
+    """
+    raw = columnar._as_key_array(raw)
+    if _pd is not None:
+        codes, uniques = _pd.factorize(raw, use_na_sentinel=False)
+        return codes.astype(np.int32), np.asarray(uniques)
+    codes, uniques = columnar.factorize(raw)
+    uniques = np.asarray(uniques)
+    # Normalize the chunk's uniques to first-occurrence order
+    # (factorize's np.unique branch yields sorted order) so new global
+    # codes are assigned exactly as one factorize over the concatenation
+    # would.
+    if len(uniques) > 1:
+        _, first_idx = np.unique(codes, return_index=True)
+        perm = np.argsort(first_idx)
+        if not np.array_equal(perm, np.arange(len(perm))):
+            inv = np.empty_like(perm)
+            inv[perm] = np.arange(len(perm))
+            codes = inv[codes].astype(np.int32)
+            uniques = uniques[perm]
+    return codes.astype(np.int32), uniques
+
+
 class ChunkedVocabEncoder:
     """Incremental first-occurrence vocabulary encoding across chunks.
 
@@ -76,11 +118,19 @@ class ChunkedVocabEncoder:
         self._dict: Optional[dict] = None  # unorderable-key last resort
 
     def encode(self, raw) -> np.ndarray:
-        # _as_key_array directly: np.asarray first would explode composite
-        # (tuple) keys into a 2-D array instead of object elements.
-        raw = columnar._as_key_array(raw)
+        # _as_key_array inside chunk_factorize: np.asarray first would
+        # explode composite (tuple) keys into a 2-D array instead of
+        # object elements.
+        return self.merge(*chunk_factorize(raw))
+
+    def merge(self, codes: np.ndarray, uniques: np.ndarray) -> np.ndarray:
+        """Sequential half of encode(): remaps one chunk's local codes
+        (with uniques in first-occurrence order, from chunk_factorize)
+        into the global vocabulary. Feeding chunks in stream order keeps
+        the global codes identical to a single factorize over the
+        concatenation — the pipelined encode calls this on the consumer
+        while workers factorize chunks ahead."""
         if _pd is not None:
-            codes, uniques = _pd.factorize(raw, use_na_sentinel=False)
             uniques = _pd.Index(uniques)
             if self._index is None:
                 self._index = uniques
@@ -92,21 +142,6 @@ class ChunkedVocabEncoder:
                     int(is_new.sum()))
                 self._index = self._index.append(uniques[is_new])
             return mapped.astype(np.int32)[codes]
-        # No pandas: chunk-local factorize, then a vectorized remap.
-        codes, uniques = columnar.factorize(raw)
-        uniques = np.asarray(uniques)
-        # Normalize the chunk's uniques to first-occurrence order
-        # (factorize's np.unique branch yields sorted order) so new global
-        # codes are assigned exactly as one factorize over the
-        # concatenation would.
-        if len(uniques) > 1:
-            _, first_idx = np.unique(codes, return_index=True)
-            perm = np.argsort(first_idx)
-            if not np.array_equal(perm, np.arange(len(perm))):
-                inv = np.empty_like(perm)
-                inv[perm] = np.arange(len(perm))
-                codes = inv[codes].astype(np.int32)
-                uniques = uniques[perm]
         if self._dict is not None:
             return self._remap_dict(codes, uniques)
         try:
@@ -252,14 +287,77 @@ class ChunkedVocabEncoder:
         return len(self._dict or ())
 
 
+@dataclasses.dataclass
+class _PreparedChunk:
+    """One chunk's thread-pool encode output: chunk-local vocab codes +
+    uniques (first-occurrence order) awaiting the sequential merge."""
+    pid_codes: np.ndarray
+    pid_uniques: np.ndarray
+    pk_codes: np.ndarray  # vocab-final when publicly encoded
+    pk_uniques: Optional[np.ndarray]  # None when pk was publicly encoded
+    values: np.ndarray
+
+
+def _prepare_chunk(chunk, partition_vocab, nonfinite,
+                   value_dtype) -> _PreparedChunk:
+    """Order-independent host encode of one chunk (runs on the encode
+    thread pool): factorize keys, validate values. The sequential
+    vocabulary merge happens on the consumer (ChunkedVocabEncoder.merge),
+    so parallel workers can never reorder code assignment."""
+    pid_raw, pk_raw, values = chunk
+    pid_codes, pid_uniques = chunk_factorize(pid_raw)
+    if partition_vocab is not None:
+        pk_codes = columnar.encode_with_vocab(
+            columnar._as_key_array(pk_raw), partition_vocab)
+        pk_uniques = None
+    else:
+        pk_codes, pk_uniques = chunk_factorize(pk_raw)
+    values = np.asarray(values, dtype=value_dtype)
+    bad = columnar.nonfinite_value_rows(values, nonfinite)
+    if bad is not None:
+        pk_codes = np.where(bad, np.int32(-1), pk_codes).astype(np.int32)
+        mask = bad if values.ndim == 1 else bad[:, None]
+        values = np.where(mask, 0.0, values).astype(value_dtype)
+    return _PreparedChunk(pid_codes, pid_uniques, pk_codes, pk_uniques,
+                          values)
+
+
+def _pad_chunk_rows(pid, pk, values, cap: int):
+    """Pads one chunk to `cap` rows with the executor.pad_rows pad values
+    (pid 0, pk -1, values 0) for the donating device accumulator."""
+    n = len(pid)
+    if cap == n:
+        return pid, pk, values
+    pad = cap - n
+    pid = np.concatenate([pid, np.zeros(pad, np.int32)])
+    pk = np.concatenate([pk, np.full(pad, -1, np.int32)])
+    values = np.concatenate(
+        [values, np.zeros((pad,) + values.shape[1:], values.dtype)])
+    return pid, pk, values
+
+
 def stream_encode_columns(
         chunks: Iterable[Tuple[Sequence[Any], Sequence[Any],
                                Sequence[float]]],
         public_partitions: Optional[Sequence[Any]] = None,
-        nonfinite: str = "error"
+        nonfinite: str = "error",
+        encode_threads: int = 0,
+        pipeline_depth: Optional[int] = None
 ) -> columnar.EncodedData:
     """Encodes and uploads (pid_raw, pk_raw, values) column chunks,
     overlapping each chunk's device copy with the next chunk's parsing.
+
+    encode_threads=0 (the default) is the serial path: one loop,
+    device copies overlapping the next chunk's parse only through jax's
+    async dispatch. encode_threads >= 1 routes through the streaming
+    executor (runtime/pipeline.py): chunk parse/factorize runs on a host
+    thread pool feeding a bounded staging queue (window =
+    ``pipeline_depth``, default the shared PIPELINE_DEPTH), the
+    sequential vocabulary merge and device accumulation run on the
+    consumer, and rows accumulate into persistent device buffers
+    (power-of-two row buckets, donated across appends). Both paths
+    yield bit-identical kernel inputs — the pipelined EncodedData
+    arrives pre-padded to exactly the executor.pad_rows bucket.
 
     Non-finite VALUES are rejected per chunk (nonfinite="error", the
     default) or dropped with a warning (nonfinite="drop") — a NaN/Inf
@@ -282,6 +380,22 @@ def stream_encode_columns(
     partition_vocab = None
     if public_partitions is not None:
         partition_vocab = list(dict.fromkeys(public_partitions))
+
+    def encoded_data(pid, pk, values):
+        return columnar.EncodedData(
+            pid=pid, pk=pk, values=values,
+            partition_vocab=(partition_vocab
+                             if partition_vocab is not None else
+                             pk_enc.vocabulary),
+            n_privacy_ids=len(pid_enc),
+            public_encoded=public_partitions is not None)
+
+    if encode_threads:
+        return _stream_encode_pipelined(chunks, partition_vocab, nonfinite,
+                                        value_dtype, pid_enc, pk_enc,
+                                        encoded_data, encode_threads,
+                                        pipeline_depth)
+
     dev_pid, dev_pk, dev_vals = [], [], []
     # The ingest span covers parse+factorize+upload for the whole stream;
     # its row count attribute lets trace summaries report ingest rate.
@@ -312,15 +426,59 @@ def stream_encode_columns(
             dev_pid, dev_pk = [empty], [empty]
             dev_vals = [jnp.zeros(0, value_dtype)]
         ingest_span.set(rows=n_rows)
-        return columnar.EncodedData(
-            pid=jnp.concatenate(dev_pid),
-            pk=jnp.concatenate(dev_pk),
-            values=jnp.concatenate(dev_vals),
-            partition_vocab=(partition_vocab
-                             if partition_vocab is not None else
-                             pk_enc.vocabulary),
-            n_privacy_ids=len(pid_enc),
-            public_encoded=public_partitions is not None)
+        return encoded_data(jnp.concatenate(dev_pid),
+                            jnp.concatenate(dev_pk),
+                            jnp.concatenate(dev_vals))
+
+
+def _stream_encode_pipelined(chunks, partition_vocab, nonfinite,
+                             value_dtype, pid_enc, pk_enc, encoded_data,
+                             encode_threads: int,
+                             pipeline_depth: Optional[int]
+                             ) -> columnar.EncodedData:
+    """The pipelined body of stream_encode_columns: thread-pool chunk
+    factorization -> bounded staging queue -> sequential vocab merge ->
+    device-resident bucket accumulation (runtime/pipeline.py)."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from pipelinedp_tpu import executor
+    from pipelinedp_tpu.runtime import pipeline as rt_pipeline
+    from pipelinedp_tpu.runtime import trace as rt_trace
+
+    acc = rt_pipeline.DeviceRowAccumulator()
+    worker = functools.partial(_prepare_chunk,
+                               partition_vocab=partition_vocab,
+                               nonfinite=nonfinite,
+                               value_dtype=value_dtype)
+    with rt_trace.span("ingest", threads=encode_threads) as ingest_span:
+        n_rows = 0
+        for idx, prep in enumerate(
+                rt_pipeline.map_overlapped(chunks, worker, encode_threads,
+                                           pipeline_depth)):
+            # Sequential merge in stream order: global codes are exactly
+            # what the serial encode assigns.
+            pid = pid_enc.merge(prep.pid_codes, prep.pid_uniques)
+            if partition_vocab is not None:
+                pk = prep.pk_codes
+            else:
+                pk = pk_enc.merge(prep.pk_codes, prep.pk_uniques)
+            n = len(pid)
+            n_rows += n
+            values = prep.values
+            if n == 0:
+                continue
+            if acc.donating:
+                pid, pk, values = _pad_chunk_rows(
+                    pid, pk, values, executor.row_bucket(n))
+            acc.append(pid, pk, values, n, chunk=idx)
+        ingest_span.set(rows=n_rows)
+        bufs = acc.finalize()
+        if bufs is None:
+            empty = jnp.zeros(0, jnp.int32)
+            return encoded_data(empty, empty, jnp.zeros(0, value_dtype))
+        return encoded_data(*bufs)
 
 
 # --- Multi-host ingest -----------------------------------------------------

@@ -79,6 +79,7 @@ from pipelinedp_tpu.parallel.mesh import host_fetch, round_capacity
 from pipelinedp_tpu.runtime import entry as rt_entry
 from pipelinedp_tpu.runtime import faults as rt_faults
 from pipelinedp_tpu.runtime import journal as rt_journal
+from pipelinedp_tpu.runtime import pipeline as rt_pipeline
 from pipelinedp_tpu.runtime import retry as rt_retry
 from pipelinedp_tpu.runtime import telemetry as rt_telemetry
 from pipelinedp_tpu.runtime import trace as rt_trace
@@ -88,9 +89,11 @@ from pipelinedp_tpu.runtime import watchdog as rt_watchdog
 # most this many block kernels in flight, and _StagedDrain keeps at most
 # this many blocks' O(kept) result buffers staged. The residency reasoning
 # (in-flight outputs + staged drains both bounded by the same window, so
-# HBM holds O(depth * C), never O(P)) only holds while these agree —
-# derive both from here, never tune one alone.
-PIPELINE_DEPTH = 8
+# HBM holds O(depth * C), never O(P)) only holds while these agree. The
+# constant itself moved to runtime/pipeline.py — the streaming ingest
+# executor bounds its staging window with the SAME depth — and is
+# re-exported here because the blocked path made the name public first.
+PIPELINE_DEPTH = rt_pipeline.PIPELINE_DEPTH
 
 # Key lane for OOM-re-planned block generations: block keys must be a pure
 # function of (final_key, plan generation, block index) so that a RETRIED
@@ -410,29 +413,9 @@ def _dispatch_blocks(block_iter, consume,
     return n_dispatched
 
 
-# Platforms without async device->host copies warn once, not per block.
-_async_copy_unsupported = False
-
-
-def _copy_to_host_async(arr) -> None:
-    """Starts an async host copy where the platform supports it.
-
-    Only the unsupported-platform signatures (missing or unimplemented
-    method) are swallowed — a real runtime failure here is the same
-    failure consume()'s sync would hit and must stay visible there, not
-    vanish into a blanket except.
-    """
-    global _async_copy_unsupported
-    if _async_copy_unsupported:
-        return
-    try:
-        arr.copy_to_host_async()
-    except (AttributeError, NotImplementedError) as e:
-        _async_copy_unsupported = True
-        logging.warning(
-            "copy_to_host_async is unsupported on this platform (%s: %s); "
-            "device->host drains will block at materialization instead of "
-            "overlapping. Warning once.", type(e).__name__, e)
+# The async-copy helper moved to runtime/pipeline.py (the dense
+# executor's drain shares it); the historical name stays importable.
+_copy_to_host_async = rt_pipeline.copy_to_host_async
 
 
 class _StagedDrain:

@@ -395,6 +395,23 @@ class TPUBackend(LocalBackend):
             leave fewer live devices raise
             runtime.MeshDegradationError naming the job_id and journal
             path a resume needs, and health() reports FAILED.
+        pipeline_depth: staging window of the streaming executor
+            (runtime/pipeline.py): at most this many encoded chunks in
+            flight between the host encode pool and the device
+            accumulator when aggregating a ChunkSource. None (default)
+            takes the shared PIPELINE_DEPTH (8) — the same depth that
+            bounds the blocked drivers' in-flight block kernels.
+            Backpressure: a full window stops the producer from pulling
+            new chunks, so host memory holds O(depth) chunks.
+        encode_threads: host thread pool size for chunk
+            parse/factorization on the streamed (ChunkSource) entry.
+            None (default) auto-sizes (min(4, cpu_count)); 0 forces the
+            serial chunk encode; >= 1 pipelines: chunk k+1 factorizes on
+            the pool while chunk k's columns land in the device-resident
+            accumulator. Pipelined and serial execution are
+            bit-identical — the accumulator reproduces executor.pad_rows
+            exactly, so the same compiled kernel sees the same arrays
+            and releases the same noise.
         trace: span-based pipeline tracing (runtime/trace.py). When
             True, every run records nested, job-scoped spans (stage
             phases, per-block dispatch/drain, reshard collectives with
@@ -420,7 +437,9 @@ class TPUBackend(LocalBackend):
                  watchdog=None,
                  elastic: bool = False,
                  min_devices: int = 1,
-                 trace: bool = False):
+                 trace: bool = False,
+                 pipeline_depth: Optional[int] = None,
+                 encode_threads: Optional[int] = None):
         super().__init__(seed=noise_seed)
         if reshard not in ("auto", "host", "device"):
             raise ValueError(
@@ -441,6 +460,12 @@ class TPUBackend(LocalBackend):
         input_validators.validate_elastic(elastic, "TPUBackend")
         input_validators.validate_min_devices(min_devices, "TPUBackend")
         input_validators.validate_trace(trace, "TPUBackend")
+        if pipeline_depth is not None:
+            input_validators.validate_pipeline_depth(
+                pipeline_depth, "TPUBackend")
+        if encode_threads is not None:
+            input_validators.validate_encode_threads(
+                encode_threads, "TPUBackend")
         self.mesh = mesh
         self.max_partitions = max_partitions
         self.noise_seed = noise_seed
@@ -456,6 +481,8 @@ class TPUBackend(LocalBackend):
         self.elastic = elastic
         self.min_devices = min_devices
         self.trace = trace
+        self.pipeline_depth = pipeline_depth
+        self.encode_threads = encode_threads
         if trace:
             from pipelinedp_tpu.runtime import trace as rt_trace
             rt_trace.enable()

@@ -56,6 +56,13 @@ semantics:
     trace_summary (top spans by inclusive/exclusive wall time,
     transferred bytes, compile seconds per entry point) — the layer
     that attributes the kernel-vs-end-to-end throughput gap.
+  * pipeline — the device-resident streaming executor: a bounded
+    staging queue fed by a host encode thread pool (ChunkSource ->
+    map_overlapped) and a buffer-donating device accumulator
+    (DeviceRowAccumulator) that together turn DPEngine.aggregate over
+    chunked input into an overlapped ingest -> aggregate -> drain
+    pipeline — bit-identical to serial execution (same pad_rows
+    buckets, same noise keys, zero duplicate ledger registrations).
 
 The privacy invariants this package leans on are documented in README
 "Failure semantics": mechanisms register with the BudgetAccountant at
@@ -68,9 +75,12 @@ is a replay of the same release, not a second one.
 from pipelinedp_tpu.runtime import entry
 from pipelinedp_tpu.runtime import faults
 from pipelinedp_tpu.runtime import health
+from pipelinedp_tpu.runtime import pipeline
 from pipelinedp_tpu.runtime import telemetry
 from pipelinedp_tpu.runtime import trace
 from pipelinedp_tpu.runtime.health import HealthState, JobHealth
+from pipelinedp_tpu.runtime.pipeline import (PIPELINE_DEPTH, ChunkSource,
+                                             DeviceRowAccumulator)
 from pipelinedp_tpu.runtime.journal import (BlockJournal,
                                             JournalCorruptionError)
 from pipelinedp_tpu.runtime.retry import (BlockOOMError,
@@ -84,15 +94,19 @@ __all__ = [
     "BlockJournal",
     "BlockOOMError",
     "BlockTimeoutError",
+    "ChunkSource",
+    "DeviceRowAccumulator",
     "HealthState",
     "JobHealth",
     "JournalCorruptionError",
     "MeshDegradationError",
+    "PIPELINE_DEPTH",
     "RetryPolicy",
     "Watchdog",
     "entry",
     "faults",
     "health",
+    "pipeline",
     "is_device_fatal",
     "retry_call",
     "run_with_degradation",

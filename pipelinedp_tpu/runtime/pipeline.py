@@ -1,0 +1,428 @@
+"""Device-resident streaming executor: overlapped ingest -> aggregate -> drain.
+
+PR 6's ``e2e_phase_breakdown`` proved the ~200x kernel-vs-end-to-end gap
+is NOT the DP math: host-side encode, per-call dispatch/compile round
+trips and serialized engine stages dominate the warm path. This module is
+the engine's answer — the pieces that turn ``DPEngine.aggregate`` into a
+device-resident pipeline instead of one serial batch call:
+
+  * **Bounded staging queue + encode thread pool** (``map_overlapped``) —
+    chunk *k+1* parses/factorizes on a small host thread pool while chunk
+    *k*'s columns land on device. The window is bounded by the shared
+    ``PIPELINE_DEPTH`` (the same depth that bounds the blocked drivers'
+    in-flight block kernels and staged drains), so host memory holds
+    O(depth) chunks, never the whole stream. Backpressure is a
+    semaphore: a stalled consumer stops the producer from pulling new
+    chunks. The consumer's waits heartbeat the active watchdog and run
+    under ``pipeline_wait`` guards, so a stalled queue (a hung producer,
+    a wedged encode worker) surfaces as a BlockTimeoutError instead of a
+    silent hang.
+  * **Device-resident chunk accumulator** (``DeviceRowAccumulator``) —
+    encoded chunks append into persistent device buffers sized to
+    power-of-two row buckets (``executor.row_bucket``, the same buckets
+    ``pad_rows`` uses), with the previous buffer DONATED to XLA on every
+    append/grow so steady-state appends reuse device memory instead of
+    allocating per chunk. ``finalize()`` returns buffers bit-identical
+    to ``executor.pad_rows`` over the concatenated rows — pipelined and
+    serial execution therefore feed the fused kernel the exact same
+    arrays and release the exact same noise.
+  * **ChunkSource** — the engine-level chunked entry: wrap an iterable of
+    ``(pid_raw, pk_raw, values)`` column chunks and hand it to
+    ``DPEngine.aggregate`` / ``select_partitions`` in place of a row
+    collection; the executor routes it through the pipelined
+    ``ingest.stream_encode_columns`` under the backend's
+    ``encode_threads`` / ``pipeline_depth`` knobs.
+  * **Overlapped drain** (``copy_to_host_async``) — the shared
+    async-copy helper (moved here from parallel/large_p.py so the
+    executor's dense drain can use it without an import cycle): result
+    columns start their device->host copies together and block only at
+    the final materialization barrier.
+
+Failure semantics compose with the rest of the runtime: encode-worker
+exceptions re-raise in the consumer (the original exception, so
+``nonfinite="error"`` still surfaces as ValueError), an OOM mid-pipeline
+(hooked for fault injection at the append site) aborts the stream before
+any DP release — re-running under the same ``noise_seed`` replays the
+identical release with zero duplicate budget registrations, because
+mechanisms register at graph-build time and noise keys derive from the
+seed, never from execution history.
+
+Static discipline: this module is covered by staticcheck's host-transfer
+rule (like parallel/ and ops/) — staging-queue consumers must route any
+device->host fetch through ``mesh.host_fetch``; the module itself
+performs none (chunks flow host->device only, drains happen in the
+executor at the final barrier).
+"""
+
+import functools
+import logging
+import queue
+import threading
+from concurrent import futures as _futures
+from typing import Any, Iterable, Iterator, Optional
+
+from pipelinedp_tpu.runtime import faults as rt_faults
+from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+from pipelinedp_tpu.runtime import trace as rt_trace
+from pipelinedp_tpu.runtime import watchdog as rt_watchdog
+
+# One shared depth for every async pipeline in the package: the blocked
+# drivers keep at most this many block kernels in flight and this many
+# blocks' drains staged (parallel/large_p.py re-exports it), and the
+# streaming ingest keeps at most this many encoded chunks in its staging
+# window. The residency reasoning (host and HBM both hold O(depth)
+# intermediates, never O(stream)) only holds while these agree — derive
+# all of them from here, never tune one alone.
+PIPELINE_DEPTH = 8
+
+_POLL_S = 0.05
+
+
+def default_encode_threads() -> int:
+    """Auto thread count for the host encode pool: enough to overlap
+    parse/factorize with device work without oversubscribing a small
+    host (the bench host has one core; encode is numpy/pandas C code
+    that releases the GIL, so even one worker overlaps the consumer's
+    device appends)."""
+    import os
+    return max(1, min(4, os.cpu_count() or 1))
+
+
+class ChunkSource:
+    """Marks an iterable of ``(pid_raw, pk_raw, values)`` column chunks as
+    a streaming input for ``DPEngine.aggregate`` / ``select_partitions``.
+
+    The executor routes a ChunkSource through the pipelined
+    ``ingest.stream_encode_columns`` (host thread-pool encode, bounded
+    staging queue, device-resident accumulation) under the backend's
+    ``encode_threads`` / ``pipeline_depth`` knobs — the bulk-file
+    counterpart of handing the engine Python rows, minus the serial
+    encode stall.
+
+    nonfinite: per-chunk NaN/Inf value policy ("error" | "drop"), the
+        same semantics as ``ingest.stream_encode_columns``.
+    """
+
+    def __init__(self, chunks: Iterable, nonfinite: str = "error"):
+        if nonfinite not in ("error", "drop"):
+            raise ValueError(
+                f"nonfinite must be error|drop, got {nonfinite!r}")
+        self.chunks = chunks
+        self.nonfinite = nonfinite
+
+
+def _validate_window(encode_threads: int, depth: int) -> None:
+    if not isinstance(encode_threads, int) or isinstance(
+            encode_threads, bool) or encode_threads < 1:
+        raise ValueError(f"encode_threads must be an integer >= 1 inside "
+                         f"the pipeline, got {encode_threads!r}")
+    if not isinstance(depth, int) or isinstance(depth,
+                                                bool) or depth < 1:
+        raise ValueError(
+            f"pipeline_depth must be an integer >= 1, got {depth!r}")
+
+
+def _staged_get(q: "queue.Queue", idx: int):
+    """Queue pop under the active watchdog (if any): a stalled staging
+    queue expires the ``pipeline_wait`` guard and surfaces as a
+    BlockTimeoutError instead of wedging the consumer."""
+    wd = rt_watchdog.active()
+    if wd is None:
+        return q.get()
+    with wd.guard("pipeline_wait", idx) as g:
+        while True:
+            try:
+                return q.get(timeout=_POLL_S)
+            except queue.Empty:
+                g.raise_if_expired()
+
+
+def _staged_result(fut: "_futures.Future", idx: int):
+    """Future wait under the active watchdog (see _staged_get); worker
+    exceptions re-raise here as their original type."""
+    wd = rt_watchdog.active()
+    if wd is None:
+        return fut.result()
+    with wd.guard("pipeline_wait", idx) as g:
+        while True:
+            try:
+                return fut.result(timeout=_POLL_S)
+            except _futures.TimeoutError:
+                g.raise_if_expired()
+
+
+def map_overlapped(items: Iterable,
+                   fn,
+                   encode_threads: int,
+                   depth: Optional[int] = None) -> Iterator[Any]:
+    """Ordered overlapped map: yields ``fn(item)`` in input order while up
+    to ``depth`` items are in flight across ``encode_threads`` workers.
+
+    The staging discipline of the streaming executor:
+
+      * a feeder thread pulls from ``items`` and submits encode tasks,
+        blocking on a depth-bounded semaphore (backpressure: a slow
+        consumer stops the producer — host memory holds O(depth) chunks);
+      * results are consumed strictly in submission order, so downstream
+        sequential state (the incremental vocabulary merge) sees chunks
+        exactly as a serial loop would — pipelined and serial encode are
+        bit-identical by construction;
+      * consumer waits heartbeat the active watchdog and run under
+        ``pipeline_wait`` guards (a stalled queue raises
+        BlockTimeoutError at the deadline);
+      * a worker exception re-raises in the consumer as its original
+        type as soon as its chunk's turn comes; a producer (iterator)
+        exception re-raises likewise.
+    """
+    depth = PIPELINE_DEPTH if depth is None else depth
+    _validate_window(encode_threads, depth)
+    q: "queue.Queue" = queue.Queue()
+    slots = threading.BoundedSemaphore(depth)
+    stop = threading.Event()
+    pool = _futures.ThreadPoolExecutor(max_workers=encode_threads,
+                                       thread_name_prefix="pdp-encode")
+
+    def encode(idx, item):
+        with rt_trace.span("pipeline_encode", chunk=idx):
+            return fn(item)
+
+    def feed():
+        try:
+            idx = 0
+            for item in items:
+                while not slots.acquire(timeout=_POLL_S):
+                    if stop.is_set():
+                        return
+                if stop.is_set():
+                    slots.release()
+                    return
+                q.put(("chunk", idx, pool.submit(encode, idx, item)))
+                idx += 1
+            q.put(("end", idx, None))
+        except BaseException as e:  # noqa: BLE001 - producer failures must surface in the consumer, not die silently on the feeder thread
+            q.put(("producer_error", -1, e))
+
+    feeder = threading.Thread(target=feed, name="pdp-pipeline-feed",
+                              daemon=True)
+    feeder.start()
+    n_consumed = 0
+    try:
+        while True:
+            tag, idx, payload = _staged_get(q, n_consumed)
+            if tag == "end":
+                return
+            if tag == "producer_error":
+                raise payload
+            try:
+                result = _staged_result(payload, idx)
+            finally:
+                slots.release()
+            wd = rt_watchdog.active()
+            if wd is not None:
+                wd.beat("pipeline")
+            rt_telemetry.record("pipeline_chunks", chunk=idx)
+            n_consumed += 1
+            yield result
+    finally:
+        stop.set()
+        pool.shutdown(wait=False, cancel_futures=True)
+
+
+# --- Overlapped device->host drains ----------------------------------------
+
+# Platforms without async device->host copies warn once, not per array.
+_async_copy_unsupported = False
+
+
+def copy_to_host_async(arr) -> None:
+    """Starts an async host copy where the platform supports it.
+
+    Shared by the blocked drivers' staged drains (parallel/large_p.py)
+    and the dense executor's result drain: starting every output
+    column's copy before the first blocking materialization turns N
+    serial device->host round trips into one overlapped batch fetched at
+    the final barrier.
+
+    Only the unsupported-platform signatures (missing or unimplemented
+    method) are swallowed — a real runtime failure here is the same
+    failure the blocking materialization would hit and must stay visible
+    there, not vanish into a blanket except.
+    """
+    global _async_copy_unsupported
+    if _async_copy_unsupported:
+        return
+    try:
+        arr.copy_to_host_async()
+    except (AttributeError, NotImplementedError) as e:
+        _async_copy_unsupported = True
+        logging.warning(
+            "copy_to_host_async is unsupported on this platform (%s: %s); "
+            "device->host drains will block at materialization instead of "
+            "overlapping. Warning once.", type(e).__name__, e)
+
+
+# --- Device-resident chunk accumulation ------------------------------------
+
+
+def _donation_supported() -> bool:
+    """Buffer donation is a no-op (with a warning) on the CPU backend;
+    the accumulator then stages chunks and concatenates once instead of
+    copying the whole buffer on every append."""
+    import jax
+    try:
+        return jax.default_backend() != "cpu"
+    except RuntimeError:  # backend init failed; stay conservative
+        return False
+
+
+@functools.lru_cache(maxsize=None)
+def _append_fn(donate: bool):
+    """Jitted chunk append: writes one bucket-padded chunk into the
+    persistent buffers at a traced row offset. With donate=True the
+    previous buffers are donated to XLA, so the append updates device
+    memory in place instead of allocating a fresh copy per chunk."""
+    import jax
+
+    def _append_impl(bufs, chunk, offset):
+        def upd(buf, part):
+            start = (offset,) + (0,) * (buf.ndim - 1)
+            return jax.lax.dynamic_update_slice(buf, part, start)
+
+        return tuple(upd(b, c) for b, c in zip(bufs, chunk))
+
+    jitted = jax.jit(_append_impl,
+                     donate_argnums=(0,) if donate else ())
+    return rt_trace.probe_jit("pipeline_append", jitted)
+
+
+@functools.lru_cache(maxsize=None)
+def _grow_fn(donate: bool):
+    """Jitted buffer growth to a larger power-of-two bucket; pad rows
+    carry the executor.pad_rows pad values (pid 0, pk -1, values 0) so
+    the tail is indistinguishable from a fresh pad."""
+    import jax
+    import jax.numpy as jnp
+
+    def _grow_impl(bufs, new_cap: int):
+        pid, pk, values = bufs
+
+        def grown(buf, fill):
+            out = jnp.full((new_cap,) + buf.shape[1:], fill, buf.dtype)
+            return jax.lax.dynamic_update_slice(out, buf,
+                                                (0,) * buf.ndim)
+
+        return grown(pid, 0), grown(pk, -1), grown(values, 0)
+
+    jitted = jax.jit(_grow_impl, static_argnames=("new_cap",),
+                     donate_argnums=(0,) if donate else ())
+    return rt_trace.probe_jit("pipeline_grow", jitted)
+
+
+def _pow2_at_least(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+class DeviceRowAccumulator:
+    """Device-resident row columns appended chunk by chunk.
+
+    Two modes, bit-identical results:
+
+      * **donating** (accelerators): persistent (pid, pk, values)
+        buffers sized to power-of-two row buckets; every append/grow
+        donates the previous buffers to XLA so device memory is reused
+        across chunks instead of reallocated. Appended chunks must
+        arrive bucket-padded with the pad_rows pad values (pid 0, pk -1,
+        values 0) — the pad tail of chunk *k* is overwritten by chunk
+        *k+1* and the final tail IS the pad.
+      * **staged** (CPU, where donation is a warned no-op and an
+        in-place append would copy the whole buffer per chunk): chunks
+        stage as individual device arrays and ``finalize`` concatenates
+        once.
+
+    ``finalize()`` returns ``(pid, pk, values)`` buffers bit-identical to
+    ``executor.pad_rows`` over the concatenated chunk rows: same
+    power-of-two capacity (``executor.row_bucket``), same pad values —
+    so the fused kernel compiled for the serial path is hit, not
+    retraced, and pipelined noise is the serial noise.
+    """
+
+    def __init__(self, donate: Optional[bool] = None):
+        self.donating = _donation_supported() if donate is None else donate
+        self._n = 0  # real rows accumulated
+        self._bufs = None  # donating mode: (pid, pk, values)
+        self._staged = []  # staged mode: (pid, pk, values, n_real)
+
+    @property
+    def n_rows(self) -> int:
+        return self._n
+
+    def append(self, pid, pk, values, n_real: int, chunk: int = 0) -> None:
+        """Appends one encoded chunk (host numpy arrays; in donating mode
+        already padded to a row bucket, with ``n_real`` true rows)."""
+        import jax.numpy as jnp
+        # Fault-injection hook: an OOM mid-pipeline aborts the stream
+        # before any DP release — the failed run registered mechanisms at
+        # graph-build time only, so a rerun replays the same release.
+        rt_faults.maybe_fail("oom", chunk)
+        if n_real == 0 and pid.shape[0] == 0:
+            return
+        with rt_trace.span("pipeline_append", chunk=chunk, rows=n_real):
+            if not self.donating:
+                self._staged.append((jnp.asarray(pid), jnp.asarray(pk),
+                                     jnp.asarray(values), n_real))
+                self._n += n_real
+                return
+            chunk_bufs = (jnp.asarray(pid), jnp.asarray(pk),
+                          jnp.asarray(values))
+            if self._bufs is None:
+                # The first bucket-padded chunk IS the buffer.
+                self._bufs = chunk_bufs
+                self._n = n_real
+                return
+            cap = self._bufs[0].shape[0]
+            need = self._n + pid.shape[0]
+            if need > cap:
+                self._bufs = _grow_fn(True)(self._bufs,
+                                            new_cap=_pow2_at_least(need))
+            self._bufs = _append_fn(True)(self._bufs, chunk_bufs,
+                                          self._n)
+            self._n += n_real
+
+    def finalize(self):
+        """Returns (pid, pk, values) device buffers holding the
+        concatenated rows padded to ``executor.row_bucket(n)`` — the
+        exact arrays ``executor.pad_rows`` would produce; ``n_rows``
+        holds the real row count. Returns None when nothing was
+        appended (the caller emits its empty-stream encoding)."""
+        import jax.numpy as jnp
+
+        # Lazy: the executor imports this module at load; the bucket
+        # arithmetic lives with pad_rows so the two can never drift.
+        from pipelinedp_tpu import executor
+        if self._n == 0:
+            return None
+        target = executor.row_bucket(self._n)
+        if self.donating:
+            pid, pk, values = self._bufs
+            if pid.shape[0] > target:
+                # A small tail chunk's bucket can overshoot the total's
+                # bucket by one step; one slice restores the pad_rows
+                # shape so the serial-path compile cache is hit.
+                pid, pk, values = (pid[:target], pk[:target],
+                                   values[:target])
+            return pid, pk, values
+        pad = target - self._n
+        # Chunks arrive unpadded in staged mode; slice only a chunk that
+        # was handed over padded (a forced-donate caller), so the common
+        # path concatenates the staged arrays without an extra copy.
+        trim = lambda a, n: a if a.shape[0] == n else a[:n]
+        pids = [trim(p, n) for p, _, _, n in self._staged]
+        pks = [trim(k, n) for _, k, _, n in self._staged]
+        vals = [trim(v, n) for _, _, v, n in self._staged]
+        if pad:
+            pids.append(jnp.zeros(pad, pids[0].dtype))
+            pks.append(jnp.full(pad, -1, pks[0].dtype))
+            vals.append(
+                jnp.zeros((pad,) + vals[0].shape[1:], vals[0].dtype))
+        return (jnp.concatenate(pids), jnp.concatenate(pks),
+                jnp.concatenate(vals))

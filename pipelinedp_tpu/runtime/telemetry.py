@@ -88,6 +88,9 @@ REGISTRY: Dict[str, Metric] = {
         _counter("jit_cache_misses",
                  "probed jit entry-point calls that compiled (grew the "
                  "jit cache) instead of hitting it"),
+        _counter("pipeline_chunks",
+                 "chunks streamed through the ingest staging queue "
+                 "(runtime/pipeline.map_overlapped)"),
     )
 }
 

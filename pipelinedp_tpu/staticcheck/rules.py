@@ -330,14 +330,25 @@ _SANCTIONED_FETCH_FUNCS = frozenset({
 })
 
 
+# Device-resident modules beyond the parallel/ and ops/ trees: the
+# streaming executor's staging queue hands device arrays between stages,
+# so a smuggled np.asarray there would serialize the exact overlap the
+# module exists to create.
+_DEVICE_RESIDENT_FILES = frozenset({
+    "pipelinedp_tpu/runtime/pipeline.py",
+})
+
+
 def _is_device_resident(mod: Module) -> bool:
     dirs = mod.parts[:-1]
-    return "parallel" in dirs or "ops" in dirs
+    return ("parallel" in dirs or "ops" in dirs or
+            mod.rel in _DEVICE_RESIDENT_FILES)
 
 
 @rule(
     "host-transfer",
-    "Device-resident modules (parallel/, ops/) must not smuggle host "
+    "Device-resident modules (parallel/, ops/, runtime/pipeline.py) "
+    "must not smuggle host "
     "transfers: np.asarray/np.array/jax.device_get/.item()/.tolist() on "
     "device values block on a device->host copy. Route control-plane "
     "fetches through mesh.host_fetch (retried, watchdog-guarded, "
@@ -717,6 +728,8 @@ KNOB_VALIDATORS: Dict[str, str] = {
     "min_devices": "validate_min_devices",
     "job_id": "validate_job_id",
     "trace": "validate_trace",
+    "pipeline_depth": "validate_pipeline_depth",
+    "encode_threads": "validate_encode_threads",
 }
 
 # Data-plane parameters: configuration, not failure semantics — adding

@@ -51,6 +51,12 @@ def pytest_configure(config):
         "staticcheck: the AST DP-invariant analyzer gate and its "
         "fixtures (always-on tier-1, NOT slow; select alone with "
         "-m staticcheck)")
+    config.addinivalue_line(
+        "markers",
+        "pipeline: the device-resident streaming executor (ingest "
+        "thread pool, staging queue, donated accumulator) — "
+        "bit-identity, backpressure and fault tests (tier-1, NOT slow; "
+        "select alone with -m pipeline)")
 
 
 @pytest.fixture(autouse=True)
