@@ -35,6 +35,7 @@ owns that axis).
 """
 
 import dataclasses
+from collections import Counter as collections_counter
 from typing import Any, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -628,6 +629,206 @@ def merge_shards(shards: Sequence[ShardEncoding],
         pid=jnp.concatenate(dev_pid),
         pk=jnp.concatenate(dev_pk),
         values=jnp.concatenate(dev_vals),
+        partition_vocab=partition_vocab,
+        n_privacy_ids=len(pid_vocab),
+        public_encoded=public)
+
+
+# --- Multi-controller (pod) ingest ----------------------------------------
+#
+# The live form of the design above: under jax.distributed, EACH process
+# runs encode_shard over its own chunk iterator (host-local parse +
+# factorize, no device work, no cross-host rows), the per-process
+# vocabularies — O(uniques), not O(rows) — are exchanged once over the
+# collective fabric, every process derives the identical global
+# vocabulary + remap vectors (merge_host_vocabularies is deterministic in
+# process order), and each process uploads ONLY its remapped shard to its
+# local devices, assembled into one global mesh-sharded array
+# (jax.make_array_from_process_local_data). The only DCN traffic before
+# the driver's all_to_all is the vocabulary exchange.
+
+
+@dataclasses.dataclass
+class _ShardMeta:
+    """The per-process facts the vocabulary exchange moves: local vocabs
+    (pure numpy, picklable) + the process's row count."""
+    n_rows: int
+    pid_vocab: np.ndarray
+    pk_vocab: Optional[np.ndarray]
+
+
+def _collective_allgather_bytes(payload: bytes) -> List[bytes]:
+    """All-gathers one bytes payload per process (process order), via two
+    device collectives: a length gather fixes the pad, then the padded
+    uint8 payloads gather. O(vocabulary) bytes — never rows."""
+    import jax
+    import numpy as np_  # local alias: keep module-level np for rows
+    from jax.experimental import multihost_utils
+
+    length = np_.asarray([len(payload)], np_.int64)
+    lengths = np_.asarray(
+        multihost_utils.process_allgather(length)).reshape(-1)
+    cap = int(lengths.max()) if len(lengths) else 0
+    padded = np_.zeros(max(cap, 1), np_.uint8)
+    padded[:len(payload)] = np_.frombuffer(payload, np_.uint8)
+    gathered = np_.asarray(multihost_utils.process_allgather(padded))
+    gathered = gathered.reshape(int(jax.process_count()), -1)
+    return [gathered[p, :int(lengths[p])].tobytes()
+            for p in range(gathered.shape[0])]
+
+
+def merge_shard_metas(metas: Sequence[_ShardMeta],
+                      public: bool
+                      ) -> Tuple[List[np.ndarray],
+                                 Optional[List[np.ndarray]],
+                                 np.ndarray, Sequence[Any]]:
+    """Deterministic global merge every process runs identically:
+    (pid remaps, pk remaps or None, global pid vocab, partition vocab)."""
+    pid_vocab, pid_remaps = merge_host_vocabularies(
+        [m.pid_vocab for m in metas])
+    if public:
+        return pid_remaps, None, pid_vocab, []
+    pk_vocab, pk_remaps = merge_host_vocabularies(
+        [m.pk_vocab for m in metas])
+    return pid_remaps, pk_remaps, pid_vocab, pk_vocab
+
+
+def _padded_local_rows(shard: ShardEncoding, pid_remap: np.ndarray,
+                       pk_remap: Optional[np.ndarray], cap: int,
+                       value_dtype) -> Tuple[np.ndarray, np.ndarray,
+                                             np.ndarray]:
+    """One process's remapped rows padded to its device capacity with the
+    standard invalid marks (pid 0, pk -1 -> EncodedData.valid False)."""
+    pid = (pid_remap[shard.pid] if len(shard.pid) else
+           shard.pid).astype(np.int32)
+    pk = shard.pk if pk_remap is None else (
+        pk_remap[shard.pk] if len(shard.pk) else shard.pk)
+    pk = np.asarray(pk, np.int32)
+    values = np.asarray(shard.values, dtype=value_dtype)
+    n = len(pid)
+    pad = cap - n
+    if pad:
+        pid = np.concatenate([pid, np.zeros(pad, np.int32)])
+        pk = np.concatenate([pk, np.full(pad, -1, np.int32)])
+        values = np.concatenate(
+            [values,
+             np.zeros((pad,) + values.shape[1:], values.dtype)])
+    return pid, pk, values
+
+
+def encode_local_shard_to_mesh(
+        chunks: Iterable[Tuple[Sequence[Any], Sequence[Any],
+                               Sequence[float]]],
+        mesh,
+        public_partitions: Optional[Sequence[Any]] = None,
+        nonfinite: str = "error",
+        exchange=None) -> columnar.EncodedData:
+    """Pod-scale ingest: this process encodes ONLY its own input shard.
+
+    Runs encode_shard over `chunks` (host-local), exchanges the
+    per-process vocabularies + row counts (`exchange(payload_bytes) ->
+    [payload_bytes per process]`, default the collective all-gather —
+    injectable so single-process tests can simulate a pod), merges them
+    into the global vocabulary every process derives identically, remaps
+    the local rows, and uploads them as this process's slice of one
+    global mesh-sharded array set (jax.make_array_from_process_local_data
+    over `mesh`'s row sharding). Per-process rows pad to a common
+    per-device capacity (pk -1 -> EncodedData.valid False), so the global
+    layout is an even leading-axis split the meshed drivers consume
+    without any further eager cross-process reshaping.
+
+    Rows never cross hosts here: the collective reshard inside the driver
+    (hash(pid) mod D over the SAME global vocabulary codes) is what
+    co-locates each privacy id, exactly as in the single-process path.
+    Process order = stream order, so the merged codes equal a serial
+    stream_encode_columns over the concatenated stream (proven in
+    tests/test_multihost.py).
+    """
+    import pickle
+
+    import jax
+    import jax.numpy as jnp
+
+    from pipelinedp_tpu import executor
+    from pipelinedp_tpu.parallel import mesh as mesh_lib
+    from pipelinedp_tpu.runtime import trace as rt_trace
+
+    value_dtype = np.dtype(executor._ftype())
+    public = public_partitions is not None
+    with rt_trace.span("ingest.local_shard") as sp:
+        shard = encode_shard(chunks, public_partitions, nonfinite)
+        sp.set(rows=int(len(shard.pid)))
+    meta = _ShardMeta(n_rows=int(len(shard.pid)),
+                      pid_vocab=np.asarray(shard.pid_vocab),
+                      pk_vocab=(None if shard.pk_vocab is None else
+                                np.asarray(shard.pk_vocab)))
+    if exchange is None:
+        if mesh_lib.process_count() == 1:
+            exchange = lambda payload: [payload]  # noqa: E731 - trivial single-process identity
+        else:
+            exchange = _collective_allgather_bytes
+    with rt_trace.span("ingest.vocab_exchange") as sp:
+        payload = pickle.dumps(meta)
+        sp.set(bytes=len(payload))
+        metas = [pickle.loads(p) for p in exchange(payload)]
+    my_p = mesh_lib.process_index()
+    if not 0 <= my_p < len(metas):
+        raise ValueError(
+            f"vocabulary exchange returned {len(metas)} shard metas but "
+            f"this is process {my_p} — every pod process must "
+            f"participate exactly once")
+    pid_remaps, pk_remaps, pid_vocab, pk_vocab = merge_shard_metas(
+        metas, public)
+    if public:
+        partition_vocab = list(dict.fromkeys(public_partitions))
+    else:
+        partition_vocab = pk_vocab
+    n_local_dev = max(len(mesh_lib.local_devices(mesh)), 1)
+    n_dev = int(mesh.devices.size)
+    # One shared per-device capacity (every process must agree on the
+    # global shape, so it is derived purely from the exchanged metas and
+    # the mesh): the largest per-device row load across processes —
+    # each process's rows divided by ITS device count in the mesh —
+    # bucketed so repeated pods of similar size reuse compiled shapes.
+    from pipelinedp_tpu.parallel.mesh import device_process, round_capacity
+    devs_of = collections_counter(
+        device_process(d) for d in mesh.devices.flat)
+    simulated = mesh_lib.process_count() == 1 and len(metas) > 1
+    per_dev = 1
+    for p, m in enumerate(metas):
+        if simulated:
+            # Injected-exchange simulation of a pod inside one process:
+            # pretend an even device split across the simulated hosts.
+            n_p = max(n_dev // len(metas), 1)
+        else:
+            n_p = devs_of.get(p, 0)
+        if m.n_rows and not n_p:
+            raise ValueError(
+                f"process {p} encoded {m.n_rows} rows but owns no device "
+                f"of the mesh — every ingesting process must hold a mesh "
+                f"slice to upload to")
+        if n_p:
+            per_dev = max(per_dev, -(-m.n_rows // n_p))
+    cap = round_capacity(per_dev)
+    local_rows = cap * n_local_dev
+    pid, pk, values = _padded_local_rows(
+        shard, pid_remaps[my_p],
+        None if pk_remaps is None else pk_remaps[my_p], local_rows,
+        value_dtype)
+    from jax.sharding import NamedSharding, PartitionSpec
+    sharding = NamedSharding(mesh, PartitionSpec(mesh_lib.SHARD_AXIS))
+    global_rows = cap * n_dev
+
+    def to_global(col):
+        if mesh_lib.process_count() == 1:
+            return jax.device_put(jnp.asarray(col), sharding)
+        return jax.make_array_from_process_local_data(
+            sharding, col, (global_rows,) + col.shape[1:])
+
+    return columnar.EncodedData(
+        pid=to_global(pid),
+        pk=to_global(pk),
+        values=to_global(values),
         partition_vocab=partition_vocab,
         n_privacy_ids=len(pid_vocab),
         public_encoded=public)

@@ -174,6 +174,53 @@ def validate_encode_threads(encode_threads, obj_name: str) -> None:
             f"threads feeding the staging queue (None auto-sizes).")
 
 
+def validate_num_processes(num_processes, obj_name: str) -> None:
+    """Validates the multi-controller process count: an integer >= 1.
+
+    Raises:
+        ValueError: num_processes is not a positive integer (it is the
+        jax.distributed job size — every controller must pass the same
+        value or the coordinator rejects the late joiners).
+    """
+    if (not isinstance(num_processes, numbers.Number) or
+            isinstance(num_processes, bool) or
+            num_processes != int(num_processes) or num_processes < 1):
+        raise ValueError(
+            f"{obj_name}: num_processes must be an integer >= 1, but "
+            f"{num_processes!r} given — it is the total controller count "
+            f"of the jax.distributed job (1 = single-process; leave both "
+            f"multi-host knobs None to skip distributed bring-up).")
+
+
+def validate_coordinator_address(coordinator_address, obj_name: str) -> None:
+    """Validates a jax.distributed coordinator address: "host:port".
+
+    Raises:
+        ValueError: not a non-empty "host:port" string with an integer
+        port in [1, 65535] (a bare hostname would make every process
+        pick its own default and never rendezvous).
+    """
+    if not isinstance(coordinator_address, str) or \
+            not coordinator_address.strip():
+        raise ValueError(
+            f"{obj_name}: coordinator_address must be a non-empty "
+            f"'host:port' string, but {coordinator_address!r} given.")
+    host, sep, port = coordinator_address.rpartition(":")
+    if not sep or not host.strip():
+        raise ValueError(
+            f"{obj_name}: coordinator_address {coordinator_address!r} "
+            f"has no host:port separator — every controller must "
+            f"rendezvous on one explicit endpoint.")
+    try:
+        port_n = int(port)
+    except ValueError:
+        port_n = -1
+    if not 1 <= port_n <= 65535:
+        raise ValueError(
+            f"{obj_name}: coordinator_address port {port!r} is not an "
+            f"integer in [1, 65535].")
+
+
 def validate_journal(journal, obj_name: str) -> None:
     """Validates a BlockJournal-shaped object: get/put record accessors.
 

@@ -1,7 +1,14 @@
 """Multi-chip execution: mesh construction, row resharding (host-staged
 or on-device all_to_all), and sharded aggregation."""
 
-from pipelinedp_tpu.parallel.mesh import make_mesh
+from pipelinedp_tpu.parallel.mesh import (
+    initialize_distributed,
+    is_fully_addressable,
+    local_devices,
+    make_mesh,
+    process_count,
+    process_index,
+)
 from pipelinedp_tpu.parallel.reshard import (
     device_reshard_rows_by_pid,
     stage_rows_to_mesh,

@@ -566,6 +566,11 @@ def _sharded_bound_compact(pid, pk, values, valid, min_v, max_v, min_s,
             key_s, cfg)
         starts = jnp.searchsorted(spk_sorted, boundaries_r,
                                   side="left").astype(jnp.int32)
+        # all_gather -> replicated [S, n_blocks+1]: the driver needs every
+        # shard's offsets on every host, and on a multi-controller mesh a
+        # replicated table is the only layout host_fetch can read (a
+        # process cannot address another host's table shard).
+        starts = jax.lax.all_gather(starts, SHARD_AXIS, axis=0)
         if leaf_s is None:  # shard_map needs a concrete pytree leaf
             leaf_s = jnp.zeros(0, jnp.int32)
         return spk_sorted, pair_s, cols_s, leaf_s, starts
@@ -575,8 +580,7 @@ def _sharded_bound_compact(pid, pk, values, valid, min_v, max_v, min_s,
                    in_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
                              SP(SHARD_AXIS), SP(SHARD_AXIS), SP(), SP()),
                    out_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
-                              SP(SHARD_AXIS), SP(SHARD_AXIS),
-                              SP(SHARD_AXIS)))
+                              SP(SHARD_AXIS), SP(SHARD_AXIS), SP()))
     return fn(pid, pk, values, valid, rows_key, boundaries)
 
 
@@ -635,12 +639,15 @@ def _sharded_block_offsets(spk_all, boundaries, mesh):
     SP = PartitionSpec
 
     def per_shard(spk_s, boundaries_r):
-        return jnp.searchsorted(spk_s, boundaries_r,
-                                side="left").astype(jnp.int32)
+        starts = jnp.searchsorted(spk_s, boundaries_r,
+                                  side="left").astype(jnp.int32)
+        # Replicated for the same multi-controller host_fetch reason as
+        # the pass-1 offsets table.
+        return jax.lax.all_gather(starts, SHARD_AXIS, axis=0)
 
     fn = shard_map(per_shard, mesh=mesh,
                    in_specs=(SP(SHARD_AXIS), SP()),
-                   out_specs=SP(SHARD_AXIS))
+                   out_specs=SP())
     return fn(spk_all, boundaries)
 
 
@@ -896,13 +903,14 @@ def _sharded_select_compact(pid, pk, valid, rows_key, boundaries, l0: int,
             pid_s, pk_s, valid_s, key_s, l0, n_partitions)
         starts = jnp.searchsorted(spk_sorted, boundaries_r,
                                   side="left").astype(jnp.int32)
-        return spk_sorted, starts
+        # Replicated offsets (all_gather): see _sharded_bound_compact.
+        return spk_sorted, jax.lax.all_gather(starts, SHARD_AXIS, axis=0)
 
     fn = shard_map(per_shard,
                    mesh=mesh,
                    in_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS),
                              SP(SHARD_AXIS), SP(), SP()),
-                   out_specs=(SP(SHARD_AXIS), SP(SHARD_AXIS)))
+                   out_specs=(SP(SHARD_AXIS), SP()))
     return fn(pid, pk, valid, rows_key, boundaries)
 
 

@@ -10,15 +10,35 @@ This module keeps the rows in HBM end to end:
   1. **Bucketize** (per shard, on device): dest(row) = mix(pid) mod D — a
      salted murmur-style hash, identical on every shard, so all rows of a
      privacy id map to one destination no matter where they start.
-  2. **Count exchange** (the one host fetch): a tiny [D, D] send-count
-     table crosses to the host (mesh.host_fetch) to fix the static padded
-     bucket capacity; O(D^2) ints, never rows.
+  2. **Count exchange** (the one host fetch): the [D, D] send-count table
+     is REDUCED ON DEVICE (one psum for the receive loads, one pmax for
+     the largest send bucket) to a replicated int32[3] stats vector —
+     [max send bucket, max receive load, total valid rows] — and only
+     that crosses to the host (mesh.host_fetch). This is what makes the
+     exchange safe on a multi-controller mesh: a process can never
+     address another host's shard of the table, but every process can
+     read its own replica of the reduced stats, and because the stats
+     are bit-identical everywhere, every controller derives the SAME
+     static capacities and compiles the SAME exchange program (divergent
+     capacities would deadlock the collective).
   3. **Padded all_to_all**: each shard packs its rows into [D, cap_send]
      invalid-padded buckets and ONE jax.lax.all_to_all per column moves
-     them over the SHARD_AXIS mesh axis (ICI on a pod).
+     them over the SHARD_AXIS mesh axis (ICI within a host, DCN across
+     hosts on a pod).
   4. **Compaction**: each shard sorts its received rows valid-first and
      slices to the host-known output capacity, restoring the dense
      leading-axis layout every meshed kernel consumes.
+
+Capacity caching: the rounded (cap_send, out_cap) pair is cached per
+exchange geometry (mesh devices, padded per-shard capacity, salt, value
+column shape/dtype). A repeated exchange at a cached geometry dispatches
+the exchange kernel OPTIMISTICALLY at the cached capacities — overlapping
+the stats fetch instead of blocking on it — and only falls back to a
+re-dispatch when the fetched stats show the cached capacity no longer
+fits (counted in the ``reshard_capacity_reuse`` telemetry counter when it
+does fit). The cache is per-process and keyed purely by call geometry, so
+every controller of a multi-process mesh makes the same hit/miss decision
+and stays on the same compiled program.
 
 Load balance, re-derived for the hash-bucketed layout: shard_rows_by_pid
 balanced ROW counts exactly (greedy-LPT heavy ids + serpentine tail), so
@@ -38,16 +58,20 @@ where one upload is unavoidable and the exact LPT balance is free — and as
 the reshard="host" escape hatch on every meshed entry point.
 """
 
+import collections
 import contextlib
 import functools
 import logging
-from typing import Optional
+import threading
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
+
+from pipelinedp_tpu.runtime.concurrency import guarded_by
 
 from pipelinedp_tpu.parallel import mesh as mesh_lib
 from pipelinedp_tpu.parallel.mesh import (SHARD_AXIS, host_fetch,
@@ -79,19 +103,27 @@ def _dest_shard(pid, n_shards: int, salt: int):
 
 @functools.partial(jax.jit,
                    static_argnames=("n_shards", "salt", "mesh"))
-def _send_count_kernel(pid, valid, n_shards: int, salt: int, mesh: Mesh):
-    """[D, D] send-count table: row s holds shard s's per-destination
-    bucket sizes. The only data the host sees before the exchange."""
+def _count_stats_kernel(pid, valid, n_shards: int, salt: int, mesh: Mesh):
+    """Replicated int32[3] = [max send bucket, max receive load, total
+    valid rows]: the [D, D] send-count table reduced on device (psum for
+    the per-destination receive loads, pmax for the largest send bucket).
+    The only data the host sees before the exchange — and, being fully
+    replicated, the only form a multi-controller process could fetch at
+    all (each reads its local replica; no host ever addresses another
+    host's table shard)."""
 
     def per_shard(pid_s, valid_s):
         dest = _dest_shard(pid_s, n_shards, salt)
         idx = jnp.where(valid_s, dest, n_shards)
-        counts = jnp.zeros((n_shards + 1,), jnp.int32).at[idx].add(1)
-        return counts[None, :n_shards]
+        counts = jnp.zeros((n_shards + 1,), jnp.int32).at[idx].add(
+            1)[:n_shards]
+        recv = jax.lax.psum(counts, SHARD_AXIS)
+        max_send = jax.lax.pmax(counts.max(), SHARD_AXIS)
+        return jnp.stack([max_send, recv.max(), recv.sum()])
 
     fn = shard_map(per_shard, mesh=mesh,
                    in_specs=(P(SHARD_AXIS), P(SHARD_AXIS)),
-                   out_specs=P(SHARD_AXIS, None))
+                   out_specs=P())
     return fn(pid, valid)
 
 
@@ -149,8 +181,8 @@ def _exchange_kernel(pid, pk, values, valid, cap_send: int, out_cap: int,
 
 # Compile/dispatch attribution for the reshard entry points (trace
 # summaries separate all_to_all compiles from steady-state exchanges).
-_send_count_kernel = rt_trace.probe_jit("reshard_send_count",
-                                        _send_count_kernel)
+_count_stats_kernel = rt_trace.probe_jit("reshard_count_stats",
+                                         _count_stats_kernel)
 _exchange_kernel = rt_trace.probe_jit("reshard_exchange", _exchange_kernel)
 
 
@@ -163,19 +195,57 @@ def _pad_and_shard(mesh: Mesh, per_shard_cap: int, pid, pk, values, valid):
     """Pads device columns to n_shards * per_shard_cap (invalid-marked) and
     lays them out as an even leading-axis split over the mesh — all on
     device (device_put between device layouts is a device-to-device copy,
-    ICI on a pod)."""
+    ICI on a pod). Columns already at the target length and layout (the
+    multi-host ingest uploads per-process shards pre-padded to exactly
+    this split) pass through untouched — no eager cross-process copy."""
     n_shards = mesh.devices.size
     pad = n_shards * per_shard_cap - pid.shape[0]
+    sharding = row_sharding(mesh)
 
     def padded(col, fill):
-        widths = ((0, pad),) + ((0, 0),) * (col.ndim - 1)
-        return jnp.pad(col, widths, constant_values=fill)
+        if pad:
+            widths = ((0, pad),) + ((0, 0),) * (col.ndim - 1)
+            col = jnp.pad(col, widths, constant_values=fill)
+        if getattr(col, "sharding", None) == sharding:
+            return col
+        return jax.device_put(col, sharding)
 
-    sharding = row_sharding(mesh)
-    return (jax.device_put(padded(pid, 0), sharding),
-            jax.device_put(padded(pk, -1), sharding),
-            jax.device_put(padded(values, 0), sharding),
-            jax.device_put(padded(valid, False), sharding))
+    return (padded(pid, 0), padded(pk, -1), padded(values, 0),
+            padded(valid, False))
+
+
+# Rounded (cap_send, out_cap) pairs per exchange geometry, insertion-
+# ordered for deterministic FIFO eviction. Per-process and keyed purely
+# by call geometry, so every controller of a multi-process mesh makes
+# the same hit/miss decision (a divergent static capacity would compile
+# divergent collectives and deadlock the exchange).
+_capacity_lock = threading.Lock()
+_capacity_cache: "collections.OrderedDict[tuple, Tuple[int, int]]" = \
+    collections.OrderedDict()
+_CAPACITY_CACHE_MAX = 64
+_GUARDED_BY = guarded_by("_capacity_lock", "_capacity_cache")
+
+
+def reset_capacity_cache() -> None:
+    """Drops the cached exchange capacities (test isolation)."""
+    with _capacity_lock:
+        _capacity_cache.clear()
+
+
+def _capacity_key(mesh: Mesh, per_in: int, salt: int, values) -> tuple:
+    return (tuple(getattr(d, "id", d) for d in mesh.devices.flat),
+            int(per_in), int(salt), tuple(values.shape[1:]),
+            str(values.dtype))
+
+
+def _warn_skew(max_recv: int, total: int, n_shards: int) -> None:
+    if total and max_recv * n_shards > 2 * total:
+        logging.warning(
+            "device reshard: hash-bucketed max shard load %d > 2x mean "
+            "(%.0f) — a few privacy ids dominate the row mass, so the "
+            "hash balance assumption (load ~ n/D) does not hold for this "
+            "input; the hot shard bounds the padded capacity.", max_recv,
+            total / n_shards)
 
 
 def device_reshard_rows_by_pid(mesh: Mesh, pid, pk, values, valid,
@@ -186,7 +256,16 @@ def device_reshard_rows_by_pid(mesh: Mesh, pid, pk, values, valid,
     returns (pid, pk, values, valid) of length n_shards * out_cap laid out
     as an even leading-axis split over `mesh`, every privacy id's rows on
     exactly one shard, invalid-padded. Rows never visit the host; the only
-    device->host traffic is the [D, D] count table (mesh.host_fetch).
+    device->host traffic is the replicated int32[3] count-stats vector
+    (mesh.host_fetch) — multi-controller safe, since each process reads
+    its own replica of the on-device-reduced table.
+
+    Repeated exchanges at a cached geometry dispatch optimistically at
+    the cached capacities, overlapping the stats fetch with the exchange
+    instead of serializing capacity-sync -> dispatch; the fetched stats
+    then either confirm the fit (reshard_capacity_reuse) or trigger one
+    corrective re-dispatch at the exact capacities (rare: the row
+    distribution grew past the cached bucket).
     """
     n_shards = mesh.devices.size
     n = pid.shape[0]
@@ -196,25 +275,36 @@ def device_reshard_rows_by_pid(mesh: Mesh, pid, pk, values, valid,
     per_in = rows_per_shard(n, n_shards)
     pid, pk, values, valid = _pad_and_shard(mesh, per_in, pid, pk, values,
                                             valid)
-    counts = host_fetch(
-        _send_count_kernel(pid, valid, n_shards, salt, mesh))
-    recv = counts.sum(axis=0)
-    max_recv = int(recv.max())
-    cap_send = round_capacity(int(counts.max()))
+    stats_dev = _count_stats_kernel(pid, valid, n_shards, salt, mesh)
+    key = _capacity_key(mesh, per_in, salt, values)
+    with _capacity_lock:
+        cached = _capacity_cache.get(key)
+    out = None
+    if cached is not None:
+        # Optimistic dispatch at the cached capacities: the exchange
+        # compiles/runs while the stats land, so the steady-state path
+        # never blocks on the capacity sync before dispatching.
+        out = _exchange_kernel(pid, pk, values, valid, cached[0],
+                               cached[1], n_shards, salt, mesh)
+    max_send, max_recv, total = (
+        int(x) for x in host_fetch(stats_dev))
+    if cached is not None and max_send <= cached[0] and \
+            max_recv <= cached[1]:
+        rt_telemetry.record("reshard_capacity_reuse")
+        _warn_skew(max_recv, total, n_shards)
+        return out
+    cap_send = round_capacity(max_send)
     out_cap = round_capacity(max_recv)
     # Padding-waste bound: round_capacity guarantees <= 12.5% slack over
     # the measured max shard load (+ the 8-row floor). Asserted so a
     # future capacity-rounding change cannot silently break the memory
     # story this reshard is sold on.
     assert out_cap <= max(-(-9 * max_recv) // 8, 8), (out_cap, max_recv)
-    total = int(recv.sum())
-    if total and max_recv * n_shards > 2 * total:
-        logging.warning(
-            "device reshard: hash-bucketed max shard load %d > 2x mean "
-            "(%.0f) — a few privacy ids dominate the row mass, so the "
-            "hash balance assumption (load ~ n/D) does not hold for this "
-            "input; the hot shard bounds the padded capacity.", max_recv,
-            total / n_shards)
+    with _capacity_lock:
+        _capacity_cache[key] = (cap_send, out_cap)
+        while len(_capacity_cache) > _CAPACITY_CACHE_MAX:
+            _capacity_cache.popitem(last=False)
+    _warn_skew(max_recv, total, n_shards)
     return _exchange_kernel(pid, pk, values, valid, cap_send, out_cap,
                             n_shards, salt, mesh)
 
@@ -243,9 +333,25 @@ def stage_rows_to_mesh(mesh: Mesh, pid, pk, values, valid,
     shrunken mesh and the permutation rebuilds for the new D — already
     invalid-padded inputs restage correctly because every kernel masks
     by `valid`.
+
+    Multi-controller meshes (is_fully_addressable False): device-resident
+    inputs must be GLOBAL arrays over the mesh (the multi-host ingest,
+    ingest.encode_local_shard_to_mesh, builds them from per-process
+    shards), and the collective exchange is the only reshard —
+    reshard='host' is rejected and a failed collective propagates
+    instead of degrading, since no process can materialize the other
+    hosts' rows. Host-numpy inputs are accepted under the standard
+    multi-controller contract that every process passes the identical
+    array (each computes the same permutation and uploads it replicated).
     """
     if reshard not in ("auto", "host", "device"):
         raise ValueError(f"reshard must be auto|host|device, got {reshard}")
+    if reshard == "host" and not mesh_lib.is_fully_addressable(mesh):
+        raise ValueError(
+            "reshard='host' is unavailable on a multi-controller mesh: "
+            "the LPT permutation needs every row materialized on one "
+            "host, and no process can address the other hosts' shards. "
+            "Use reshard='auto' (the collective exchange) instead.")
     device_resident = isinstance(pid, jax.Array)
     use_device = (reshard == "device" or
                   (reshard == "auto" and device_resident))
@@ -280,6 +386,19 @@ def stage_rows_to_mesh(mesh: Mesh, pid, pk, values, valid,
                                                   valid)
         except Exception as e:  # noqa: BLE001 - classified below
             if not _is_collective_failure(e):
+                raise
+            if not mesh_lib.is_fully_addressable(mesh):
+                # A multi-controller mesh has no host permutation to
+                # degrade to: no process can materialize the other
+                # hosts' rows, so the failure propagates (the elastic
+                # loop may still rebuild a smaller mesh if the cause is
+                # device-fatal; a plain collective fault is terminal
+                # here, exactly like a failed psum would be).
+                logging.warning(
+                    "device collective reshard failed on a "
+                    "multi-controller mesh (%s) — the host LPT fallback "
+                    "needs every row addressable on one host, so the "
+                    "failure propagates.", type(e).__name__)
                 raise
             rt_telemetry.record("reshard_host_fallbacks")
             logging.warning(

@@ -412,6 +412,18 @@ class TPUBackend(LocalBackend):
             bit-identical — the accumulator reproduces executor.pad_rows
             exactly, so the same compiled kernel sees the same arrays
             and releases the same noise.
+        coordinator_address: jax.distributed coordinator endpoint
+            ("host:port"). With num_processes, brings up the
+            multi-controller runtime at backend construction
+            (parallel/mesh.initialize_distributed — idempotent, selects
+            the gloo CPU collectives the 2-process dryrun uses) so
+            jax.devices() spans the pod before any mesh is built. The
+            process id comes from JAX_PROCESS_INDEX or cluster
+            auto-detection. Both knobs None (the default) skips
+            distributed bring-up entirely.
+        num_processes: total controller count of the jax.distributed
+            job; must be identical on every process. See
+            coordinator_address.
         trace: span-based pipeline tracing (runtime/trace.py). When
             True, every run records nested, job-scoped spans (stage
             phases, per-block dispatch/drain, reshard collectives with
@@ -439,7 +451,9 @@ class TPUBackend(LocalBackend):
                  min_devices: int = 1,
                  trace: bool = False,
                  pipeline_depth: Optional[int] = None,
-                 encode_threads: Optional[int] = None):
+                 encode_threads: Optional[int] = None,
+                 coordinator_address: Optional[str] = None,
+                 num_processes: Optional[int] = None):
         super().__init__(seed=noise_seed)
         if reshard not in ("auto", "host", "device"):
             raise ValueError(
@@ -466,6 +480,25 @@ class TPUBackend(LocalBackend):
         if encode_threads is not None:
             input_validators.validate_encode_threads(
                 encode_threads, "TPUBackend")
+        if num_processes is not None:
+            input_validators.validate_num_processes(
+                num_processes, "TPUBackend")
+        if coordinator_address is not None:
+            input_validators.validate_coordinator_address(
+                coordinator_address, "TPUBackend")
+        if (coordinator_address is None) != (num_processes is None):
+            raise ValueError(
+                "TPUBackend: coordinator_address and num_processes must "
+                "be set together — they are the two halves of the "
+                "jax.distributed bring-up (process_id comes from "
+                "JAX_PROCESS_INDEX or cluster auto-detection).")
+        if coordinator_address is not None and num_processes > 1:
+            # Multi-controller bring-up BEFORE any mesh is touched:
+            # jax.devices() must already span the pod when the caller
+            # builds (or defaults) the mesh. Idempotent across backends.
+            from pipelinedp_tpu.parallel import mesh as mesh_lib
+            mesh_lib.initialize_distributed(coordinator_address,
+                                            num_processes)
         self.mesh = mesh
         self.max_partitions = max_partitions
         self.noise_seed = noise_seed
@@ -483,6 +516,8 @@ class TPUBackend(LocalBackend):
         self.trace = trace
         self.pipeline_depth = pipeline_depth
         self.encode_threads = encode_threads
+        self.coordinator_address = coordinator_address
+        self.num_processes = num_processes
         if trace:
             from pipelinedp_tpu.runtime import trace as rt_trace
             rt_trace.enable()

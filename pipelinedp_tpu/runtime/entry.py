@@ -25,7 +25,15 @@ a single API boundary for the runtime knobs:
     driver; at the one-device floor the unsharded fallback runs instead;
     losses past min_devices raise MeshDegradationError with a resume
     pointer. Block keys are fold_in(final_key, b) — independent of mesh
-    geometry — so every re-entry replays the same release.
+    geometry — so every re-entry replays the same release. On
+    multi-controller meshes the same loop covers whole-host loss: the
+    mesh rebuilds over the surviving hosts, and an evacuated controller
+    (no addressable device left) raises HostEvacuatedError.
+  * multi-controller coordination (meshed drivers on a mesh that is not
+    fully addressable): the journal knob is automatically scoped to this
+    controller's process index (BlockJournal.scoped_to_process) so
+    co-hosted processes sharing a journal directory never collide or
+    cross-replay, and the driver span carries the process index.
 
 timeout_s: per-operation deadline in seconds. Shorthand for
     watchdog=Watchdog(timeout_s=...); with neither, no deadlines are
@@ -96,10 +104,29 @@ def runtime_entry(kind: str, fallback: Optional[Callable] = None):
             from pipelinedp_tpu.parallel import mesh as mesh_lib
             fetch_retries = getattr(kwargs.get("retry"), "max_retries",
                                     None)
+            span_attrs = {"job": job}
+            if meshed and not mesh_lib.is_fully_addressable(args[0]):
+                # Multi-controller mesh: per-process coordination. The
+                # journal (when present) is scoped to this controller so
+                # co-hosted processes sharing one directory can never
+                # collide, cross-replay or quarantine each other's
+                # records; health snapshots and spans carry the process
+                # index for the same (job_id, process_index) keying.
+                pi = mesh_lib.process_index()
+                span_attrs["process"] = pi
+                journal = kwargs.get("journal")
+                if journal is not None and \
+                        getattr(journal, "process_index", None) is None and \
+                        callable(getattr(journal, "scoped_to_process",
+                                         None)):
+                    kwargs["journal"] = journal.scoped_to_process(pi)
+                    logging.debug(
+                        "%s: journal scoped to controller process %d "
+                        "(multi-controller mesh).", kind, pi)
             t0 = time.perf_counter()
             with rt_health.job_scope(job), rt_watchdog.activate(wd), \
                     mesh_lib.fetch_retry_scope(fetch_retries), \
-                    rt_trace.span(kind, job=job):
+                    rt_trace.span(kind, **span_attrs):
                 if meshed and elastic:
                     result = rt_retry.run_with_mesh_degradation(
                         lambda m: fn(m, *args[1:], job_id=job, **kwargs),

@@ -136,6 +136,10 @@ class Fault:
     device: "device_loss" only — global jax device id of the lost chip.
         None = the liveness probe marks the highest-id still-live device
         of the probed mesh as dead (deterministic without naming ids).
+    process: "device_loss" only — controller process index whose EVERY
+        device drops together (whole-host loss: power/network/runtime
+        death takes all of a host's chips at once). Mutually exclusive
+        with `device`.
     """
     kind: str
     block: Optional[int] = None
@@ -144,6 +148,7 @@ class Fault:
     point: Optional[str] = None  # kind in ("hang", "device_loss") only
     mode: str = "flip"  # kind == "corrupt" only
     device: Optional[int] = None  # kind == "device_loss" only
+    process: Optional[int] = None  # kind == "device_loss" only
 
     def __post_init__(self):
         if self.kind not in set(_RAISES) | {"slow", "hang", "corrupt"}:
@@ -157,6 +162,14 @@ class Fault:
             raise ValueError(f"unknown {self.kind} point {self.point!r}")
         if self.mode not in ("flip", "truncate"):
             raise ValueError(f"unknown corrupt mode {self.mode!r}")
+        if self.process is not None:
+            if self.kind != "device_loss":
+                raise ValueError("process= is a device_loss field")
+            if self.device is not None:
+                raise ValueError(
+                    "device= and process= are mutually exclusive: a "
+                    "whole-host loss already names every device of the "
+                    "process")
 
 
 class FaultSchedule:
@@ -172,20 +185,30 @@ class FaultSchedule:
     def __init__(self, faults: List[Fault]):
         self._remaining = [[f, f.times] for f in faults]
         self._lost_ids = set()
+        self._lost_processes = set()
         self._unassigned_losses = 0
 
     def note_device_loss(self, fault: Fault) -> None:
-        """Records one fired device_loss fault's victim."""
-        if fault.device is not None:
+        """Records one fired device_loss fault's victim (a named device,
+        a whole process's devices, or one to be assigned at probe)."""
+        if fault.process is not None:
+            self._lost_processes.add(int(fault.process))
+        elif fault.device is not None:
             self._lost_ids.add(fault.device)
         else:
             self._unassigned_losses += 1
 
     def assign_lost(self, devices) -> set:
         """Resolves which of `devices` (jax device objects or ids) the
-        schedule considers dead: explicitly-named ids, plus one
-        highest-id still-live device per unassigned fired loss (assigned
-        sticky, so later probes agree)."""
+        schedule considers dead: explicitly-named ids, every device of a
+        lost PROCESS (whole-host loss — resolved against each device's
+        process_index), plus one highest-id still-live device per
+        unassigned fired loss (assigned sticky, so later probes agree)."""
+        if self._lost_processes:
+            for d in devices:
+                if int(getattr(d, "process_index", 0)) in \
+                        self._lost_processes:
+                    self._lost_ids.add(getattr(d, "id", d))
         ids = [getattr(d, "id", d) for d in devices]
         for id_ in sorted(set(ids) - self._lost_ids, reverse=True):
             if self._unassigned_losses <= 0:

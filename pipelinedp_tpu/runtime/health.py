@@ -65,6 +65,7 @@ _DEGRADING_COUNTERS = frozenset({
     "host_fetch_retries",
     "watchdog_late_completions",
     "device_losses",
+    "host_losses",
     "mesh_degradations",
 })
 _STALLING_COUNTERS = frozenset({"block_timeouts", "watchdog_timeouts"})
@@ -72,8 +73,35 @@ _TRACKED_COUNTERS = (_DEGRADING_COUNTERS | _STALLING_COUNTERS |
                      frozenset({"journal_replays"}))
 
 
+def _process_index() -> int:
+    """This controller's jax process index, WITHOUT forcing backend
+    initialization: health records are created from contexts (journal
+    quarantine outside a run, pure-host tests) where dragging the jax
+    backend up would be both slow and wrong. Before jax is imported —
+    or before jax.distributed is live — the answer is 0, which matches
+    the single-process layout those contexts are in."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0
+    try:
+        # Only consult jax when the distributed runtime is actually live:
+        # jax.process_index() would otherwise INITIALIZE the backend as a
+        # side effect, and a plain (non-distributed) process is process 0
+        # by definition.
+        from jax._src import distributed as _jax_distributed
+        if getattr(_jax_distributed.global_state, "client", None) is None:
+            return 0
+        return int(jax.process_index())
+    except Exception:  # noqa: BLE001 - any backend/introspection failure means single-process semantics
+        return 0
+
+
 class JobHealth:
-    """Thread-safe health record of one job (keyed by journal job_id)."""
+    """Thread-safe health record of one job (keyed by journal job_id —
+    one registry per controller process, so the effective key of a
+    multi-controller job's health is (job_id, process_index), with the
+    process index carried in every snapshot)."""
 
     # Written by the driver thread, the watchdog monitor (note_timeout)
     # and telemetry forwarding; read by snapshot builders. staticcheck's
@@ -85,6 +113,12 @@ class JobHealth:
 
     def __init__(self, job_id: str):
         self.job_id = job_id
+        # Controller process this record lives in: health registries are
+        # per-process (each multi-controller process tracks its own), so
+        # the index is snapshot metadata that keys the state to
+        # (job_id, process_index) when snapshots from several controllers
+        # are aggregated (bench receipts, the multi-host dryrun).
+        self.process_index = _process_index()
         self._lock = threading.Lock()
         self._state = HealthState.HEALTHY
         self._counters: Dict[str, int] = {}
@@ -182,6 +216,7 @@ class JobHealth:
                    round(time.monotonic() - self._last_beat, 3))
             return {
                 "job_id": self.job_id,
+                "process_index": self.process_index,
                 "state": self._state.name,
                 "counters": dict(self._counters),
                 "journal_quarantined":

@@ -33,6 +33,17 @@ directory to the records a resume can actually replay.
 Resume across processes requires a directory, a stable job_id, and a
 deterministic noise key (TPUBackend(noise_seed=...)); resume within a
 process needs only the same BlockJournal instance.
+
+Multi-controller jobs: every process of a pod-spanning mesh runs the same
+blocked driver and journals the same (replicated) consumed-block results,
+so co-hosted processes sharing one journal directory would race each
+other's atomic renames and cross-replay records that are only meaningful
+under their own process's runtime state. BlockJournal(process_index=...)
+scopes a journal to one controller: record file names gain a
+``p<index>__`` segment and the in-memory cache keys include the index, so
+records from different processes can never collide, replay or quarantine
+one another. runtime/entry.py applies the scoping automatically when a
+meshed driver runs on a multi-controller mesh (scoped_to_process).
 """
 
 import dataclasses
@@ -108,10 +119,13 @@ class BlockJournal:
     # immutable after construction and stays undeclared.
     _GUARDED_BY = guarded_by("_lock", "_mem")
 
-    def __init__(self, directory: Optional[str] = None):
+    def __init__(self, directory: Optional[str] = None,
+                 process_index: Optional[int] = None):
         self._lock = threading.Lock()
         self._mem: Dict[Tuple[str, str], BlockRecord] = {}
         self._dir = directory
+        self._process_index = (None if process_index is None else
+                               int(process_index))
         if directory is not None:
             os.makedirs(directory, exist_ok=True)
             self._sweep_orphan_tmp(directory)
@@ -122,6 +136,45 @@ class BlockJournal:
         point an operator at a resume — e.g. the elastic runtime's
         MeshDegradationError — name this path."""
         return self._dir
+
+    @property
+    def process_index(self) -> Optional[int]:
+        """Controller process this journal's records belong to (None =
+        unscoped, the single-process layout and file naming)."""
+        return self._process_index
+
+    def scoped_to_process(self, process_index: int) -> "BlockJournal":
+        """A view of this journal scoped to one controller process.
+
+        Shares the backing directory, the in-memory cache and its lock
+        (records a multi-controller test simulates in one process stay
+        isolated through the key prefix, not through separate stores),
+        but namespaces every record under ``p<index>``: distinct file
+        names on disk, distinct cache keys in memory. A journal already
+        scoped to the same index returns itself; re-scoping to a
+        different index is rejected — it would silently alias two
+        controllers' records.
+        """
+        process_index = int(process_index)
+        if self._process_index is not None:
+            if self._process_index == process_index:
+                return self
+            raise ValueError(
+                f"journal is already scoped to process "
+                f"{self._process_index}; re-scoping to {process_index} "
+                f"would alias two controllers' records")
+        scoped = BlockJournal.__new__(BlockJournal)
+        scoped._lock = self._lock
+        scoped._mem = self._mem  # staticcheck: disable=lock-discipline — aliasing the SHARED dict reference on a brand-new object no other thread can see yet; all element access goes through the shared lock
+        scoped._dir = self._dir
+        scoped._process_index = process_index
+        return scoped
+
+    def _job_prefix(self, job_id: str) -> str:
+        """File-name prefix of one job's records under this scope."""
+        if self._process_index is None:
+            return f"{_safe(job_id)}__"
+        return f"{_safe(job_id)}__p{self._process_index}__"
 
     @staticmethod
     def _sweep_orphan_tmp(directory: str) -> None:
@@ -143,11 +196,20 @@ class BlockJournal:
                 pass
 
     def _path(self, job_id: str, key: str) -> str:
-        return os.path.join(self._dir, f"{_safe(job_id)}__{_safe(key)}.npz")
+        return os.path.join(self._dir,
+                            f"{self._job_prefix(job_id)}{_safe(key)}.npz")
+
+    def _mem_job(self, job_id: str) -> str:
+        """In-memory key namespace of a job under this scope (NUL is
+        rejected by validate_job_id, so the separator cannot collide
+        with a legitimate job id)."""
+        if self._process_index is None:
+            return job_id
+        return f"{job_id}\x00p{self._process_index}"
 
     def put(self, job_id: str, key: str, record: BlockRecord) -> None:
         with self._lock:
-            self._mem[(job_id, key)] = record
+            self._mem[(self._mem_job(job_id), key)] = record
         if self._dir is None:
             return
         payload = {"ids": record.ids}
@@ -254,7 +316,7 @@ class BlockJournal:
 
     def get(self, job_id: str, key: str) -> Optional[BlockRecord]:
         with self._lock:
-            record = self._mem.get((job_id, key))
+            record = self._mem.get((self._mem_job(job_id), key))
         if record is not None or self._dir is None:
             return record
         path = self._path(job_id, key)
@@ -270,22 +332,32 @@ class BlockJournal:
             self._quarantine(job_id, key, path, e)
             return None
         with self._lock:
-            self._mem[(job_id, key)] = record
+            self._mem[(self._mem_job(job_id), key)] = record
         return record
 
     def keys(self, job_id: str) -> Iterable[str]:
         """Block keys recorded for a job (memory + directory; disk-only
         records surface under their sanitized file-name form, which get()
-        resolves to the same file)."""
+        resolves to the same file). Scoped journals list only their own
+        process's records — a sibling process's files carry a different
+        ``p<index>`` prefix and never match."""
+        mem_job = self._mem_job(job_id)
         with self._lock:
-            mem = {key for jid, key in self._mem if jid == job_id}
+            mem = {key for jid, key in self._mem if jid == mem_job}
         keys = set(mem)
         if self._dir is not None:
             sanitized_mem = {_safe(key) for key in mem}
-            prefix = _safe(job_id) + "__"
+            prefix = self._job_prefix(job_id)
+            unscoped_p = re.compile(r"^p\d+__") \
+                if self._process_index is None else None
             for name in os.listdir(self._dir):
                 if name.startswith(prefix) and name.endswith(".npz"):
                     key = name[len(prefix):-len(".npz")]
+                    if unscoped_p is not None and unscoped_p.match(key):
+                        # An UNSCOPED journal sharing a directory with
+                        # scoped ones must not surface (or replay) their
+                        # process-suffixed records as its own.
+                        continue
                     if key not in sanitized_mem:
                         keys.add(key)
         return sorted(keys)
@@ -343,26 +415,28 @@ class BlockJournal:
         return dropped
 
     def _drop(self, job_id: str, key: str) -> None:
+        mem_job = self._mem_job(job_id)
         with self._lock:
-            self._mem.pop((job_id, key), None)
+            self._mem.pop((mem_job, key), None)
             # The sanitized forms of the raw and disk-listed key
             # spellings land on the same file.
             for variant in {key, key.replace("_", ":", 1)}:
-                self._mem.pop((job_id, variant), None)
+                self._mem.pop((mem_job, variant), None)
         if self._dir is not None:
             path = self._path(job_id, key)
             if os.path.exists(path):
                 os.unlink(path)
 
     def clear(self, job_id: Optional[str] = None) -> None:
-        """Drops records — all of them, or one job's."""
+        """Drops records — all of them, or one job's (within this
+        journal's process scope only)."""
         with self._lock:
             for jid, key in list(self._mem):
-                if job_id is None or jid == job_id:
+                if job_id is None or jid == self._mem_job(job_id):
                     del self._mem[(jid, key)]
         if self._dir is None:
             return
-        prefix = None if job_id is None else _safe(job_id) + "__"
+        prefix = None if job_id is None else self._job_prefix(job_id)
         for name in os.listdir(self._dir):
             if not name.endswith(".npz"):
                 continue

@@ -1,0 +1,601 @@
+"""Multi-controller pod harness: the 2-process CPU dryrun gate.
+
+SNIPPETS.md's pjit/NamedSharding excerpts promise that the same sharded
+code drives multi-process TPU pods; this module is where that promise is
+made falsifiable on every CI box. It spawns a REAL jax.distributed job —
+N separate python processes, each owning a slice of CPU devices, gloo
+collectives across them — runs the four meshed drivers (aggregate/select
+x dense/blocked) plus an engine-level aggregation over the pod-spanning
+mesh, and proves the outputs BIT-IDENTICAL to a single-process run of
+the same device count:
+
+  * the workload recipe (run_pod_workload / run_pod_engine) is one
+    function executed by the children (global multi-process mesh) and by
+    the single-process reference (same D, one controller), so any
+    divergence is the multi-controller runtime's fault, not the test's;
+  * inputs are integer-valued with non-binding contribution bounds, so
+    psums are exact and placement/sampling cannot perturb results — the
+    same construction the elastic-mesh bit-identity tests use;
+  * the identity scenario wraps the drivers in
+    reshard.forbid_row_fetches: the only host traffic on the cross-host
+    path is the replicated count-stats vector and O(kept) results;
+  * the host-loss scenario injects a whole-host device loss
+    (Fault(device_loss, process=...)): the surviving controller rebuilds
+    the mesh over its own devices and completes bit-identically (block
+    keys are geometry-independent), while the evacuated controller
+    raises HostEvacuatedError and exits cleanly.
+
+The spawn helper enforces a HARD timeout — a wedged child (a collective
+waiting on a dead peer) is killed and surfaced as a failure, so the
+multihost tests can never hang tier-1.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+# Env vars the spawned children read (set by spawn_local_pod).
+ENV_COORDINATOR = "PDP_MULTIHOST_COORDINATOR"
+ENV_NUM_PROCESSES = "PDP_MULTIHOST_NUM_PROCESSES"
+ENV_PROCESS_INDEX = "JAX_PROCESS_INDEX"
+
+# The pod geometry every scenario runs: 2 controllers x 2 devices == the
+# 4-device single-process reference.
+POD_PROCESSES = 2
+POD_DEVICES_PER_PROCESS = 2
+
+
+def _free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# Workload recipe (shared verbatim by children and the reference)
+# ---------------------------------------------------------------------------
+
+
+def _pod_spec(n_partitions: int, l0: int = 2, linf: int = 3):
+    """(cfg, selection, stds, scalars) of a COUNT+SUM private-selection
+    step with the noise stds zeroed — parity must be exact, and the
+    selection decisions stay deterministic through the replicated key."""
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import combiners, executor
+    from pipelinedp_tpu.aggregate_params import MechanismType
+    from pipelinedp_tpu.ops import selection_ops
+
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        noise_kind=pdp.NoiseKind.LAPLACE,
+        max_partitions_contributed=l0,
+        max_contributions_per_partition=linf,
+        min_value=0.0,
+        max_value=9.0)
+    acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0, total_delta=1e-6)
+    compound = combiners.create_compound_combiner(params, acc)
+    budget = acc.request_budget(MechanismType.GENERIC)
+    acc.compute_budgets()
+    selection = selection_ops.selection_params_from_host(
+        params.partition_selection_strategy, budget.eps, budget.delta,
+        params.max_partitions_contributed, None)
+    cfg = executor.make_kernel_config(params, compound, n_partitions,
+                                      private_selection=True,
+                                      selection_params=selection)
+    stds = np.zeros_like(executor.compute_noise_stds(compound, params))
+    return cfg, selection, stds, executor.kernel_scalars(params)
+
+
+def _pod_rows(n_partitions: int, n_ids: int = 960,
+              l0: int = 2, linf: int = 3):
+    """Deterministic integer-valued rows whose contribution bounds are
+    exactly met (never exceeded): bounding drops nothing, psums are
+    exact, so outputs are a pure function of the multiset of rows —
+    independent of mesh geometry, process topology and row order.
+    Partitions are DENSE (~n_ids/6 privacy ids each) so private
+    selection keeps them deterministically at eps=1."""
+    u = np.arange(n_ids, dtype=np.int64)
+    pid = np.repeat(u, l0 * linf)
+    if n_partitions <= 64:
+        p1 = (u * 7) % 12
+        p2 = (u * 7 + 1) % 12
+    else:
+        # Large-P (blocked) recipe: 8 dense partitions spread across the
+        # whole [0, P) range — several 512-partition blocks see some,
+        # each partition holds ~n_ids/4 privacy ids (a thin spread over
+        # P partitions would be dropped by selection and prove nothing).
+        slots = 4
+        p1 = (u % slots) * (n_partitions // slots) + 13
+        p2 = ((u + 1) % slots) * (n_partitions // slots) + 200
+    pk = np.repeat(
+        np.stack([p1, p2], axis=1).ravel().astype(np.int32), linf)
+    values = ((pid * 7 + pk) % 10).astype(np.float64)
+    valid = np.ones(len(pid), dtype=bool)
+    return pid, pk, values, valid
+
+
+def _stage_global_rows(mesh, pid, pk, values, valid):
+    """Lays the rows out as one global mesh-sharded array set.
+
+    Single-controller: one upload. Multi-controller: each process uploads
+    ONLY its contiguous row slice (padded to the shared per-device
+    capacity, pk -1 / valid False marking the pad), assembled with
+    jax.make_array_from_process_local_data — the driver-level counterpart
+    of ingest.encode_local_shard_to_mesh's layout, so the reshard's
+    _pad_and_shard passes it through without any eager cross-process
+    copy.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from pipelinedp_tpu.parallel import mesh as mesh_lib
+
+    sharding = NamedSharding(mesh, PartitionSpec(mesh_lib.SHARD_AXIS))
+    n_proc = mesh_lib.process_count()
+    if n_proc == 1:
+        return (jnp.asarray(pid.astype(np.int32)), jnp.asarray(pk),
+                jnp.asarray(values), jnp.asarray(valid))
+    me = mesh_lib.process_index()
+    n = len(pid)
+    per_proc = -(-n // n_proc)
+    lo, hi = me * per_proc, min((me + 1) * per_proc, n)
+    n_local_dev = len(mesh_lib.local_devices(mesh))
+    n_dev = int(mesh.devices.size)
+    cap = mesh_lib.round_capacity(-(-per_proc // max(n_local_dev, 1)))
+    local_rows = cap * n_local_dev
+    global_rows = cap * n_dev
+
+    def to_global(col, fill, dtype):
+        local = np.full((local_rows,) + col.shape[1:], fill, dtype)
+        local[:hi - lo] = col[lo:hi]
+        return jax.make_array_from_process_local_data(
+            sharding, local, (global_rows,) + col.shape[1:])
+
+    return (to_global(pid.astype(np.int32), 0, np.int32),
+            to_global(pk, -1, np.int32),
+            to_global(values, 0.0, values.dtype),
+            to_global(valid, False, bool))
+
+
+def run_pod_workload(mesh, journal_dir: Optional[str] = None,  # staticcheck: disable=key-hygiene — fixed literal harness keys: the bit-identity proof REQUIRES every controller and the reference to derive from the same key; noise stds are zeroed, nothing here is a product release
+                     elastic: bool = False) -> Dict[str, np.ndarray]:
+    """The four meshed drivers over `mesh`, device-resident inputs,
+    deterministic keys. Returns host-numpy outputs keyed for bitwise
+    comparison across topologies."""
+    import jax
+
+    from pipelinedp_tpu.parallel import large_p, sharded
+    from pipelinedp_tpu.parallel.mesh import host_fetch
+    from pipelinedp_tpu.runtime import journal as rt_journal
+
+    P_dense, P_big = 48, 4096
+    cfg, selection, stds, (min_v, max_v, min_s, max_s, mid) = _pod_spec(
+        P_dense)
+    cfg_big, selection_big, stds_big, _ = _pod_spec(P_big)
+    pid, pk, values, valid = _pod_rows(P_dense)
+    pid_b, pk_b, values_b, valid_b = _pod_rows(P_big)
+    key = jax.random.PRNGKey(3)
+    journal = (rt_journal.BlockJournal(journal_dir)
+               if journal_dir else None)
+    runtime_kwargs = dict(elastic=elastic) if elastic else {}
+
+    cols = _stage_global_rows(mesh, pid, pk, values, valid)
+    outputs, keep, _ = sharded.sharded_aggregate_arrays(
+        mesh, *cols, min_v, max_v, min_s, max_s, mid, stds, key, cfg,
+        **runtime_kwargs)
+    sel = sharded.sharded_select_partitions(
+        mesh, cols[0], cols[1], cols[3], jax.random.PRNGKey(5), 2,
+        P_dense, selection, **runtime_kwargs)
+
+    cols_b = _stage_global_rows(mesh, pid_b, pk_b, values_b, valid_b)
+    blk_ids, blk_out = large_p.aggregate_blocked_sharded(
+        mesh, *cols_b, min_v, max_v, min_s, max_s, mid, stds_big,
+        jax.random.PRNGKey(7), cfg_big, block_partitions=512,
+        journal=journal, **runtime_kwargs)
+    blk_sel = large_p.select_partitions_blocked_sharded(
+        mesh, cols_b[0], cols_b[1], cols_b[3], jax.random.PRNGKey(9), 2,
+        P_big, selection_big, block_partitions=512, journal=journal,
+        **runtime_kwargs)
+
+    return {
+        "dense_count": host_fetch(outputs["count"]),
+        "dense_sum": host_fetch(outputs["sum"]),
+        "dense_keep": host_fetch(keep),
+        "dense_sel": host_fetch(sel),
+        "blk_ids": np.asarray(blk_ids),
+        "blk_count": np.asarray(blk_out["count"]),
+        "blk_sum": np.asarray(blk_out["sum"]),
+        "blk_sel": np.asarray(blk_sel),
+    }
+
+
+def _engine_chunks(lo: int, hi: int, chunk: int = 700):
+    """String-keyed engine input chunks for rows [lo, hi) of the shared
+    stream — string keys so the vocabulary exchange is exercised on real
+    (object-dtype) vocabularies, integer values so sums stay exact."""
+    rng = np.random.default_rng(17)
+    n = 3000
+    pids = np.char.add("u", (rng.integers(0, 250, n)).astype(str))
+    pks = np.char.add("p", (rng.integers(0, 30, n)).astype(str))
+    vals = rng.integers(0, 10, n).astype(np.float64)
+    return [(pids[i:min(i + chunk, hi)], pks[i:min(i + chunk, hi)],
+             vals[i:min(i + chunk, hi)])
+            for i in range(lo, hi, chunk)], n
+
+
+def run_pod_engine(mesh) -> Dict[str, np.ndarray]:
+    """Engine-level pod aggregation over the multi-host ingest path:
+    this process encodes only its shard (encode_local_shard_to_mesh),
+    the engine aggregates over the pod mesh, and the budget ledger is
+    returned for the zero-duplicate-registration check."""
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import ingest
+    from pipelinedp_tpu.parallel import mesh as mesh_lib
+
+    n_proc = mesh_lib.process_count()
+    me = mesh_lib.process_index()
+    _, total = _engine_chunks(0, 0)
+    per = -(-total // n_proc)
+    lo, hi = me * per, min((me + 1) * per, total)
+    chunks, _ = _engine_chunks(lo, hi)
+    encoded = ingest.encode_local_shard_to_mesh(iter(chunks), mesh)
+
+    params = pdp.AggregateParams(metrics=[pdp.Metrics.COUNT,
+                                          pdp.Metrics.SUM],
+                                 max_partitions_contributed=30,
+                                 max_contributions_per_partition=60,
+                                 min_value=0.0,
+                                 max_value=9.0)
+    ex = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                            partition_extractor=lambda r: r[1],
+                            value_extractor=lambda r: float(r[2]))
+    acc = pdp.NaiveBudgetAccountant(total_epsilon=1e7, total_delta=1e-6)
+    engine = pdp.DPEngine(acc, pdp.TPUBackend(mesh=mesh, noise_seed=11))
+    result = engine.aggregate(encoded, params, ex)
+    acc.compute_budgets()
+    result = dict(result)
+    pks = sorted(result)
+    return {
+        "engine_pks": np.asarray([str(k) for k in pks]),
+        "engine_counts": np.asarray([result[k].count for k in pks]),
+        "engine_sums": np.asarray([result[k].sum for k in pks]),
+        "mechanism_count": np.asarray([acc.mechanism_count]),
+    }
+
+
+def run_host_loss_workload(mesh, lost_process: int,  # staticcheck: disable=key-hygiene — fixed literal harness key shared with the fault-free reference (bit-identity proof); noise-free, not a product release
+                           journal_dir: str) -> Dict[str, np.ndarray]:
+    """The blocked aggregate driver under an injected WHOLE-HOST loss:
+    every device of `lost_process` drops at block 2 of the first
+    dispatch. Host-numpy inputs (the multi-controller identical-input
+    contract), elastic + journal, so the surviving controller rebuilds
+    over its own devices, replays journaled blocks, re-derives the same
+    fold_in keys and finishes bit-identically to a fault-free run —
+    while the evacuated controller raises HostEvacuatedError (translated
+    by the child main into an `evacuated` marker)."""
+    import jax
+
+    from pipelinedp_tpu.parallel import large_p
+    from pipelinedp_tpu.runtime import faults as rt_faults
+    from pipelinedp_tpu.runtime import journal as rt_journal
+
+    P_big = 4096
+    cfg_big, _, stds_big, (min_v, max_v, min_s, max_s, mid) = _pod_spec(
+        P_big)
+    pid_b, pk_b, values_b, valid_b = _pod_rows(P_big)
+    journal = rt_journal.BlockJournal(journal_dir)
+    schedule = rt_faults.FaultSchedule([
+        rt_faults.Fault("device_loss", block=2, point="dispatch",
+                        process=lost_process),
+    ])
+    with rt_faults.inject(schedule):
+        blk_ids, blk_out = large_p.aggregate_blocked_sharded(
+            mesh, pid_b, pk_b, values_b, valid_b, min_v, max_v, min_s,
+            max_s, mid, stds_big, jax.random.PRNGKey(7), cfg_big,
+            block_partitions=512, journal=journal, elastic=True)
+    return {
+        "blk_ids": np.asarray(blk_ids),
+        "blk_count": np.asarray(blk_out["count"]),
+        "blk_sum": np.asarray(blk_out["sum"]),
+    }
+
+
+def reference_host_loss_outputs() -> Dict[str, np.ndarray]:  # staticcheck: disable=key-hygiene — fixed literal harness key shared with the faulted run (bit-identity proof); noise-free, not a product release
+    """Fault-free single-process reference of run_host_loss_workload
+    (same recipe, same keys, no journal needed)."""
+    import jax
+
+    from pipelinedp_tpu.parallel import large_p
+    from pipelinedp_tpu.parallel.mesh import make_mesh
+
+    n_dev = POD_PROCESSES * POD_DEVICES_PER_PROCESS
+    mesh = make_mesh(n_devices=n_dev)
+    P_big = 4096
+    cfg_big, _, stds_big, (min_v, max_v, min_s, max_s, mid) = _pod_spec(
+        P_big)
+    pid_b, pk_b, values_b, valid_b = _pod_rows(P_big)
+    blk_ids, blk_out = large_p.aggregate_blocked_sharded(
+        mesh, pid_b, pk_b, values_b, valid_b, min_v, max_v, min_s, max_s,
+        mid, stds_big, jax.random.PRNGKey(7), cfg_big,
+        block_partitions=512)
+    return {
+        "blk_ids": np.asarray(blk_ids),
+        "blk_count": np.asarray(blk_out["count"]),
+        "blk_sum": np.asarray(blk_out["sum"]),
+    }
+
+
+def reference_identity_outputs(tmp_journal_dir: Optional[str] = None
+                               ) -> Dict[str, np.ndarray]:
+    """Single-process reference of the identity scenario: same recipe,
+    same keys, one controller owning all POD devices."""
+    from pipelinedp_tpu.parallel.mesh import make_mesh
+
+    n_dev = POD_PROCESSES * POD_DEVICES_PER_PROCESS
+    mesh = make_mesh(n_devices=n_dev)
+    out = run_pod_workload(mesh, journal_dir=tmp_journal_dir)
+    out.update(run_pod_engine(mesh))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Child process main
+# ---------------------------------------------------------------------------
+
+
+def _child_main(scenario: str, out_path: str) -> int:
+    """Entry point of one spawned controller (see spawn_local_pod)."""
+    import jax
+
+    from pipelinedp_tpu.parallel import mesh as mesh_lib
+    from pipelinedp_tpu.runtime import retry as rt_retry
+    from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+    from pipelinedp_tpu.runtime import health as rt_health
+
+    coordinator = os.environ[ENV_COORDINATOR]
+    num_processes = int(os.environ[ENV_NUM_PROCESSES])
+    process_id = int(os.environ[ENV_PROCESS_INDEX])
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    mesh_lib.initialize_distributed(coordinator, num_processes,
+                                    process_id)
+    assert jax.process_count() == num_processes
+    mesh = mesh_lib.make_mesh()
+    journal_dir = os.path.join(os.path.dirname(out_path), "journal")
+    info: Dict[str, object] = {
+        "process_index": mesh_lib.process_index(),
+        "n_devices": int(mesh.devices.size),
+        "n_local_devices": len(mesh_lib.local_devices(mesh)),
+        "fully_addressable": mesh_lib.is_fully_addressable(mesh),
+        "evacuated": False,
+    }
+    outputs: Dict[str, np.ndarray] = {}
+    if scenario == "identity":
+        from pipelinedp_tpu.parallel import reshard
+        # The transfer guard rides the whole driver pass: the only host
+        # traffic on the cross-host reshard path is the replicated
+        # count-stats vector, block offsets and O(kept) results.
+        with reshard.forbid_row_fetches():
+            outputs.update(run_pod_workload(mesh,
+                                            journal_dir=journal_dir))
+        outputs.update(run_pod_engine(mesh))
+    elif scenario == "host_loss":
+        lost = num_processes - 1
+        try:
+            outputs.update(
+                run_host_loss_workload(mesh, lost, journal_dir))
+        except rt_retry.HostEvacuatedError as e:
+            info["evacuated"] = True
+            info["evacuation_error"] = str(e)[:500]
+    else:
+        raise SystemExit(f"unknown scenario {scenario!r}")
+    info["counters"] = dict(rt_telemetry.snapshot())
+    info["health"] = {
+        job: snap["state"]
+        for job, snap in rt_health.snapshot_all().items()
+    }
+    np.savez(out_path + ".npz", **outputs)
+    with open(out_path + ".json", "w") as f:
+        json.dump(info, f)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# Spawner (hard-timeout enforced)
+# ---------------------------------------------------------------------------
+
+
+def spawn_local_pod(scenario: str, out_dir: str,
+                    n_processes: int = POD_PROCESSES,
+                    devices_per_process: int = POD_DEVICES_PER_PROCESS,
+                    timeout_s: float = 240.0) -> List[Tuple[dict, dict]]:
+    """Spawns an n-process jax.distributed CPU pod running `scenario`.
+
+    Returns one (info_json, outputs_npz_dict) pair per process, in
+    process order. Enforces a HARD timeout: children still alive at the
+    deadline are killed (a collective waiting on a dead peer would
+    otherwise wedge forever) and a TimeoutError carries their last
+    output, so a wedged pod can never hang the calling test suite.
+    """
+    import pipelinedp_tpu
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.abspath(pipelinedp_tpu.__file__)))
+    port = _free_port()
+    procs = []
+    for p in range(n_processes):
+        env = os.environ.copy()
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS":
+                f"--xla_force_host_platform_device_count"
+                f"={devices_per_process}",
+            "JAX_ENABLE_X64": "1",
+            ENV_PROCESS_INDEX: str(p),
+            ENV_COORDINATOR: f"127.0.0.1:{port}",
+            ENV_NUM_PROCESSES: str(n_processes),
+            "PYTHONPATH": repo_root + os.pathsep + env.get("PYTHONPATH",
+                                                           ""),
+        })
+        out = os.path.join(out_dir, f"proc{p}")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "pipelinedp_tpu.runtime.multihost",
+             scenario, out],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=repo_root)
+        procs.append((p, proc, out))
+    deadline = time.monotonic() + timeout_s
+    logs = {}
+    try:
+        for p, proc, _ in procs:
+            left = max(deadline - time.monotonic(), 0.001)
+            try:
+                logs[p], _ = proc.communicate(timeout=left)
+            except subprocess.TimeoutExpired:
+                raise TimeoutError(
+                    f"multihost pod scenario {scenario!r}: process {p} "
+                    f"still running after {timeout_s:.0f}s — killed. "
+                    f"A wedged collective (dead peer) is the usual "
+                    f"cause.")
+    finally:
+        for _, proc, _ in procs:
+            if proc.poll() is None:
+                proc.kill()
+                proc.communicate()
+    results = []
+    for p, proc, out in procs:
+        if proc.returncode != 0:
+            tail = "\n".join((logs.get(p) or "").splitlines()[-30:])
+            raise RuntimeError(
+                f"multihost pod scenario {scenario!r}: process {p} "
+                f"exited rc={proc.returncode}\n--- tail of its output "
+                f"---\n{tail}")
+        with open(out + ".json") as f:
+            info = json.load(f)
+        with np.load(out + ".npz", allow_pickle=False) as data:
+            outputs = {name: data[name] for name in data.files}
+        results.append((info, outputs))
+    return results
+
+
+# ---------------------------------------------------------------------------
+# Checks (shared by tests/test_multihost.py and the __graft_entry__ dryrun)
+# ---------------------------------------------------------------------------
+
+
+def _assert_outputs_equal(got: Dict[str, np.ndarray],
+                          want: Dict[str, np.ndarray],
+                          what: str) -> None:
+    assert set(got) == set(want), (
+        f"{what}: output key mismatch {set(got) ^ set(want)}")
+    for name in sorted(want):
+        assert np.array_equal(np.asarray(got[name]),
+                              np.asarray(want[name])), (
+            f"{what}: {name!r} differs\n got={got[name]!r}\n "
+            f"want={want[name]!r}")
+
+
+def check_identity_results(results: List[Tuple[dict, dict]],
+                           reference: Dict[str, np.ndarray]) -> str:
+    """Asserts the identity scenario: every controller produced the same
+    outputs, bit-identical to the single-process reference, with equal
+    budget-ledger counts and no journal cross-talk."""
+    assert len(results) == POD_PROCESSES
+    for p, (info, outputs) in enumerate(results):
+        assert info["process_index"] == p
+        assert info["n_devices"] == POD_PROCESSES * POD_DEVICES_PER_PROCESS
+        assert info["n_local_devices"] == POD_DEVICES_PER_PROCESS
+        assert not info["fully_addressable"], (
+            "the pod mesh must span processes")
+        _assert_outputs_equal(outputs, reference,
+                              f"process {p} vs single-process reference")
+    mech = {int(outputs["mechanism_count"][0])
+            for _, outputs in results}
+    mech.add(int(reference["mechanism_count"][0]))
+    assert len(mech) == 1, (
+        f"budget-ledger mechanism counts diverged across topologies: "
+        f"{mech}")
+    kept = int(np.asarray(reference["dense_keep"]).sum())
+    return (f"{POD_PROCESSES} processes x {POD_DEVICES_PER_PROCESS} "
+            f"devices == 1 process x "
+            f"{POD_PROCESSES * POD_DEVICES_PER_PROCESS} devices "
+            f"bit-identical on all four drivers + engine "
+            f"({kept} dense partitions kept, "
+            f"{len(reference['blk_ids'])} blocked partitions, ledger "
+            f"{int(reference['mechanism_count'][0])} mechanisms)")
+
+
+def check_host_loss_results(results: List[Tuple[dict, dict]],
+                            reference: Dict[str, np.ndarray]) -> str:
+    """Asserts the host-loss scenario: the surviving controller finished
+    bit-identically to the fault-free reference with DEGRADED health and
+    the loss counters incremented; the lost controller evacuated."""
+    assert len(results) == POD_PROCESSES
+    survivor_info, survivor_out = results[0]
+    evacuated_info, _ = results[-1]
+    assert not survivor_info["evacuated"], (
+        "the surviving controller must complete, not evacuate")
+    assert evacuated_info["evacuated"], (
+        "the lost controller must raise HostEvacuatedError")
+    _assert_outputs_equal(survivor_out, reference,
+                          "surviving process vs fault-free reference")
+    counters = survivor_info["counters"]
+    assert counters.get("host_losses", 0) >= 1, counters
+    assert counters.get("mesh_degradations", 0) >= 1, counters
+    assert counters.get("journal_replays", 0) >= 1, counters
+    states = set(survivor_info["health"].values())
+    assert "DEGRADED" in states, survivor_info["health"]
+    return (f"whole-host loss: survivor completed bit-identically "
+            f"(mesh_degradations="
+            f"{counters.get('mesh_degradations')}, host_losses="
+            f"{counters.get('host_losses')}, journal_replays="
+            f"{counters.get('journal_replays')}), lost controller "
+            f"evacuated cleanly")
+
+
+# ---------------------------------------------------------------------------
+# Bench receipt
+# ---------------------------------------------------------------------------
+
+
+def multihost_receipt(mesh=None) -> Dict[str, object]:
+    """The multihost_* bench-receipt keys: process topology, per-process
+    ingest overlap (each controller parses/encodes only its shard — the
+    overlap factor is the process count on an evenly-sharded stream),
+    and the cross-host share of the collective-reshard exchange volume
+    (geometry fraction x the traced exchange bytes)."""
+    import jax
+
+    from pipelinedp_tpu.parallel import mesh as mesh_lib
+    from pipelinedp_tpu.runtime import trace as rt_trace
+
+    if mesh is None:
+        mesh = mesh_lib.make_mesh()
+    frac = mesh_lib.cross_process_fraction(mesh)
+    exchanged = 0
+    for ev in rt_trace.to_trace_events().get("traceEvents", []):
+        if ev.get("name") == "reshard.collective":
+            exchanged += int(ev.get("args", {}).get("bytes", 0) or 0)
+    return {
+        "multihost_processes": int(jax.process_count()),
+        "multihost_local_devices": len(mesh_lib.local_devices(mesh)),
+        "multihost_mesh_devices": int(mesh.devices.size),
+        "multihost_per_process_ingest_overlap": int(jax.process_count()),
+        "multihost_cross_host_fraction": round(frac, 4),
+        "multihost_cross_host_exchange_bytes": int(exchanged * frac),
+    }
+
+
+if __name__ == "__main__":
+    if len(sys.argv) != 3:
+        raise SystemExit(
+            "usage: python -m pipelinedp_tpu.runtime.multihost "
+            "<scenario> <out_path>")
+    raise SystemExit(_child_main(sys.argv[1], sys.argv[2]))

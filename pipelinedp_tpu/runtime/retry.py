@@ -105,6 +105,16 @@ class MeshDegradationError(RuntimeError):
     needed to resume elsewhere."""
 
 
+class HostEvacuatedError(MeshDegradationError):
+    """A whole-host loss left THIS controller with no addressable devices
+    in the rebuilt mesh: the job continues bit-identically on the
+    surviving hosts (block keys are geometry-independent), but this
+    process can no longer participate — it holds no shard of the mesh to
+    drive. Raised instead of silently idling so the launcher can reap
+    the evacuated controller; the surviving processes complete the run
+    and their journals/health carry the degradation record."""
+
+
 def is_device_fatal(exc: BaseException) -> bool:
     """Whether the failure means a device dropped off the mesh.
 
@@ -365,6 +375,14 @@ def run_with_mesh_degradation(run: Callable,
     raise MeshDegradationError naming the job_id and the journal path a
     resume needs; the job's health record reports FAILED.
 
+    Multi-controller meshes extend the same loop to WHOLE-HOST loss: a
+    controller process whose every device dropped is counted as a host
+    loss (host_losses telemetry), the mesh rebuilds over the surviving
+    hosts' devices, and the run re-enters bit-identically — while a
+    controller left with no addressable devices in the rebuilt mesh
+    raises HostEvacuatedError (it cannot drive a mesh it cannot
+    address; the surviving processes carry the run).
+
     Returns whatever run()/fallback() returns.
     """
     from pipelinedp_tpu.parallel import mesh as mesh_lib
@@ -391,6 +409,21 @@ def run_with_mesh_degradation(run: Callable,
                 raise
             telemetry.record("device_losses")
             live = mesh_lib.probe_live_devices(list(current.devices.flat))
+            # Whole-host accounting: a controller process whose every
+            # device dropped is a HOST loss (power/network/runtime death
+            # takes all its chips together) — surfaced distinctly so
+            # operators can tell one dead chip from one dead machine.
+            procs_before = set(mesh_lib.mesh_processes(current))
+            procs_alive = {mesh_lib.device_process(d) for d in live}
+            dead_procs = sorted(procs_before - procs_alive)
+            if dead_procs:
+                telemetry.record("host_losses", len(dead_procs))
+                logging.warning(
+                    "whole-host loss for job %r: controller process(es) "
+                    "%s lost every device; the mesh rebuilds over the "
+                    "surviving host(s) and the run continues "
+                    "bit-identically (block keys are geometry-"
+                    "independent).", job_id, dead_procs)
             # Shrink by at least one even if every device answers the
             # probe (transiently-wedged chips can ack a trivial program):
             # the failed dispatch names this geometry as unusable.
@@ -413,6 +446,20 @@ def run_with_mesh_degradation(run: Callable,
                     f"blocks replay, the rest re-derive the same "
                     f"fold_in keys.") from e
             telemetry.record("mesh_degradations")
+            survivors = live[:target]
+            me = mesh_lib.process_index()
+            if (len(procs_before) > 1 and
+                    all(mesh_lib.device_process(d) != me
+                        for d in survivors)):
+                # This controller's own host lost its devices: the
+                # surviving processes rebuild without it, and a mesh this
+                # process cannot address is a mesh it cannot drive.
+                raise HostEvacuatedError(
+                    f"job {job_id!r}: whole-host loss evacuated this "
+                    f"controller (process {me}) — none of the {target} "
+                    f"surviving devices are addressable here. The job "
+                    f"continues on the surviving host(s); this process "
+                    f"should exit and be reaped by the launcher.") from e
             logging.warning(
                 "device loss for job %r (%s: %s); rebuilding a %d-device "
                 "mesh from %d survivors (planned %d) and re-entering the "
@@ -421,4 +468,4 @@ def run_with_mesh_degradation(run: Callable,
                 "degraded run is a replay of the same release.", job_id,
                 type(e).__name__,
                 str(e).splitlines()[0][:160], target, len(live), planned)
-            current = mesh_lib.make_mesh(devices=live[:target])
+            current = mesh_lib.make_mesh(devices=survivors)

@@ -76,9 +76,16 @@ REGISTRY: Dict[str, Metric] = {
         _counter("device_losses",
                  "device-fatal failures observed (a chip dropped off the "
                  "mesh)"),
+        _counter("host_losses",
+                 "whole-host losses observed (a controller process lost "
+                 "every one of its devices at once)"),
         _counter("mesh_degradations",
                  "elastic mesh rebuilds onto fewer devices after a "
                  "device loss"),
+        _counter("reshard_capacity_reuse",
+                 "collective reshard exchanges that reused a cached "
+                 "padded capacity for their geometry (the stats fetch "
+                 "overlapped the exchange instead of gating it)"),
         _counter("injected_faults",
                  "faults raised by the injection harness"),
         _counter("budget_registrations",

@@ -730,6 +730,8 @@ KNOB_VALIDATORS: Dict[str, str] = {
     "trace": "validate_trace",
     "pipeline_depth": "validate_pipeline_depth",
     "encode_threads": "validate_encode_threads",
+    "num_processes": "validate_num_processes",
+    "coordinator_address": "validate_coordinator_address",
 }
 
 # Data-plane parameters: configuration, not failure semantics — adding

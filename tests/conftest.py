@@ -57,6 +57,12 @@ def pytest_configure(config):
         "thread pool, staging queue, donated accumulator) — "
         "bit-identity, backpressure and fault tests (tier-1, NOT slow; "
         "select alone with -m pipeline)")
+    config.addinivalue_line(
+        "markers",
+        "multihost: multi-controller pod scale-out — process-topology "
+        "helpers, process-scoped journals, whole-host loss, and the "
+        "spawn-based 2-process jax.distributed CPU dryrun (tier-1, NOT "
+        "slow; select alone with -m multihost)")
 
 
 @pytest.fixture(autouse=True)

@@ -236,6 +236,16 @@ CLEAN = {
             "import numpy as np\n"
             "def f(x):\n"
             "    return np.asarray(x)\n"),
+        # The multi-process count exchange: the on-device-reduced,
+        # replicated stats vector crossing through mesh.host_fetch is
+        # the ONLY sanctioned host traffic on the cross-host reshard
+        # path — and host_fetch routing needs no suppression.
+        "pipelinedp_tpu/parallel/fix_exchange.py": (
+            "from pipelinedp_tpu.parallel.mesh import host_fetch\n"
+            "def exchange_capacities(stats_dev):\n"
+            "    max_send, max_recv, total = (\n"
+            "        int(x) for x in host_fetch(stats_dev))\n"
+            "    return max_send, max_recv, total\n"),
     },
     "lock-discipline": {
         "pipelinedp_tpu/fix_lock.py": (
