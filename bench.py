@@ -859,6 +859,28 @@ def main():
         }
         for job, snap in rt_health.snapshot_all().items()
     }
+    # Fleet observability keys: the device-memory watermark the run
+    # peaked at (platform memory stats on TPU, the byte-accounted
+    # fallback on CPU), and the privacy-budget odometer reconciled
+    # against the headline accountant's ledger — a receipt whose
+    # odometer does not reconcile is flagging a registration that
+    # bypassed the audit trail.
+    from pipelinedp_tpu.runtime import observability as rt_obs
+    memory_watermarks = rt_obs.memory_watermark()
+    odo = rt_obs.odometer_report(accountant=accountant)
+    odometer_detail = {
+        "mechanisms": odo["mechanisms"],
+        "spent_epsilon": round(odo["spent_epsilon"], 8),
+        "total_epsilon": odo["total_epsilon"],
+        "remaining_epsilon": round(odo["remaining_epsilon"], 8),
+        "reconciled": odo["reconciled"],
+        "by_metric": {
+            metric: sum(1 for r in odo["records"]
+                        if (r["metric"] or "?") == metric)
+            for metric in sorted({r["metric"] or "?"
+                                  for r in odo["records"]})
+        },
+    }
     # Static-analysis gate state rides along with the perf numbers: the
     # finding count + rule version in every receipt means a lint
     # regression (or a rule-set change that re-opens triage) shows up
@@ -904,6 +926,8 @@ def main():
                 "runtime_fault_counters": fault_counters,
                 "runtime_phase_timings": phase_timings,
                 "runtime_job_health": job_health,
+                "memory_watermarks": memory_watermarks,
+                "odometer": odometer_detail,
                 "staticcheck": staticcheck_detail,
                 **({"device_fallback": fallback} if fallback else {}),
                 # CPU-fallback runs carry the newest committed device

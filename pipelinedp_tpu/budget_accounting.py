@@ -246,14 +246,31 @@ class BudgetAccountant(abc.ABC):
         # double-spend bug (a registration during execution) is visible
         # in the trace exactly where it happened. Lazy import: this
         # module must stay importable without the runtime package.
-        from pipelinedp_tpu.runtime import telemetry
+        from pipelinedp_tpu.runtime import observability, telemetry
         telemetry.record(
             "budget_registrations",
             mechanism_type=str(
                 getattr(mechanism.mechanism_spec, "mechanism_type", "")))
+        # The privacy-budget odometer: one ordered audit record per
+        # registration (job/metric/kind/process provenance; the eps and
+        # delta shares resolve through the SHARED spec once
+        # compute_budgets fills it). odometer_report() reconciles the
+        # trail against mechanism_count and spent_epsilon() exactly.
+        observability.record_mechanism(self, mechanism)
         for scope in self._scopes_stack:
             scope.mechanisms.append(mechanism)
         return mechanism
+
+    def spent_epsilon(self) -> float:
+        """Epsilon the ledger has apportioned so far: the sum of every
+        computed mechanism's eps share weighted by its application
+        count (0.0 before compute_budgets). The odometer's per-record
+        eps values sum to exactly this number — the reconciliation the
+        audit trail is checked against."""
+        return sum(
+            m.mechanism_spec._eps * m.mechanism_spec.count
+            for m in self._mechanisms
+            if m.mechanism_spec._eps is not None)
 
     def _enter_scope(self, scope):
         self._scopes_stack.append(scope)

@@ -648,13 +648,25 @@ def create_compound_combiner(
         budget_accountant: budget_accounting.BudgetAccountant
 ) -> CompoundCombiner:
     """Builds the CompoundCombiner for the requested metrics, requesting one
-    budget per mechanism (reference :791-858)."""
+    budget per mechanism (reference :791-858).
+
+    Each request is wrapped in observability.mechanism_label so the
+    privacy-budget odometer's audit records carry the DP metric the
+    mechanism serves (count/sum/...), not just its noise kind.
+    """
+    # Lazy import: combiners must stay importable without the runtime
+    # package (the generic backends use them standalone).
+    from pipelinedp_tpu.runtime import observability
     combiners = []
     mechanism_type = params.noise_kind.convert_to_mechanism_type()
 
+    def request(metric_label: str):
+        with observability.mechanism_label(metric_label):
+            return budget_accountant.request_budget(
+                mechanism_type, weight=params.budget_weight)
+
     if Metrics.VARIANCE in params.metrics:
-        budget_variance = budget_accountant.request_budget(
-            mechanism_type, weight=params.budget_weight)
+        budget_variance = request('variance')
         metrics_to_compute = ['variance']
         if Metrics.MEAN in params.metrics:
             metrics_to_compute.append('mean')
@@ -666,10 +678,8 @@ def create_compound_combiner(
             VarianceCombiner(CombinerParams(budget_variance, params),
                              metrics_to_compute))
     elif Metrics.MEAN in params.metrics:
-        budget_count = budget_accountant.request_budget(
-            mechanism_type, weight=params.budget_weight)
-        budget_sum = budget_accountant.request_budget(
-            mechanism_type, weight=params.budget_weight)
+        budget_count = request('count')
+        budget_sum = request('sum')
         metrics_to_compute = ['mean']
         if Metrics.COUNT in params.metrics:
             metrics_to_compute.append('count')
@@ -679,32 +689,25 @@ def create_compound_combiner(
             MeanCombiner(budget_count, budget_sum, params, metrics_to_compute))
     else:
         if Metrics.COUNT in params.metrics:
-            budget_count = budget_accountant.request_budget(
-                mechanism_type, weight=params.budget_weight)
-            combiners.append(CountCombiner(budget_count, params))
+            combiners.append(CountCombiner(request('count'), params))
         if Metrics.SUM in params.metrics:
-            budget_sum = budget_accountant.request_budget(
-                mechanism_type, weight=params.budget_weight)
-            combiners.append(SumCombiner(budget_sum, params))
+            combiners.append(SumCombiner(request('sum'), params))
     if Metrics.PRIVACY_ID_COUNT in params.metrics:
-        budget_pid_count = budget_accountant.request_budget(
-            mechanism_type, weight=params.budget_weight)
-        combiners.append(PrivacyIdCountCombiner(budget_pid_count, params))
-    if Metrics.VECTOR_SUM in params.metrics:
-        budget_vector_sum = budget_accountant.request_budget(
-            mechanism_type, weight=params.budget_weight)
         combiners.append(
-            VectorSumCombiner(CombinerParams(budget_vector_sum, params)))
+            PrivacyIdCountCombiner(request('privacy_id_count'), params))
+    if Metrics.VECTOR_SUM in params.metrics:
+        combiners.append(
+            VectorSumCombiner(
+                CombinerParams(request('vector_sum'), params)))
 
     percentiles_to_compute = [
         metric.parameter for metric in params.metrics if metric.is_percentile
     ]
     if percentiles_to_compute:
-        budget_percentile = budget_accountant.request_budget(
-            mechanism_type, weight=params.budget_weight)
         combiners.append(
-            QuantileCombiner(CombinerParams(budget_percentile, params),
-                             percentiles_to_compute))
+            QuantileCombiner(
+                CombinerParams(request('percentile'), params),
+                percentiles_to_compute))
 
     return CompoundCombiner(combiners, return_named_tuple=True)
 

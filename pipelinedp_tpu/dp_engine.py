@@ -308,8 +308,10 @@ class DPEngine:
             pre_threshold: Optional[int]):
         """Filters partitions by the DP selection strategy, reading the
         privacy-id count from the compound accumulator's row count."""
-        budget = self._budget_accountant.request_budget(
-            mechanism_type=agg_params.MechanismType.GENERIC)
+        from pipelinedp_tpu.runtime import observability as rt_observability
+        with rt_observability.mechanism_label("partition_selection"):
+            budget = self._budget_accountant.request_budget(
+                mechanism_type=agg_params.MechanismType.GENERIC)
 
         def filter_fn(budget, max_partitions, max_rows_per_privacy_id,
                       strategy, pre_threshold, row) -> bool:

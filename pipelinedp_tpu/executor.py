@@ -53,6 +53,7 @@ from pipelinedp_tpu.ops import noise as noise_ops
 from pipelinedp_tpu.ops import secure_noise
 from pipelinedp_tpu.ops import segment_ops
 from pipelinedp_tpu.ops import selection_ops
+from pipelinedp_tpu.runtime import observability as rt_observability
 from pipelinedp_tpu.runtime import pipeline as rt_pipeline
 from pipelinedp_tpu.runtime import trace as rt_trace
 from pipelinedp_tpu.runtime import watchdog as rt_watchdog
@@ -1151,8 +1152,9 @@ def lazy_select_partitions(backend, col, params, data_extractors,
     stage runs shard-local (rows sharded by privacy id) and the counts are
     psum'd over the mesh (parallel/sharded.sharded_select_partitions).
     """
-    budget = budget_accountant.request_budget(
-        mechanism_type=MechanismType.GENERIC)
+    with rt_observability.mechanism_label("partition_selection"):
+        budget = budget_accountant.request_budget(
+            mechanism_type=MechanismType.GENERIC)
     strategy = params.partition_selection_strategy
     pre_threshold_str = (f", pre_threshold={params.pre_threshold}"
                          if params.pre_threshold else "")
@@ -1374,8 +1376,9 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
     private = public_partitions is None
     selection_budget = None
     if private:
-        selection_budget = budget_accountant.request_budget(
-            mechanism_type=MechanismType.GENERIC)
+        with rt_observability.mechanism_label("partition_selection"):
+            selection_budget = budget_accountant.request_budget(
+                mechanism_type=MechanismType.GENERIC)
 
     # Report stages (mirrors the generic path narration).
     if not private:

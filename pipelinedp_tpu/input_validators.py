@@ -174,6 +174,42 @@ def validate_encode_threads(encode_threads, obj_name: str) -> None:
             f"threads feeding the staging queue (None auto-sizes).")
 
 
+def validate_metrics_port(metrics_port, obj_name: str) -> None:
+    """Validates the live-metrics scrape port: an integer in [0, 65535].
+
+    Raises:
+        ValueError: metrics_port is not an integer in range (0 binds an
+        ephemeral port — read it back from the exporter; a float or a
+        path passed here was probably meant for metrics_path).
+    """
+    if (not isinstance(metrics_port, numbers.Number) or
+            isinstance(metrics_port, bool) or
+            metrics_port != int(metrics_port) or
+            not 0 <= metrics_port <= 65535):
+        raise ValueError(
+            f"{obj_name}: metrics_port must be an integer in [0, 65535], "
+            f"but {metrics_port!r} given — it binds the Prometheus "
+            f"scrape endpoint on 127.0.0.1 (0 picks an ephemeral port; "
+            f"use metrics_path for the portless file mode).")
+
+
+def validate_metrics_path(metrics_path, obj_name: str) -> None:
+    """Validates the atomic-file metrics export path: a non-empty string
+    naming a file in an existing (or creatable) directory.
+
+    Raises:
+        ValueError: metrics_path is not a non-empty string (the portless
+        scrape mode re-writes this file atomically on an interval; a
+        port number passed here was probably meant for metrics_port).
+    """
+    if not isinstance(metrics_path, str) or not metrics_path.strip():
+        raise ValueError(
+            f"{obj_name}: metrics_path must be a non-empty file path "
+            f"string, but {metrics_path!r} given — the file-mode "
+            f"exporter atomically re-writes the Prometheus text there "
+            f"(use metrics_port for the HTTP endpoint).")
+
+
 def validate_num_processes(num_processes, obj_name: str) -> None:
     """Validates the multi-controller process count: an integer >= 1.
 

@@ -432,6 +432,19 @@ class TPUBackend(LocalBackend):
             dump_trace(path) (Chrome/Perfetto trace-event JSON) or read
             trace_summary(). Off (the default) costs one bool check per
             call site — the blocked-driver hot path is unaffected.
+        metrics_port: live Prometheus scrape endpoint
+            (runtime/observability.py). When set, a background thread
+            serves every declared counter and gauge (queue depth, live
+            devices, health states, budget remaining, memory
+            watermarks) per job_id at
+            http://127.0.0.1:<port>/metrics WHILE runs are in flight —
+            0 binds an ephemeral port, read back via
+            backend.metrics_endpoint(). None (default) serves nothing.
+        metrics_path: the portless scrape mode for CI sandboxes that
+            cannot open sockets: the same Prometheus text re-written
+            atomically (write-then-rename, never torn) to this file
+            every ~250ms. Combinable with metrics_port; None (default)
+            writes nothing.
     """
 
     def __init__(self,
@@ -453,7 +466,9 @@ class TPUBackend(LocalBackend):
                  pipeline_depth: Optional[int] = None,
                  encode_threads: Optional[int] = None,
                  coordinator_address: Optional[str] = None,
-                 num_processes: Optional[int] = None):
+                 num_processes: Optional[int] = None,
+                 metrics_port: Optional[int] = None,
+                 metrics_path: Optional[str] = None):
         super().__init__(seed=noise_seed)
         if reshard not in ("auto", "host", "device"):
             raise ValueError(
@@ -486,6 +501,12 @@ class TPUBackend(LocalBackend):
         if coordinator_address is not None:
             input_validators.validate_coordinator_address(
                 coordinator_address, "TPUBackend")
+        if metrics_port is not None:
+            input_validators.validate_metrics_port(
+                metrics_port, "TPUBackend")
+        if metrics_path is not None:
+            input_validators.validate_metrics_path(
+                metrics_path, "TPUBackend")
         if (coordinator_address is None) != (num_processes is None):
             raise ValueError(
                 "TPUBackend: coordinator_address and num_processes must "
@@ -518,9 +539,23 @@ class TPUBackend(LocalBackend):
         self.encode_threads = encode_threads
         self.coordinator_address = coordinator_address
         self.num_processes = num_processes
+        self.metrics_port = metrics_port
+        self.metrics_path = metrics_path
         if trace:
             from pipelinedp_tpu.runtime import trace as rt_trace
             rt_trace.enable()
+        # Live metrics exporters (HTTP endpoint and/or atomic file):
+        # started here so counters and gauges are scrapeable from the
+        # first aggregation, stopped via stop_metrics().
+        self._metrics_exporters = []
+        if metrics_port is not None or metrics_path is not None:
+            from pipelinedp_tpu.runtime import observability as rt_obs
+            if metrics_port is not None:
+                self._metrics_exporters.append(
+                    rt_obs.start_exporter(port=metrics_port))
+            if metrics_path is not None:
+                self._metrics_exporters.append(
+                    rt_obs.start_exporter(path=metrics_path))
         # Job ids whose health this backend's aggregations fed (the
         # executor records them as it resolves/derives them).
         self._health_jobs = set()
@@ -559,6 +594,46 @@ class TPUBackend(LocalBackend):
         if jobs:
             return {j: s for j, s in snaps.items() if j in jobs}
         return snaps
+
+    def odometer(self, job_id: Optional[str] = None,
+                 accountant=None) -> dict:
+        """The privacy-budget odometer: spent-vs-remaining over the
+        ordered per-mechanism audit trail (one record per
+        BudgetAccountant registration — job, metric, mechanism kind,
+        eps/delta share, process provenance). Filter by job_id and/or
+        a specific accountant; with an accountant the report includes
+        total/remaining epsilon and `reconciled` (record count ==
+        mechanism_count AND eps shares sum exactly to the ledger's
+        spent epsilon). See runtime/observability.odometer_report."""
+        from pipelinedp_tpu.runtime import observability as rt_obs
+        return rt_obs.odometer_report(accountant=accountant,
+                                      job_id=job_id)
+
+    def scrape_metrics(self) -> str:
+        """The current Prometheus exposition text (counters + gauges,
+        gauge sources refreshed) — the same bytes the metrics_port
+        endpoint and metrics_path file serve. Works without either
+        knob."""
+        from pipelinedp_tpu.runtime import observability as rt_obs
+        return rt_obs.render_prometheus()
+
+    def metrics_endpoint(self) -> Optional[str]:
+        """The live scrape address: the HTTP URL when metrics_port is
+        configured (resolved ephemeral port included), else the
+        metrics_path file, else None."""
+        for exporter in self._metrics_exporters:
+            if exporter.port is not None:
+                return exporter.endpoint
+        for exporter in self._metrics_exporters:
+            return exporter.endpoint
+        return None
+
+    def stop_metrics(self) -> None:
+        """Stops this backend's metrics exporters (the HTTP server
+        thread and/or the file re-writer)."""
+        for exporter in self._metrics_exporters:
+            exporter.stop()
+        self._metrics_exporters = []
 
 
 # Lambdas cannot be pickled for Pool.map; with the fork start method the

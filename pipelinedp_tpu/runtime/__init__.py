@@ -56,6 +56,17 @@ semantics:
     trace_summary (top spans by inclusive/exclusive wall time,
     transferred bytes, compile seconds per entry point) — the layer
     that attributes the kernel-vs-end-to-end throughput gap.
+  * observability — the fleet observability plane: Prometheus-text
+    live export (HTTP scrape endpoint or atomic-file mode for portless
+    CI) of every declared counter and gauge, device-memory watermarks
+    (platform memory stats with a byte-accounted CPU fallback, attached
+    to trace spans and OOM-degradation events), the privacy-budget
+    odometer (one ordered, journal-persistable audit record per
+    mechanism registration, reconciling exactly with
+    BudgetAccountant.mechanism_count and spent epsilon), and the
+    collective-free cross-process rollup that merges every controller's
+    counters/health/trace into one pod view with a distinct Perfetto
+    track per process.
   * pipeline — the device-resident streaming executor: a bounded
     staging queue fed by a host encode thread pool (ChunkSource ->
     map_overlapped) and a buffer-donating device accumulator
@@ -75,9 +86,11 @@ is a replay of the same release, not a second one.
 from pipelinedp_tpu.runtime import entry
 from pipelinedp_tpu.runtime import faults
 from pipelinedp_tpu.runtime import health
+from pipelinedp_tpu.runtime import observability
 from pipelinedp_tpu.runtime import pipeline
 from pipelinedp_tpu.runtime import telemetry
 from pipelinedp_tpu.runtime import trace
+from pipelinedp_tpu.runtime.observability import MetricsExporter
 from pipelinedp_tpu.runtime.health import HealthState, JobHealth
 from pipelinedp_tpu.runtime.pipeline import (PIPELINE_DEPTH, ChunkSource,
                                              DeviceRowAccumulator)
@@ -100,12 +113,14 @@ __all__ = [
     "JobHealth",
     "JournalCorruptionError",
     "MeshDegradationError",
+    "MetricsExporter",
     "PIPELINE_DEPTH",
     "RetryPolicy",
     "Watchdog",
     "entry",
     "faults",
     "health",
+    "observability",
     "pipeline",
     "is_device_fatal",
     "retry_call",

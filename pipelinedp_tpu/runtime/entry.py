@@ -139,6 +139,21 @@ def runtime_entry(kind: str, fallback: Optional[Callable] = None):
                     result = fn(*args, job_id=job, **kwargs)
                 rt_telemetry.record_duration(kind,
                                              time.perf_counter() - t0)
+            if kwargs.get("journal") is not None:
+                # Teardown audit persist: the ordered budget-odometer
+                # trail rides the journal's durability (CRC, fsync-then-
+                # rename) and process scoping, so a resume — or an
+                # auditor — replays mechanism provenance from the same
+                # store the block results live in. Best-effort: a failed
+                # persist must not fail a completed run.
+                from pipelinedp_tpu.runtime import observability
+                try:
+                    observability.persist_odometer(kwargs["journal"], job)
+                except Exception as e:  # noqa: BLE001 - audit persistence is an observer; the run's results are already safe
+                    logging.warning(
+                        "%s: odometer persist to journal failed (%s: "
+                        "%s); the in-memory audit trail is unaffected.",
+                        kind, type(e).__name__, e)
             return result
 
         return wrapper

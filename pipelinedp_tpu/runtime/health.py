@@ -174,6 +174,10 @@ class JobHealth:
             self._live_devices = int(live_devices)
             if live_devices < planned_devices:
                 self._escalate(HealthState.DEGRADED)
+        # Outside the lock (set_gauge takes telemetry's lock; never
+        # nest the two): the live-device level is scrapeable mid-run.
+        telemetry.set_gauge("live_devices", int(live_devices),
+                            job_id=self.job_id)
 
     def note_recovered(self) -> None:
         """A stalled operation completed (late) or its retry succeeded:

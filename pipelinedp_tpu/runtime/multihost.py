@@ -262,11 +262,25 @@ def run_pod_engine(mesh) -> Dict[str, np.ndarray]:
     acc.compute_budgets()
     result = dict(result)
     pks = sorted(result)
+    # The budget odometer rides the bit-identity contract: every
+    # controller (and the single-process reference) derives the SAME
+    # audit trail for this ledger — record count == mechanism_count and
+    # the per-mechanism eps shares sum EXACTLY to the ledger's spent
+    # epsilon, asserted here and compared bitwise across topologies
+    # through the outputs.
+    from pipelinedp_tpu.runtime import observability
+    odo = observability.odometer_report(accountant=acc)
+    assert odo["reconciled"], odo
+    assert odo["mechanisms"] == acc.mechanism_count, odo
+    assert odo["spent_epsilon"] == acc.spent_epsilon(), odo
     return {
         "engine_pks": np.asarray([str(k) for k in pks]),
         "engine_counts": np.asarray([result[k].count for k in pks]),
         "engine_sums": np.asarray([result[k].sum for k in pks]),
         "mechanism_count": np.asarray([acc.mechanism_count]),
+        "odometer_mechanisms": np.asarray([odo["mechanisms"]]),
+        "odometer_spent_eps": np.asarray([odo["spent_epsilon"]],
+                                         dtype=np.float64),
     }
 
 
@@ -351,12 +365,24 @@ def reference_identity_outputs(tmp_journal_dir: Optional[str] = None
 
 
 def _child_main(scenario: str, out_path: str) -> int:
-    """Entry point of one spawned controller (see spawn_local_pod)."""
+    """Entry point of one spawned controller (see spawn_local_pod).
+
+    Every child runs fully OBSERVED: tracing + per-span memory sampling
+    on, a portless file metrics exporter live for the whole run (read
+    back MID-RUN into info["scrape"] — the scrapeable-while-in-flight
+    proof), and a full observability export (counters, gauges, health,
+    odometer, trace buffer under this controller's process index as its
+    Perfetto pid) written at teardown. Process 0 then performs the
+    collective-free host-side gather: it waits for its siblings' export
+    files and writes the merged pod rollup (one trace, both tracks).
+    """
     import jax
 
     from pipelinedp_tpu.parallel import mesh as mesh_lib
+    from pipelinedp_tpu.runtime import observability as rt_obs
     from pipelinedp_tpu.runtime import retry as rt_retry
     from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+    from pipelinedp_tpu.runtime import trace as rt_trace
     from pipelinedp_tpu.runtime import health as rt_health
 
     coordinator = os.environ[ENV_COORDINATOR]
@@ -368,9 +394,16 @@ def _child_main(scenario: str, out_path: str) -> int:
                                     process_id)
     assert jax.process_count() == num_processes
     mesh = mesh_lib.make_mesh()
-    journal_dir = os.path.join(os.path.dirname(out_path), "journal")
+    out_dir = os.path.dirname(out_path)
+    journal_dir = os.path.join(out_dir, "journal")
+    rt_trace.enable()
+    rt_obs.enable_memory_sampling()
+    me = mesh_lib.process_index()
+    exporter = rt_obs.start_exporter(
+        path=os.path.join(out_dir, f"metrics_p{me}.prom"),
+        interval_s=0.2)
     info: Dict[str, object] = {
-        "process_index": mesh_lib.process_index(),
+        "process_index": me,
         "n_devices": int(mesh.devices.size),
         "n_local_devices": len(mesh_lib.local_devices(mesh)),
         "fully_addressable": mesh_lib.is_fully_addressable(mesh),
@@ -385,6 +418,12 @@ def _child_main(scenario: str, out_path: str) -> int:
         with reshard.forbid_row_fetches():
             outputs.update(run_pod_workload(mesh,
                                             journal_dir=journal_dir))
+        # MID-RUN scrape: the drivers above are drained but the engine
+        # half of this controller's job is still ahead — the exporter
+        # file at this instant is what an external scraper would see
+        # while the pod is in flight.
+        with open(exporter.path) as f:
+            info["scrape"] = f.read()
         outputs.update(run_pod_engine(mesh))
     elif scenario == "host_loss":
         lost = num_processes - 1
@@ -394,6 +433,8 @@ def _child_main(scenario: str, out_path: str) -> int:
         except rt_retry.HostEvacuatedError as e:
             info["evacuated"] = True
             info["evacuation_error"] = str(e)[:500]
+        with open(exporter.path) as f:
+            info["scrape"] = f.read()
     else:
         raise SystemExit(f"unknown scenario {scenario!r}")
     info["counters"] = dict(rt_telemetry.snapshot())
@@ -404,6 +445,14 @@ def _child_main(scenario: str, out_path: str) -> int:
     np.savez(out_path + ".npz", **outputs)
     with open(out_path + ".json", "w") as f:
         json.dump(info, f)
+    # Teardown observability gather: every controller exports its own
+    # state atomically; process 0 merges whatever its siblings managed
+    # to write into the pod rollup (a dead sibling costs coverage, not
+    # the rollup).
+    exporter.stop()
+    rt_obs.export_process_state(out_dir, process_index=me)
+    if me == 0:
+        rt_obs.write_pod_rollup(out_dir, num_processes, timeout_s=60.0)
     return 0
 
 
@@ -560,6 +609,80 @@ def check_host_loss_results(results: List[Tuple[dict, dict]],
             f"evacuated cleanly")
 
 
+def check_pod_observability(out_dir: str,
+                            results: List[Tuple[dict, dict]],
+                            scenario: str) -> str:
+    """Asserts the pod's merged observability plane (both scenarios):
+
+      * process 0 wrote the merged rollup (the collective-free teardown
+        gather), and the merged Perfetto trace carries span events from
+        BOTH controllers on distinct pid tracks with named
+        process_name metadata rows;
+      * each controller's mid-run metrics scrape parses under the
+        strict Prometheus line grammar and exposes counters;
+      * every incident appears in the merge EXACTLY ONCE per process
+        that recorded it: for each controller, the count of
+        ``host_losses`` (and ``injected_faults``) instants on its pid
+        track equals that controller's own counter — a merge that
+        double-ingested a per-process buffer would double it.
+    """
+    from pipelinedp_tpu.runtime import observability as rt_obs
+
+    rollup_path = os.path.join(out_dir, rt_obs.POD_ROLLUP_NAME)
+    assert os.path.exists(rollup_path), (
+        f"process 0 never wrote the pod rollup {rollup_path!r}")
+    with open(rollup_path) as f:
+        rollup = json.load(f)
+    expected_pids = list(range(len(results)))
+    assert rollup["processes"] == expected_pids, rollup["processes"]
+
+    events = rollup["trace"]["traceEvents"]
+    span_pids = {ev["pid"] for ev in events if ev.get("ph") == "X"}
+    assert span_pids == set(expected_pids), (
+        f"merged trace must carry spans from every controller on its "
+        f"own pid track: got pids {sorted(span_pids)}")
+    names = {
+        ev["pid"]: ev["args"]["name"]
+        for ev in events
+        if ev.get("ph") == "M" and ev.get("name") == "process_name"
+    }
+    for pid in expected_pids:
+        assert names.get(pid) == f"pipelinedp-tpu p{pid}", names
+
+    scraped_counters = 0
+    for info, _ in results:
+        parsed = rt_obs.parse_prometheus(info["scrape"])
+        counters = [n for n, entry in parsed.items()
+                    if entry["type"] == "counter"]
+        assert counters, "mid-run scrape exposed no counters"
+        scraped_counters = max(scraped_counters, len(counters))
+
+    # Exactly-once incident accounting across the merge.
+    once_checked = []
+    for incident in ("host_losses", "injected_faults",
+                     "mesh_degradations"):
+        for info, _ in results:
+            pid = info["process_index"]
+            on_track = sum(
+                1 for ev in events
+                if ev.get("ph") == "i" and ev["name"] == incident and
+                ev["pid"] == pid)
+            want = int(info["counters"].get(incident, 0))
+            assert on_track == want, (
+                f"{incident} appears {on_track}x on pid {pid}'s merged "
+                f"track but the controller counted {want} — the merge "
+                f"double- or under-ingested a per-process buffer")
+            if want:
+                once_checked.append(f"{incident}@p{pid}={want}")
+    assert not rollup.get("truncated"), (
+        "pod trace buffers overflowed — the merge under-reports")
+    return (f"pod rollup merged {len(expected_pids)} controllers "
+            f"(spans on pid tracks {sorted(span_pids)}, "
+            f"{scraped_counters} counters in the mid-run scrape"
+            + (f", incidents exactly-once: {', '.join(once_checked)}"
+               if once_checked else ", no incidents") + ")")
+
+
 # ---------------------------------------------------------------------------
 # Bench receipt
 # ---------------------------------------------------------------------------
@@ -569,11 +692,18 @@ def multihost_receipt(mesh=None) -> Dict[str, object]:
     """The multihost_* bench-receipt keys: process topology, per-process
     ingest overlap (each controller parses/encodes only its shard — the
     overlap factor is the process count on an evenly-sharded stream),
-    and the cross-host share of the collective-reshard exchange volume
-    (geometry fraction x the traced exchange bytes)."""
+    the cross-host share of the collective-reshard exchange volume
+    (geometry fraction x the traced exchange bytes), and
+    ``multihost_trace_merged`` — this run's trace pushed through the
+    export→aggregate→merge path (the machinery the 2-process dryrun
+    proves end to end; a single-controller bench truthfully reports one
+    track)."""
+    import tempfile
+
     import jax
 
     from pipelinedp_tpu.parallel import mesh as mesh_lib
+    from pipelinedp_tpu.runtime import observability as rt_obs
     from pipelinedp_tpu.runtime import trace as rt_trace
 
     if mesh is None:
@@ -583,6 +713,10 @@ def multihost_receipt(mesh=None) -> Dict[str, object]:
     for ev in rt_trace.to_trace_events().get("traceEvents", []):
         if ev.get("name") == "reshard.collective":
             exchanged += int(ev.get("args", {}).get("bytes", 0) or 0)
+    with tempfile.TemporaryDirectory() as tmp:
+        rt_obs.export_process_state(tmp)
+        pod = rt_obs.aggregate_directory(tmp)
+    merged_events = pod["trace"]["traceEvents"]
     return {
         "multihost_processes": int(jax.process_count()),
         "multihost_local_devices": len(mesh_lib.local_devices(mesh)),
@@ -590,6 +724,15 @@ def multihost_receipt(mesh=None) -> Dict[str, object]:
         "multihost_per_process_ingest_overlap": int(jax.process_count()),
         "multihost_cross_host_fraction": round(frac, 4),
         "multihost_cross_host_exchange_bytes": int(exchanged * frac),
+        "multihost_trace_merged": {
+            "processes": pod["processes"],
+            "span_tracks": sorted({
+                ev["pid"] for ev in merged_events
+                if ev.get("ph") == "X"
+            }),
+            "n_events": len(merged_events),
+            "truncated": pod["truncated"],
+        },
     }
 
 

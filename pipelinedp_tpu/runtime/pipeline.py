@@ -221,6 +221,10 @@ def map_overlapped(items: Iterable,
             if wd is not None:
                 wd.beat("pipeline")
             rt_telemetry.record("pipeline_chunks", chunk=idx)
+            # Post-pop staging depth: what a mid-run scrape sees. A
+            # persistently full gauge (== depth) means the device side
+            # is the bottleneck; persistently 0 means encode is.
+            rt_telemetry.set_gauge("pipeline_queue_depth", q.qsize())
             n_consumed += 1
             yield result
     finally:
@@ -351,10 +355,31 @@ class DeviceRowAccumulator:
         self._n = 0  # real rows accumulated
         self._bufs = None  # donating mode: (pid, pk, values)
         self._staged = []  # staged mode: (pid, pk, values, n_real)
+        self._accounted_bytes = 0
 
     @property
     def n_rows(self) -> int:
         return self._n
+
+    def _refresh_accounting(self) -> None:
+        """Folds this accumulator's device footprint into the byte
+        accountant (runtime/observability.py) — the array-shape fallback
+        that gives CPU runs (no platform memory stats) a watermark. The
+        donating path's transient donated-in/out pair is not modeled;
+        the steady-state buffer footprint is."""
+        from pipelinedp_tpu.runtime import observability
+        if self.donating:
+            now = (sum(int(b.nbytes) for b in self._bufs)
+                   if self._bufs is not None else 0)
+        else:
+            now = sum(int(p.nbytes) + int(k.nbytes) + int(v.nbytes)
+                      for p, k, v, _ in self._staged)
+        delta = now - self._accounted_bytes
+        if delta > 0:
+            observability.account_bytes(delta)
+        elif delta < 0:
+            observability.release_bytes(-delta)
+        self._accounted_bytes = now
 
     def append(self, pid, pk, values, n_real: int, chunk: int = 0) -> None:
         """Appends one encoded chunk (host numpy arrays; in donating mode
@@ -371,6 +396,7 @@ class DeviceRowAccumulator:
                 self._staged.append((jnp.asarray(pid), jnp.asarray(pk),
                                      jnp.asarray(values), n_real))
                 self._n += n_real
+                self._refresh_accounting()
                 return
             chunk_bufs = (jnp.asarray(pid), jnp.asarray(pk),
                           jnp.asarray(values))
@@ -378,6 +404,7 @@ class DeviceRowAccumulator:
                 # The first bucket-padded chunk IS the buffer.
                 self._bufs = chunk_bufs
                 self._n = n_real
+                self._refresh_accounting()
                 return
             cap = self._bufs[0].shape[0]
             need = self._n + pid.shape[0]
@@ -387,6 +414,7 @@ class DeviceRowAccumulator:
             self._bufs = _append_fn(True)(self._bufs, chunk_bufs,
                                           self._n)
             self._n += n_real
+            self._refresh_accounting()
 
     def finalize(self):
         """Returns (pid, pk, values) device buffers holding the

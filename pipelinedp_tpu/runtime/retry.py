@@ -327,8 +327,18 @@ def run_with_degradation(run_range: Callable[[int, int, int, int], None],
             if capacity // 2 < min_block_partitions:
                 raise
             capacity //= 2
+            # The degradation event carries the device-memory watermark
+            # that triggered it (platform memory stats, or the byte-
+            # accounted fallback): an operator reading the timeline sees
+            # HOW FULL the device was when the halving fired, not just
+            # that it fired. Lazy import: observability sits above retry.
+            from pipelinedp_tpu.runtime import observability
+            wm = observability.memory_watermark()
             telemetry.record("block_oom_degradations", block=e.block,
-                             capacity=capacity)
+                             capacity=capacity,
+                             mem_live_bytes=wm["live_bytes"],
+                             mem_peak_bytes=wm["peak_bytes"],
+                             mem_source=wm["source"])
             logging.warning(
                 "block kernel OOM (or exhausted deadline) at partition "
                 "base %d; halving partition "

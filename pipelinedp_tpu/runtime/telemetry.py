@@ -7,12 +7,17 @@ through every signature would couple all of them to the runtime. Counters
 are monotonically increasing per process; callers that want per-run deltas
 snapshot() before and after.
 
-Every counter is DECLARED in REGISTRY (name, kind, help text) and
-record() validates against it, so a typo'd counter name is a loud error
-at the increment site instead of a silently forked metric; the
-structural test in tests/test_trace.py greps the source tree to prove
-every recorded literal is declared and every declared counter is
-recorded somewhere. The full table is rendered in README "Observability".
+Every metric is DECLARED in REGISTRY (name, kind, help text) — counters
+(monotonic, record()) and gauges (point-in-time levels, set_gauge():
+queue depth, live devices, health state, remaining budget, memory
+watermarks). Both entry points validate name AND kind, so a typo'd or
+mis-kinded metric is a loud error at the call site instead of a
+silently forked metric; staticcheck's registry-drift rule proves both
+directions for both kinds over the source tree. Gauges are keyed by
+(name, job_id) — set under a job_scope they belong to that job, and
+the Prometheus exporter (runtime/observability.py) renders them with a
+job_id label so two jobs in one process never mix levels. The full
+table is rendered in README "Observability".
 
 Timings (record_duration) aggregate per-phase wall time as
 (count, min, max, sum); the watchdog and the blocked drivers feed them
@@ -41,6 +46,15 @@ Metric = collections.namedtuple("Metric", ["name", "kind", "help"])
 
 def _counter(name: str, help_text: str) -> Metric:
     return Metric(name, "counter", help_text)
+
+
+def _gauge(name: str, help_text: str) -> Metric:
+    """A point-in-time level (set_gauge), not a monotonic count: queue
+    depths, live device counts, health states, remaining budget. Gauges
+    are scrapeable mid-run through runtime/observability.py's Prometheus
+    endpoint; staticcheck's registry-drift rule enforces declaration in
+    both directions exactly as it does for counters."""
+    return Metric(name, "gauge", help_text)
 
 
 # The declared metrics registry: every record() name must appear here.
@@ -98,6 +112,30 @@ REGISTRY: Dict[str, Metric] = {
         _counter("pipeline_chunks",
                  "chunks streamed through the ingest staging queue "
                  "(runtime/pipeline.map_overlapped)"),
+        _counter("trace_dropped_events",
+                 "trace events dropped because the bounded trace buffer "
+                 "was full (trace_summary flags the epoch as truncated)"),
+        _gauge("pipeline_queue_depth",
+               "encoded chunks currently staged between the host encode "
+               "pool and the device accumulator (bounded by "
+               "pipeline_depth)"),
+        _gauge("live_devices",
+               "devices currently live in the elastic mesh of the "
+               "gauge's job (== planned until a device loss shrinks it)"),
+        _gauge("job_health_state",
+               "numeric health state of a job (0 HEALTHY, 1 DEGRADED, "
+               "2 STALLED, 3 FAILED — runtime/health.HealthState)"),
+        _gauge("budget_epsilon_remaining",
+               "total_epsilon minus the epsilon already apportioned to "
+               "registered mechanisms (the odometer's spent-vs-remaining "
+               "view; equals 0 once a finalized ledger spent its budget)"),
+        _gauge("device_memory_live_bytes",
+               "bytes currently live on the local devices (JAX device "
+               "memory stats where available, the byte-accounted "
+               "fallback elsewhere)"),
+        _gauge("device_memory_peak_bytes",
+               "peak device-memory watermark observed this epoch (same "
+               "sources as device_memory_live_bytes)"),
     )
 }
 
@@ -114,10 +152,18 @@ _timings: Dict[str, list] = {}
 # job_id -> {name -> [count, min, max, sum]}: the same stats scoped to
 # the job that was current (health.job_scope) when they were recorded.
 _job_timings: Dict[str, Dict[str, list]] = {}
+# (gauge name, job_id or None) -> last set value. Gauges are levels:
+# set_gauge overwrites, snapshots read the latest, reset clears.
+_gauges: Dict[tuple, float] = {}
 # Drivers record from worker threads while the watchdog monitor and
 # receipt builders read; staticcheck's lock-discipline rule enforces the
 # declaration (readers use snapshot()/delta(), never the bare maps).
-_GUARDED_BY = guarded_by("_lock", "counters", "_timings", "_job_timings")
+_GUARDED_BY = guarded_by("_lock", "counters", "_timings", "_job_timings",
+                         "_gauges")
+
+# Sentinel distinguishing "no job_id passed" (attribute to the current
+# job scope) from an explicit job_id=None (process-level gauge).
+_CURRENT_JOB = object()
 
 
 def record(name: str, n: int = 1, **attrs) -> None:
@@ -133,6 +179,11 @@ def record(name: str, n: int = 1, **attrs) -> None:
             f"it in telemetry.REGISTRY (name, kind, help) first — "
             f"undeclared counters silently fork the metric namespace. "
             f"Declared: {sorted(REGISTRY)}")
+    if REGISTRY[name].kind != "counter":
+        raise ValueError(
+            f"telemetry.record({name!r}): declared as a "
+            f"{REGISTRY[name].kind}, not a counter — levels are set with "
+            f"set_gauge(), record() increments monotonic counters only.")
     with _lock:
         counters[name] += n
     if trace.enabled():
@@ -142,6 +193,51 @@ def record(name: str, n: int = 1, **attrs) -> None:
     # would be circular; the hook only fires on failure-path events).
     from pipelinedp_tpu.runtime import health
     health.observe_counter(name, n)
+
+
+def set_gauge(name: str, value, job_id=_CURRENT_JOB) -> None:
+    """Sets a DECLARED gauge to a point-in-time level.
+
+    Gauges overwrite (a level, not a count) and are keyed by job: with
+    the default job_id the current job scope (health.job_scope) owns the
+    value; pass job_id=None for an explicitly process-level gauge, or a
+    string to attribute to a job from outside its scope (the elastic
+    runtime does this for live_devices). Gauges do not forward to the
+    trace timeline — a queue-depth gauge updates per chunk, and flooding
+    the bounded buffer with level samples would evict the causal
+    incidents instants exist for.
+    """
+    metric = REGISTRY.get(name)
+    if metric is None:
+        raise ValueError(
+            f"telemetry.set_gauge({name!r}): not a declared metric. "
+            f"Declare it with _gauge(name, help) in telemetry.REGISTRY "
+            f"first. Declared gauges: "
+            f"{sorted(m.name for m in REGISTRY.values() if m.kind == 'gauge')}")
+    if metric.kind != "gauge":
+        raise ValueError(
+            f"telemetry.set_gauge({name!r}): declared as a "
+            f"{metric.kind}, not a gauge — counters increment via "
+            f"record(), set_gauge() sets levels only.")
+    if job_id is _CURRENT_JOB:
+        from pipelinedp_tpu.runtime import health
+        h = health.current()
+        job_id = h.job_id if h is not None else None
+    with _lock:
+        _gauges[(name, job_id)] = float(value)
+
+
+def gauge_snapshot() -> Dict[str, Dict[str, float]]:
+    """{gauge name: {job_id or "": value}} for every gauge set this
+    epoch. The empty-string key is the process-level (job-less) value —
+    JSON-safe, and the Prometheus renderer maps it to a label-less
+    sample."""
+    with _lock:
+        items = list(_gauges.items())
+    out: Dict[str, Dict[str, float]] = {}
+    for (name, job), value in items:
+        out.setdefault(name, {})[job if job is not None else ""] = value
+    return out
 
 
 def _fold_timing(store: Dict[str, list], name: str, seconds: float) -> None:
@@ -211,11 +307,13 @@ def snapshot() -> Dict[str, int]:
 
 def full_snapshot() -> Dict[str, Any]:
     """Counters AND timing stats in one structured snapshot:
-    {"counters": {name: int}, "timings": timing_snapshot(),
-    "job_timings": job_timing_snapshot()}. Use snapshot() when the
-    result feeds delta(), which subtracts integer counters only."""
+    {"counters": {name: int}, "gauges": gauge_snapshot(),
+    "timings": timing_snapshot(), "job_timings": job_timing_snapshot()}.
+    Use snapshot() when the result feeds delta(), which subtracts
+    integer counters only."""
     return {
         "counters": snapshot(),
+        "gauges": gauge_snapshot(),
         "timings": timing_snapshot(),
         "job_timings": job_timing_snapshot(),
     }
@@ -230,16 +328,22 @@ def delta(before: Dict[str, int]) -> Dict[str, int]:
 
 
 def reset() -> None:
-    """Coordinated epoch reset: counters, timings, job timings, trace
-    buffers AND per-job health states clear together, so test isolation
-    and long-running processes can never mix epochs (a counter from one
-    epoch attributed to another job's health, or a stale trace buffer
-    leaking into the next run's export)."""
+    """Coordinated epoch reset: counters, gauges, timings, job timings,
+    trace buffers, per-job health states, memory watermarks AND the
+    budget odometer clear together, so test isolation and long-running
+    processes can never mix epochs (a counter from one epoch attributed
+    to another job's health, or a stale trace buffer leaking into the
+    next run's export)."""
     with _lock:
         counters.clear()
         _timings.clear()
         _job_timings.clear()
-    # Lazy import: health imports telemetry at module load.
+        _gauges.clear()
+    # Lazy imports: health imports telemetry at module load, and
+    # observability's epoch state (memory accounting, odometer) sits a
+    # layer above both.
     from pipelinedp_tpu.runtime import health
+    from pipelinedp_tpu.runtime import observability
     health.reset()
     trace.reset()
+    observability.reset_epoch()

@@ -45,6 +45,7 @@ health states so long-running processes and tests cannot mix epochs.
 import contextlib
 import functools
 import json
+import logging
 import os
 import threading
 import time
@@ -67,6 +68,13 @@ _PID = os.getpid()
 _compile: Dict[str, list] = {}
 
 _local = threading.local()
+
+# Optional per-span memory sampler (runtime/observability.py installs
+# memory_watermark here via enable_memory_sampling): when set, every
+# span close attaches mem_live_bytes/mem_peak_bytes attrs so the
+# Perfetto timeline carries the device-memory watermark per phase. A
+# module-global callable keeps the disabled path at one None check.
+_memory_sampler = None
 
 # Spans close on driver/worker threads while exporters read; staticcheck
 # enforces the declaration. `_enabled` (the disabled-path bool) and
@@ -123,8 +131,31 @@ def _append(event: tuple) -> None:
     with _lock:
         if len(_events) >= _buffer_limit:
             _dropped += 1
+            first_drop = _dropped == 1
+            limit = _buffer_limit
+        else:
+            _events.append(event)
             return
-        _events.append(event)
+    # Buffer overflow is a DECLARED incident, not a silent truncation:
+    # the counter makes trace_summary's under-reporting visible in every
+    # receipt, and the warning fires once per epoch. The reentrancy flag
+    # stops the counter's own instant event from re-entering the full
+    # buffer (record -> instant -> _append -> drop -> record ...).
+    if getattr(_local, "noting_drop", False):
+        return
+    _local.noting_drop = True
+    try:
+        if first_drop:
+            logging.warning(
+                "trace: event buffer full (%d events) — further events "
+                "are dropped and counted in trace_dropped_events; "
+                "trace_summary will flag this epoch as truncated. Raise "
+                "trace.enable(buffer_limit=...) or reset() between runs.",
+                limit)
+        from pipelinedp_tpu.runtime import telemetry
+        telemetry.record("trace_dropped_events")
+    finally:
+        _local.noting_drop = False
 
 
 class _NullSpan:
@@ -181,6 +212,11 @@ class _Span:
         if stack:
             stack[-1]._child_s += dur
         exclusive = max(dur - self._child_s, 0.0)
+        if _memory_sampler is not None:
+            try:
+                self.set(**_memory_sampler())
+            except Exception:  # noqa: BLE001 - a failed memory sample must never fail the traced operation; the span simply lacks the mem attrs
+                pass
         _append(("X", self.name, self._tid, self._job,
                  self._start, dur, exclusive, self.attrs))
         return False
@@ -202,8 +238,26 @@ def instant(name: str, **attrs) -> None:
     """Records a point event (a runtime incident) on the timeline."""
     if not _enabled:
         return
+    if getattr(_local, "noting_drop", False):
+        # The trace_dropped_events counter's own forwarded instant:
+        # the buffer is full by definition, so buffering it is
+        # impossible and counting it as another drop would double-count.
+        return
     _append(("i", name, threading.get_ident(), _current_job(),
              time.perf_counter(), attrs or None))
+
+
+def set_memory_sampler(fn) -> None:
+    """Installs (or, with None, removes) the per-span memory sampler.
+
+    ``fn()`` must return a dict of span attributes (observability.py
+    passes {"mem_live_bytes": ..., "mem_peak_bytes": ...}); it runs at
+    every span close while installed, so it must be cheap and must not
+    raise for control flow. Use observability.enable_memory_sampling()
+    rather than calling this directly.
+    """
+    global _memory_sampler
+    _memory_sampler = fn
 
 
 def probe_jit(name: str, fn):
@@ -267,8 +321,12 @@ def trace_summary(job_id: Optional[str] = None) -> Dict[str, Any]:
     Returns {"spans": {name: {count, inclusive_s, exclusive_s, max_s}}
     ordered by inclusive time descending, "instants": {name: count},
     "transfer_bytes": total of ``bytes=`` attributes, "compile":
-    compile_stats(), "n_events", "dropped_events"}. With a job_id, only
-    events recorded while that job's scope was current.
+    compile_stats(), "n_events", "dropped_events", "truncated"}. With a
+    job_id, only events recorded while that job's scope was current.
+    ``truncated`` is True when ANY event of the epoch was dropped on the
+    full buffer: the rollup (and every job filter of it — drops are not
+    attributable to a job) under-reports, and readers must treat counts
+    and times as lower bounds rather than totals.
     """
     spans: Dict[str, list] = {}
     instants: Dict[str, int] = {}
@@ -306,19 +364,30 @@ def trace_summary(job_id: Optional[str] = None) -> Dict[str, Any]:
         "compile": compile_stats(),
         "n_events": len(events),
         "dropped_events": dropped,
+        "truncated": dropped > 0,
     }
 
 
-def to_trace_events(job_id: Optional[str] = None) -> Dict[str, Any]:
+def to_trace_events(job_id: Optional[str] = None,
+                    pid: Optional[int] = None,
+                    process_name: Optional[str] = None) -> Dict[str, Any]:
     """The buffered events as a Chrome/Perfetto trace-event JSON object
-    ({"traceEvents": [...], "displayTimeUnit": "ms"})."""
+    ({"traceEvents": [...], "displayTimeUnit": "ms"}).
+
+    ``pid``/``process_name`` override the track identity: the
+    cross-process rollup (runtime/observability.py) exports each
+    controller's buffer under its jax process index so the merged pod
+    trace reads as one timeline with one named track group per
+    controller, instead of OS pids that collide across hosts.
+    """
+    track_pid = _PID if pid is None else int(pid)
     out = [{
         "name": "process_name",
         "ph": "M",
-        "pid": _PID,
+        "pid": track_pid,
         "tid": 0,
         "ts": 0,
-        "args": {"name": "pipelinedp-tpu"},
+        "args": {"name": process_name or "pipelinedp-tpu"},
     }]
     for ev in _snapshot_events(job_id):
         if ev[0] == "X":
@@ -331,7 +400,7 @@ def to_trace_events(job_id: Optional[str] = None) -> Dict[str, Any]:
                 "name": name,
                 "cat": "span",
                 "ph": "X",
-                "pid": _PID,
+                "pid": track_pid,
                 "tid": tid,
                 "ts": round((start - _t0) * 1e6, 3),
                 "dur": round(dur * 1e6, 3),
@@ -347,7 +416,7 @@ def to_trace_events(job_id: Optional[str] = None) -> Dict[str, Any]:
                 "cat": "instant",
                 "ph": "i",
                 "s": "t",
-                "pid": _PID,
+                "pid": track_pid,
                 "tid": tid,
                 "ts": round((ts - _t0) * 1e6, 3),
                 "args": args,

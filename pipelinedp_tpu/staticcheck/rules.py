@@ -645,69 +645,101 @@ def jit_boundary(modules: List[Module]) -> Iterator[Finding]:
 
 _TELEMETRY_REL = "pipelinedp_tpu/runtime/telemetry.py"
 
+# Declaration helper -> the metric kind it declares. Bare Metric(...)
+# calls carry their kind as the second positional argument.
+_DECL_HELPERS = {"_counter": "counter", "_gauge": "gauge"}
 
-def _declared_metrics(mod: Module) -> Dict[str, int]:
-    declared: Dict[str, int] = {}
+
+def _declared_metrics(mod: Module) -> Dict[str, Tuple[int, str]]:
+    """{metric name: (line, kind)} declared in telemetry.REGISTRY."""
+    declared: Dict[str, Tuple[int, str]] = {}
     for node in ast.walk(mod.tree):
-        if isinstance(node, ast.Call):
-            callee = mod.dotted(node.func) or ""
-            if callee.rsplit(".", 1)[-1] in ("_counter", "Metric") and \
-                    node.args and isinstance(node.args[0], ast.Constant) \
-                    and isinstance(node.args[0].value, str):
-                declared[node.args[0].value] = node.lineno
+        if not isinstance(node, ast.Call):
+            continue
+        callee = (mod.dotted(node.func) or "").rsplit(".", 1)[-1]
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            continue
+        if callee in _DECL_HELPERS:
+            declared[node.args[0].value] = (node.lineno,
+                                            _DECL_HELPERS[callee])
+        elif callee == "Metric":
+            kind = "counter"
+            if len(node.args) > 1 and \
+                    isinstance(node.args[1], ast.Constant) and \
+                    isinstance(node.args[1].value, str):
+                kind = node.args[1].value
+            declared[node.args[0].value] = (node.lineno, kind)
     return declared
 
 
-def _recorded_literals(modules: List[Module]
-                       ) -> Dict[str, List[Tuple[str, int]]]:
-    recorded: Dict[str, List[Tuple[str, int]]] = {}
+def _metric_call_literals(modules: List[Module], func_name: str
+                          ) -> Dict[str, List[Tuple[str, int]]]:
+    """First-arg string literals of every `<func_name>("...")` call."""
+    found: Dict[str, List[Tuple[str, int]]] = {}
     for mod in modules:
         for node in ast.walk(mod.tree):
             if not isinstance(node, ast.Call) or not node.args:
                 continue
             func = node.func
-            is_record = (isinstance(func, ast.Attribute) and
-                         func.attr == "record") or \
-                        (isinstance(func, ast.Name) and
-                         func.id == "record")
-            if not is_record:
+            hit = (isinstance(func, ast.Attribute) and
+                   func.attr == func_name) or \
+                  (isinstance(func, ast.Name) and func.id == func_name)
+            if not hit:
                 continue
             arg = node.args[0]
             if isinstance(arg, ast.Constant) and isinstance(arg.value, str)\
                     and arg.value.isidentifier():
-                recorded.setdefault(arg.value, []).append(
+                found.setdefault(arg.value, []).append(
                     (mod.rel, node.lineno))
-    return recorded
+    return found
+
+
+def _recorded_literals(modules: List[Module]
+                       ) -> Dict[str, List[Tuple[str, int]]]:
+    return _metric_call_literals(modules, "record")
 
 
 @rule(
     "registry-drift",
     "telemetry.REGISTRY and the source tree must agree in BOTH "
-    "directions: every telemetry.record(\"name\") literal names a "
-    "declared metric, and every declared counter is recorded somewhere "
-    "— dead metrics mislead receipt readers, undeclared ones fork the "
+    "directions and BOTH kinds: every telemetry.record(\"name\") / "
+    "set_gauge(\"name\") literal names a declared metric of the right "
+    "kind (counter / gauge), every declared counter is recorded "
+    "somewhere and every declared gauge is set somewhere — dead metrics "
+    "mislead receipt and scrape readers, undeclared ones fork the "
     "namespace.")
 def registry_drift(modules: List[Module]) -> Iterator[Finding]:
     telemetry = next((m for m in modules if m.rel == _TELEMETRY_REL), None)
     if telemetry is None:
         return
     declared = _declared_metrics(telemetry)
-    recorded = _recorded_literals(modules)
-    for name, sites in sorted(recorded.items()):
-        if name not in declared:
+    for func_name, want_kind, other_api in (
+            ("record", "counter", "set_gauge"),
+            ("set_gauge", "gauge", "record")):
+        used = _metric_call_literals(modules, func_name)
+        for name, sites in sorted(used.items()):
             rel, line = sites[0]
-            yield Finding(
-                "registry-drift", rel, line,
-                f"telemetry.record({name!r}) has no REGISTRY declaration "
-                f"— declare it (name, kind, help) in runtime/telemetry.py "
-                f"first")
-    for name, line in sorted(declared.items()):
-        if name not in recorded:
-            yield Finding(
-                "registry-drift", _TELEMETRY_REL, line,
-                f"REGISTRY declares {name!r} but no source file records "
-                f"it — a dead metric misleads receipt readers; drop it "
-                f"or wire it up")
+            if name not in declared:
+                yield Finding(
+                    "registry-drift", rel, line,
+                    f"telemetry.{func_name}({name!r}) has no REGISTRY "
+                    f"declaration — declare it (name, kind, help) in "
+                    f"runtime/telemetry.py first")
+            elif declared[name][1] != want_kind:
+                yield Finding(
+                    "registry-drift", rel, line,
+                    f"telemetry.{func_name}({name!r}) targets a metric "
+                    f"declared as a {declared[name][1]} — use "
+                    f"{other_api}() or fix the declaration's kind")
+        for name, (line, kind) in sorted(declared.items()):
+            if kind == want_kind and name not in used:
+                verb = ("records" if want_kind == "counter" else "sets")
+                yield Finding(
+                    "registry-drift", _TELEMETRY_REL, line,
+                    f"REGISTRY declares {want_kind} {name!r} but no "
+                    f"source file {verb} it — a dead metric misleads "
+                    f"receipt readers; drop it or wire it up")
 
 
 # ---------------------------------------------------------------------------
@@ -732,6 +764,8 @@ KNOB_VALIDATORS: Dict[str, str] = {
     "encode_threads": "validate_encode_threads",
     "num_processes": "validate_num_processes",
     "coordinator_address": "validate_coordinator_address",
+    "metrics_port": "validate_metrics_port",
+    "metrics_path": "validate_metrics_path",
 }
 
 # Data-plane parameters: configuration, not failure semantics — adding

@@ -63,6 +63,12 @@ def pytest_configure(config):
         "helpers, process-scoped journals, whole-host loss, and the "
         "spawn-based 2-process jax.distributed CPU dryrun (tier-1, NOT "
         "slow; select alone with -m multihost)")
+    config.addinivalue_line(
+        "markers",
+        "observability: the fleet observability plane — gauges, "
+        "Prometheus export, memory watermarks, the privacy-budget "
+        "odometer and the cross-process rollup (tier-1, NOT slow; "
+        "select alone with -m observability)")
 
 
 @pytest.fixture(autouse=True)

@@ -480,7 +480,14 @@ class TestTwoProcessPod:
         reference = multihost.reference_identity_outputs()
         msg = multihost.check_identity_results(results, reference)
         assert "bit-identical" in msg
-        names = sorted(os.listdir(tmp_path / "journal"))
+        # The merged observability rollup over the same pod: both
+        # controllers' spans on distinct pid tracks, parseable mid-run
+        # scrapes, incident instants exactly once per recorder.
+        obs_msg = multihost.check_pod_observability(
+            str(tmp_path), results, "identity")
+        assert "pod rollup merged 2 controllers" in obs_msg
+        names = sorted(n for n in os.listdir(tmp_path / "journal")
+                       if n.endswith(".npz"))
         p0 = [n for n in names if "__p0__" in n]
         p1 = [n for n in names if "__p1__" in n]
         assert p0 and len(p0) == len(p1), names
@@ -500,3 +507,9 @@ class TestTwoProcessPod:
         reference = multihost.reference_host_loss_outputs()
         msg = multihost.check_host_loss_results(results, reference)
         assert "bit-identically" in msg
+        # Injected host-loss incidents appear EXACTLY ONCE per
+        # recording controller in the merged trace (no double-count
+        # from per-process buffers).
+        obs_msg = multihost.check_pod_observability(
+            str(tmp_path), results, "host_loss")
+        assert "host_losses" in obs_msg

@@ -94,11 +94,84 @@ class TestRegistry:
         assert "ghost_counter" in found[0].message
         assert found[0].file == "pipelinedp_tpu/runtime/telemetry.py"
 
+    @pytest.mark.staticcheck
+    def test_set_gauge_of_undeclared_name_is_caught(self):
+        mods = [
+            staticcheck.parse_source(
+                "pipelinedp_tpu/runtime/telemetry.py",
+                "def _gauge(name, help_text):\n"
+                "    return (name, 'gauge', help_text)\n"
+                "REGISTRY = dict(a=_gauge('used_gauge', 'h'))\n"),
+            staticcheck.parse_source(
+                "pipelinedp_tpu/fix_user.py",
+                "from pipelinedp_tpu.runtime import telemetry\n"
+                "def f():\n"
+                "    telemetry.set_gauge('used_gauge', 1)\n"
+                "    telemetry.set_gauge('undeclared_gauge', 2)\n"),
+        ]
+        found = staticcheck.analyze(
+            mods, only_rules=["registry-drift"]).active
+        assert len(found) == 1
+        assert "undeclared_gauge" in found[0].message
+        assert found[0].file == "pipelinedp_tpu/fix_user.py"
+
+    @pytest.mark.staticcheck
+    def test_declared_but_never_set_gauge_is_caught(self):
+        mods = [
+            staticcheck.parse_source(
+                "pipelinedp_tpu/runtime/telemetry.py",
+                "def _gauge(name, help_text):\n"
+                "    return (name, 'gauge', help_text)\n"
+                "REGISTRY = dict(\n"
+                "    a=_gauge('used_gauge', 'h'),\n"
+                "    b=_gauge('ghost_gauge', 'h'))\n"),
+            staticcheck.parse_source(
+                "pipelinedp_tpu/fix_user.py",
+                "from pipelinedp_tpu.runtime import telemetry\n"
+                "def f():\n"
+                "    telemetry.set_gauge('used_gauge', 1)\n"),
+        ]
+        found = staticcheck.analyze(
+            mods, only_rules=["registry-drift"]).active
+        assert len(found) == 1
+        assert "ghost_gauge" in found[0].message
+        assert found[0].file == "pipelinedp_tpu/runtime/telemetry.py"
+
+    @pytest.mark.staticcheck
+    def test_kind_mismatch_is_caught_both_ways(self):
+        mods = [
+            staticcheck.parse_source(
+                "pipelinedp_tpu/runtime/telemetry.py",
+                "def _counter(name, help_text):\n"
+                "    return (name, 'counter', help_text)\n"
+                "def _gauge(name, help_text):\n"
+                "    return (name, 'gauge', help_text)\n"
+                "REGISTRY = dict(\n"
+                "    a=_counter('a_counter', 'h'),\n"
+                "    b=_gauge('a_gauge', 'h'))\n"),
+            staticcheck.parse_source(
+                "pipelinedp_tpu/fix_user.py",
+                "from pipelinedp_tpu.runtime import telemetry\n"
+                "def f():\n"
+                "    telemetry.record('a_gauge')\n"
+                "    telemetry.set_gauge('a_counter', 1)\n"),
+        ]
+        found = staticcheck.analyze(
+            mods, only_rules=["registry-drift"]).active
+        messages = "\n".join(f.message for f in found)
+        assert "declared as a gauge" in messages
+        assert "declared as a counter" in messages
+
     def test_registry_entries_are_complete(self):
+        kinds = set()
         for name, metric in telemetry.REGISTRY.items():
             assert metric.name == name
-            assert metric.kind == "counter"
+            assert metric.kind in ("counter", "gauge")
             assert metric.help and isinstance(metric.help, str)
+            kinds.add(metric.kind)
+        # Both kinds are live in the registry (counters since PR 2,
+        # gauges since the observability plane).
+        assert kinds == {"counter", "gauge"}
 
     def test_record_rejects_undeclared_names(self):
         with pytest.raises(ValueError, match="not a declared metric"):
@@ -122,7 +195,8 @@ class TestSnapshotSplit:
         telemetry.record("block_retries")
         telemetry.record_duration("phase_y", 0.25)
         full = telemetry.full_snapshot()
-        assert set(full) == {"counters", "timings", "job_timings"}
+        assert set(full) == {"counters", "gauges", "timings",
+                             "job_timings"}
         assert full["counters"] == {"block_retries": 1}
         assert full["timings"]["phase_y"]["count"] == 1
 
