@@ -887,13 +887,23 @@ def main():
     # next to the throughput it ships with.
     try:
         from pipelinedp_tpu import staticcheck as sc
-        _sc_analysis, sc_active, sc_baselined, sc_stale, _sc_mods = \
+        from pipelinedp_tpu.staticcheck import cli as sc_cli
+        sc_started = time.perf_counter()
+        sc_analysis, sc_active, sc_baselined, sc_stale, _sc_mods = \
             sc.run_tree()
+        sc_seconds = time.perf_counter() - sc_started
         staticcheck_detail = {
             "findings": len(sc_active),
             "baselined": len(sc_baselined),
             "stale_baseline_entries": len(sc_stale),
             "rules_version": sc.RULES_VERSION,
+            # Full-tree analysis wall time + per-rule finding counts:
+            # analyzer runtime regressions (the dataflow fixpoint is the
+            # dominant cost) and per-family triage drift are both
+            # visible in the perf trajectory.
+            "analysis_seconds": round(sc_seconds, 3),
+            "per_rule": sc_cli.per_rule_counts(sc_analysis, sc_active,
+                                               sc_baselined),
         }
     except Exception as e:  # noqa: BLE001 - the receipt must survive analyzer breakage; tests/test_staticcheck.py owns failing on it
         staticcheck_detail = {"error": f"{type(e).__name__}: {e}"}

@@ -44,7 +44,12 @@ class DPSumCombineFn(private_collection.PrivateCombineFn):
         sensitivity = (aggregate_params.max_partitions_contributed *
                        aggregate_params.max_contributions_per_partition *
                        max(abs(self._min_value), abs(self._max_value)))
-        return accumulator + np.random.laplace(
+        # The package's injectable mechanism RNG, not numpy's
+        # process-global state: seedable through
+        # dp_computations.seed_mechanism_rng, so a resumed job can
+        # replay the same release (the repo-wide host-rng discipline).
+        from pipelinedp_tpu import dp_computations
+        return accumulator + dp_computations.mechanism_rng().laplace(
             0.0, sensitivity / budget.eps)
 
     def request_budget(self, budget_accountant):

@@ -21,8 +21,6 @@ import tempfile
 sys.path.insert(
     0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", ".."))
 
-import numpy as np
-
 import pipelinedp_tpu as pdp
 from examples.movie_view_ratings import netflix_format
 from pipelinedp_tpu import combiners
@@ -47,7 +45,12 @@ class LaplaceCountCombiner(combiners.CustomCombiner):
         sensitivity = (p.max_partitions_contributed *
                        p.max_contributions_per_partition)
         scale = sensitivity / self._budget.eps
-        return {"laplace_count": count + np.random.laplace(0.0, scale)}
+        # Injectable, seedable noise source (dp_computations.
+        # seed_mechanism_rng) instead of numpy's process-global RNG —
+        # the same host-rng discipline the product code is held to.
+        from pipelinedp_tpu import dp_computations
+        return {"laplace_count":
+                count + dp_computations.mechanism_rng().laplace(0.0, scale)}
 
     def explain_computation(self):
         return lambda: (f"Custom Laplace count (eps={self._budget.eps})")

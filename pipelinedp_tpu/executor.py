@@ -1201,6 +1201,7 @@ def lazy_select_partitions(backend, col, params, data_extractors,
             n_real = len(vocab)
             for idx in kept_ids:
                 if idx < n_real:
+                    # staticcheck: disable=release-taint — sanctioned release: partition keys are decoded ONLY at indices the DP selection kernel kept (noise + threshold); the selection mechanism registered with the ledger is the sanitizer
                     yield vocab[idx]
             return
         if backend.mesh is not None:
@@ -1233,6 +1234,7 @@ def lazy_select_partitions(backend, col, params, data_extractors,
         with rt_trace.span("post_process"):
             for idx in kept_idx:
                 if idx < n_real:
+                    # staticcheck: disable=release-taint — sanctioned release: partition keys are decoded ONLY at indices the DP selection kernel kept (noise + threshold); the selection mechanism registered with the ledger is the sanitizer
                     yield vocab[idx]
 
     return generator()
@@ -1477,6 +1479,7 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
                         np.asarray(stds), key, cfg,
                         secure_tables=secure_tables, **runtime_kwargs)
             with rt_trace.span("post_process"):
+                # staticcheck: disable=release-taint — sanctioned release: the vocab is indexed only by kept_ids the blocked DP selection emitted, and every metric column was noised inside the block kernel before draining
                 yield from decode_blocked_results(kept_ids, blocked_outputs,
                                                   encoded.partition_vocab,
                                                   compound)
@@ -1500,6 +1503,7 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
                         max_v, min_s, max_s, mid, jnp.asarray(stds), key,
                         cfg, secure_tables)
         with rt_trace.span("post_process"):
+            # staticcheck: disable=release-taint — sanctioned release: decode_results emits only partitions the fused kernel's DP selection kept, and the output columns carry the kernel's noise
             yield from decode_results(outputs, keep,
                                       encoded.partition_vocab, compound)
 

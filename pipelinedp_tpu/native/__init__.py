@@ -94,6 +94,7 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _load_failed:
             return _lib
         path = os.path.join(_dir, _LIB_NAME)
+        # staticcheck: disable=lock-order — intentional build serialization: exactly one thread compiles the library while every other caller waits for it; the double-checked fast path above never takes the lock, so steady state is lock-free
         if not os.path.exists(path) and not _try_build():
             _load_failed = True
             return None

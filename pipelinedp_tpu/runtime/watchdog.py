@@ -188,7 +188,15 @@ class Watchdog:
             gid = self._next_id
             self._next_id += 1
             self._guards[gid] = g
-            self._ensure_monitor()
+            start_monitor = self._ensure_monitor()
+        if start_monitor is not None:
+            # Outside the lock: Thread.start() blocks on the new
+            # thread's bootstrap handshake, and the monitor's first act
+            # is taking this same lock — starting it inside the critical
+            # section stretched every concurrent guard entry by a
+            # scheduler-dependent wait (a finding of staticcheck's
+            # lock-order rule on its first run).
+            start_monitor.start()
         _push_token(g)
         failed = False
         try:
@@ -239,12 +247,19 @@ class Watchdog:
 
     # -- monitor ---------------------------------------------------------
 
-    def _ensure_monitor(self) -> None:  # staticcheck: disable=lock-discipline — caller holds self._lock (guard() acquires before the call)
-        if self._monitor is None or not self._monitor.is_alive():
-            self._monitor = threading.Thread(target=self._run_monitor,
-                                             name="pdp-watchdog",
-                                             daemon=True)
-            self._monitor.start()
+    def _ensure_monitor(self) -> "Optional[threading.Thread]":  # staticcheck: disable=lock-discipline — caller holds self._lock (guard() acquires before the call)
+        """Creates (under the caller's lock) a monitor thread when none
+        is running, WITHOUT starting it — the caller starts the returned
+        thread after releasing the lock. A created-but-not-yet-started
+        monitor has ident None, so a racing guard entry never creates a
+        duplicate."""
+        m = self._monitor
+        if m is None or (m.ident is not None and not m.is_alive()):
+            m = threading.Thread(target=self._run_monitor,
+                                 name="pdp-watchdog", daemon=True)
+            self._monitor = m
+            return m
+        return None
 
     def _run_monitor(self) -> None:
         while not self._closed:

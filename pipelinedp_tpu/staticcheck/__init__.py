@@ -5,11 +5,17 @@ locally: noise keys must be pure ``fold_in(final_key, b)`` derivations,
 every mechanism must hit the budget ledger exactly once, device-resident
 paths must not smuggle host transfers, and the runtime modules share
 state across monitor threads under declared locks. This package parses
-every module once into a shared AST model (:mod:`model`) and runs
-pluggable rules (:mod:`rules`) over it, producing
-``Finding(rule_id, file, line, message)`` records, with inline
-suppressions, a committed baseline for grandfathered findings
-(:mod:`baseline`) and a CLI (:mod:`cli`). The tier-1 gate
+every module once into a shared AST model (:mod:`model`) — including a
+project call graph — and runs pluggable rules (:mod:`rules`) over it,
+producing ``Finding(rule_id, file, line, message)`` records, with
+inline suppressions, a committed baseline for grandfathered findings
+(:mod:`baseline`), a content-hash model cache (:mod:`cache`) and a CLI
+(:mod:`cli`). Three rule families are interprocedural dataflow over the
+call graph (:mod:`dataflow`): privacy-release taint (raw row data must
+be noised before any export sink, findings carry the source->sink call
+path), lock-order deadlock proofs (acyclic acquisition graph, no
+blocking while locked), and budget-flow verification (every mechanism
+spec provably reaches the ledger). The tier-1 gate
 (tests/test_staticcheck.py) fails on any non-baselined finding.
 
 See README "Static analysis" for the rule table, the suppression syntax

@@ -1,0 +1,143 @@
+"""Content-hash incremental cache for parsed module models.
+
+A full-tree pass costs one ``ast.parse`` + suppression tokenization per
+file; as the tree and the rule count grow, re-parsing ~100 unchanged
+files per gate run is the dominant fixed cost. The cache maps
+``abspath -> (sha256(source), pickled Module)`` in one pickle file:
+
+  * a hit (hash matches) returns the cached :class:`model.Module`
+    object — byte-identical analysis inputs, so findings are identical
+    to a cold run by construction (asserted in tests);
+  * a miss re-parses and updates the entry;
+  * ``trusted`` paths (the ``--changed-only`` flow: files git reports
+    UNCHANGED) skip even the hash read — the entry is served as-is.
+
+The file is versioned by :data:`CACHE_VERSION` + the analyzer's
+RULES_VERSION; any mismatch or unpickling failure degrades to a cold
+parse (the cache is an accelerator, never a correctness dependency).
+"""
+
+import hashlib
+import os
+import pickle
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from pipelinedp_tpu.staticcheck import model
+
+CACHE_VERSION = 1
+
+
+class ModelCache:
+    """Pickle-backed parsed-module cache (see module docstring)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._entries: Dict[str, Tuple[str, model.Module]] = {}
+        self.hits = 0
+        self.misses = 0
+        self.trusted = 0
+        if path is not None and os.path.exists(path):
+            try:
+                with open(path, "rb") as f:
+                    payload = pickle.load(f)
+                if payload.get("cache_version") == CACHE_VERSION:
+                    self._entries = payload.get("entries", {})
+            except Exception:  # noqa: BLE001 - a corrupt/stale cache file must degrade to a cold parse, never fail the analysis
+                self._entries = {}
+
+    @staticmethod
+    def _digest(source: str) -> str:
+        return hashlib.sha256(source.encode("utf-8")).hexdigest()
+
+    def get(self, path: str, trust: bool = False) -> model.Module:
+        """The parsed Module for ``path``; ``trust=True`` serves a cached
+        entry without re-reading the file (the --changed-only contract:
+        git vouched the file did not change)."""
+        abspath = os.path.abspath(path)
+        entry = self._entries.get(abspath)
+        if trust and entry is not None:
+            self.trusted += 1
+            return entry[1]
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        digest = self._digest(source)
+        if entry is not None and entry[0] == digest:
+            self.hits += 1
+            return entry[1]
+        self.misses += 1
+        mod = model.parse_source(model.canonical_rel(path), source)
+        self._entries[abspath] = (digest, mod)
+        return mod
+
+    def save(self) -> None:
+        if self.path is None:
+            return
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump({"cache_version": CACHE_VERSION,
+                         "entries": self._entries}, f)
+        os.replace(tmp, self.path)
+
+
+def load_tree_cached(paths: Iterable[str],
+                     cache: Optional[ModelCache] = None,
+                     trusted_paths: Optional[Set[str]] = None
+                     ) -> List[model.Module]:
+    """model.load_tree with an optional cache.
+
+    ``trusted_paths``: abspaths that may be served from the cache
+    without hashing (files git reports unchanged in --changed-only
+    mode). Everything else is hash-checked, so the returned module set
+    is byte-equivalent to a cold ``model.load_tree`` whenever the cache
+    agrees with the filesystem.
+    """
+    if cache is None:
+        return model.load_tree(paths)
+    trusted_paths = trusted_paths or set()
+    modules = []
+    for path in model.iter_python_files(paths):
+        modules.append(cache.get(
+            path, trust=os.path.abspath(path) in trusted_paths))
+    return modules
+
+
+def git_unchanged_paths(paths: Iterable[str]) -> Optional[Set[str]]:
+    """Abspaths under ``paths`` that git reports UNCHANGED vs HEAD
+    (tracked, no diff, not untracked). None when git is unavailable or
+    the tree is not a repository — callers then fall back to hashing
+    everything, which is still correct, just colder.
+    """
+    import subprocess
+    files = model.iter_python_files(paths)
+    if not files:
+        return set()
+    root_dir = os.path.dirname(os.path.abspath(files[0]))
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"], cwd=root_dir,
+            capture_output=True, text=True, timeout=30)
+        if top.returncode != 0:
+            return None
+        repo = top.stdout.strip()
+        changed = subprocess.run(
+            ["git", "status", "--porcelain", "--untracked-files=all"],
+            cwd=repo, capture_output=True, text=True, timeout=30)
+        if changed.returncode != 0:
+            return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    dirty = set()
+    for line in changed.stdout.splitlines():
+        if len(line) > 3:
+            name = line[3:].strip().strip('"')
+            if " -> " in name:  # renames list "old -> new"
+                for part in name.split(" -> "):
+                    dirty.add(os.path.join(repo, part))
+                continue
+            dirty.add(os.path.join(repo, name))
+    out = set()
+    for path in files:
+        abspath = os.path.abspath(path)
+        if abspath not in dirty:
+            out.add(abspath)
+    return out

@@ -4,19 +4,40 @@ Exit codes: 0 = clean (after suppressions and baseline), 1 = active
 findings, 2 = usage error. ``--update-baseline`` rewrites the committed
 baseline from the current active findings (preserving notes of entries
 that still match) and exits 0.
+
+Speed: ``--cache PATH`` keeps a content-hash pickle of parsed module
+models (hash hit = no re-parse); ``--changed-only`` additionally trusts
+the cache outright for files ``git status`` reports unchanged. Both
+produce byte-identical findings to a cold full run — the whole tree is
+always ANALYZED (the interprocedural rules need every module); the
+selection only decides what gets re-parsed.
+
+Formats: ``text`` (default), ``json``, and ``sarif`` (SARIF 2.1.0 —
+findings render as annotations in standard CI viewers).
 """
 
 import argparse
 import json
 import os
 import sys
+import time
 from typing import List, Optional
 
 from pipelinedp_tpu.staticcheck import baseline as baseline_mod
+from pipelinedp_tpu.staticcheck import cache as cache_mod
 from pipelinedp_tpu.staticcheck import core
 from pipelinedp_tpu.staticcheck import model
 
 _PACKAGE_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO_ROOT = os.path.dirname(_PACKAGE_ROOT)
+
+# The perf-harness and demo trees are measured/read, not production DP
+# paths — the transfer/jit/registry rules are noise there — but key and
+# host-RNG hygiene still hold: a benchmark that draws from hidden global
+# state produces unreproducible receipts, and example code teaches the
+# discipline readers copy. Intentional fixed-seed keys are baselined
+# with reason notes.
+AUX_RULES = ("key-hygiene", "host-rng")
 
 
 def default_paths() -> List[str]:
@@ -24,34 +45,151 @@ def default_paths() -> List[str]:
 
     benchmarks/ (and other non-product dirs) are excluded by
     model.DEFAULT_EXCLUDED_DIRS whether reached through this default or
-    through an explicit repo-root path argument.
+    through an explicit repo-root path argument; the AUX_RULES subset
+    runs over benchmarks/ and examples/ separately (aux_paths).
     """
     return [_PACKAGE_ROOT]
 
 
+def aux_paths() -> List[str]:
+    """benchmarks/ + examples/ trees, where the AUX_RULES subset runs."""
+    out = []
+    for name in ("benchmarks", "examples"):
+        path = os.path.join(_REPO_ROOT, name)
+        if os.path.isdir(path):
+            out.append(path)
+    return out
+
+
+def _load(paths, cache=None, changed_only=False):
+    trusted = None
+    if changed_only and cache is not None:
+        trusted = cache_mod.git_unchanged_paths(paths)
+    return cache_mod.load_tree_cached(paths, cache=cache,
+                                      trusted_paths=trusted)
+
+
 def run_tree(paths: Optional[List[str]] = None,
              baseline_path: str = baseline_mod.DEFAULT_BASELINE_PATH,
-             only_rules: Optional[List[str]] = None):
+             only_rules: Optional[List[str]] = None,
+             cache: Optional["cache_mod.ModelCache"] = None,
+             changed_only: bool = False):
     """One full pass: (analysis, active-after-baseline, baselined,
     stale-baseline-entries, modules). The programmatic entry the tier-1
-    gate and the bench receipt share with the CLI."""
-    modules = model.load_tree(paths or default_paths())
+    gate and the bench receipt share with the CLI.
+
+    With default paths the AUX_RULES subset additionally runs over
+    benchmarks/ and examples/, merged into the same result (one
+    baseline, one exit code).
+    """
+    main_paths = paths or default_paths()
+    modules = _load(main_paths, cache=cache, changed_only=changed_only)
     analysis = core.analyze(modules, only_rules=only_rules)
+    if paths is None:
+        aux = [r for r in AUX_RULES
+               if only_rules is None or r in only_rules]
+        aux_dirs = aux_paths()
+        if aux and aux_dirs:
+            aux_modules = _load(aux_dirs, cache=cache,
+                                changed_only=changed_only)
+            aux_analysis = core.analyze(aux_modules, only_rules=aux)
+            modules = modules + aux_modules
+            analysis = core.Analysis(
+                active=sorted(
+                    analysis.active + aux_analysis.active,
+                    key=lambda f: (f.file, f.line, f.rule_id)),
+                suppressed=sorted(
+                    analysis.suppressed + aux_analysis.suppressed,
+                    key=lambda f: (f.file, f.line, f.rule_id)))
+    if cache is not None:
+        cache.save()
     entries = baseline_mod.load(baseline_path) if baseline_path else []
     active, baselined, stale = baseline_mod.split(
         analysis.active, modules, entries)
     return analysis, active, baselined, stale, modules
 
 
+def per_rule_counts(analysis: "core.Analysis", active, baselined) -> dict:
+    """{rule: {"active": n, "baselined": n, "suppressed": n}} over one
+    pass, zero-valued rules omitted — the bench-receipt shape that makes
+    a per-family regression visible next to the perf numbers."""
+    out: dict = {}
+
+    def bump(findings, kind):
+        for f in findings:
+            entry = out.setdefault(f.rule_id,
+                                   {"active": 0, "baselined": 0,
+                                    "suppressed": 0})
+            entry[kind] += 1
+
+    bump(active, "active")
+    bump(baselined, "baselined")
+    bump(analysis.suppressed, "suppressed")
+    return out
+
+
+def to_sarif(active, stale) -> dict:
+    """Findings as a SARIF 2.1.0 log (one run, one result per finding) —
+    the schema CI annotation viewers ingest. Stale baseline entries ride
+    along as tool notifications."""
+    rules = [{
+        "id": rid,
+        "shortDescription": {"text": help_text},
+    } for rid, help_text in core.rule_help().items()]
+    results = [{
+        "ruleId": f.rule_id,
+        "level": "error",
+        "message": {"text": f.message},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f.file},
+                "region": {"startLine": f.line},
+            },
+        }],
+    } for f in active]
+    notifications = [{
+        "level": "note",
+        "message": {
+            "text": f"stale baseline entry {e['rule']}@{e['file']} "
+                    f"({e.get('text', '')!r}) — prune with "
+                    f"--update-baseline"},
+    } for e in stale]
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "pipelinedp-tpu-staticcheck",
+                    "version": core.RULES_VERSION,
+                    "informationUri":
+                        "https://github.com/pipelinedp-tpu",
+                    "rules": rules,
+                },
+            },
+            "results": results,
+            "invocations": [{
+                "executionSuccessful": True,
+                "toolExecutionNotifications": notifications,
+            }],
+        }],
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m pipelinedp_tpu.staticcheck",
-        description="AST-based DP-invariant analyzer (key hygiene, "
-                    "ledger discipline, host-transfer & lock lints).")
+        description="AST + interprocedural-dataflow DP-invariant "
+                    "analyzer (key hygiene, release taint, lock order, "
+                    "budget flow, ledger discipline, host-transfer & "
+                    "lock lints).")
     parser.add_argument("paths", nargs="*",
                         help="files/directories to analyze "
-                             "(default: the pipelinedp_tpu package)")
-    parser.add_argument("--format", choices=("text", "json"),
+                             "(default: the pipelinedp_tpu package, "
+                             "plus key/RNG hygiene over benchmarks/ "
+                             "and examples/)")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
                         default="text")
     parser.add_argument("--baseline",
                         default=baseline_mod.DEFAULT_BASELINE_PATH,
@@ -66,6 +204,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--rules", default=None,
                         help="comma-separated rule ids to run "
                              "(default: all)")
+    parser.add_argument("--cache", default=None, metavar="PATH",
+                        help="content-hash pickle of parsed module "
+                             "models; hash hits skip re-parsing "
+                             "(findings stay byte-identical to a cold "
+                             "run)")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="trust the --cache outright for files git "
+                             "reports unchanged (skips even the hash "
+                             "read); the whole tree is still analyzed, "
+                             "so findings are identical to a full run")
     parser.add_argument("--list-rules", action="store_true")
     args = parser.parse_args(argv)
 
@@ -74,16 +222,26 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{rid}: {help_text}")
         return 0
 
+    if args.changed_only and not args.cache:
+        print("staticcheck: --changed-only needs --cache PATH (without "
+              "a cache there is nothing to reuse; the run would just be "
+              "a cold full pass)", file=sys.stderr)
+        return 2
+
     only = ([r.strip() for r in args.rules.split(",") if r.strip()]
             if args.rules else None)
+    cache = cache_mod.ModelCache(args.cache) if args.cache else None
+    started = time.perf_counter()
     try:
         analysis, active, baselined, stale, modules = run_tree(
             args.paths or None,
             baseline_path=None if args.no_baseline else args.baseline,
-            only_rules=only)
+            only_rules=only, cache=cache,
+            changed_only=args.changed_only)
     except (ValueError, SyntaxError, OSError) as e:
         print(f"staticcheck: {e}", file=sys.stderr)
         return 2
+    analysis_seconds = time.perf_counter() - started
 
     if args.update_baseline:
         n = baseline_mod.save(analysis.active, modules,
@@ -102,7 +260,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             "n_baselined": len(baselined),
             "n_suppressed": len(analysis.suppressed),
             "stale_baseline_entries": stale,
+            "per_rule": per_rule_counts(analysis, active, baselined),
+            "analysis_seconds": round(analysis_seconds, 3),
+            **({"cache": {"hits": cache.hits, "misses": cache.misses,
+                          "trusted": cache.trusted}} if cache else {}),
         }, indent=1))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(active, stale), indent=1))
     else:
         for f in active:
             print(f.render())
@@ -111,8 +275,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"{e['rule']}@{e['file']} ({e.get('text', '')!r}) — "
                   f"the flagged code changed; prune with "
                   f"--update-baseline", file=sys.stderr)
+        cache_note = ""
+        if cache is not None:
+            cache_note = (f", cache {cache.hits} hit/"
+                          f"{cache.trusted} trusted/"
+                          f"{cache.misses} parsed")
         print(f"staticcheck: {len(active)} finding(s), "
               f"{len(baselined)} baselined, "
               f"{len(analysis.suppressed)} suppressed "
-              f"(rules v{core.RULES_VERSION})", file=sys.stderr)
+              f"(rules v{core.RULES_VERSION}, "
+              f"{analysis_seconds:.2f}s{cache_note})", file=sys.stderr)
     return 1 if active else 0

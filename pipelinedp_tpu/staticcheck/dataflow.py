@@ -1,0 +1,793 @@
+"""Interprocedural dataflow over the project call graph.
+
+Two engines share the :class:`model.CallGraph`:
+
+  * **Taint** (:func:`run_taint`) — forward value-taint from registered
+    *sources* (functions whose return carries raw row-column data) to
+    registered *sinks* (export surfaces: trace-span attrs, telemetry
+    values, journal payloads, observability exports, driver release
+    returns), with registered *sanitizers* (DP noise mechanisms /
+    selection kernels) clearing taint. Per-function summaries (which
+    params flow to the return, which params reach a sink, which source
+    origins escape through the return) are computed to a fixpoint over
+    the call graph, so a value that crosses five functions between the
+    ingest column and the span attribute is still tracked — and the
+    finding message carries the full source→sink call path.
+  * **Locks** (:func:`run_locks`) — held-lock propagation: which locks a
+    function may acquire (transitively), which blocking operations it
+    may perform (transitively), and therefore which lock-order edges
+    (L1 held while L2 is acquired) and blocking-while-locked flows the
+    project contains. The lock-order rule turns the edge set into a
+    deadlock proof (cycle detection) and flags blocking calls under a
+    lock with the interprocedural path.
+
+Unknown-callee policy (stated per engine, tested in
+tests/test_callgraph.py):
+
+  * taint treats an unresolved call CONSERVATIVELY as pass-through —
+    ``f(tainted)`` returns tainted when ``f`` cannot be resolved, so a
+    third-party hop never launders a value (declassifiers below are the
+    deliberate exception);
+  * lock facts are only claimed for resolved callees — an unresolved
+    call cannot be proven to acquire or block, so it contributes nothing
+    (EXCEPT the syntactic blocking patterns — ``.join()``/``.wait()``/
+    ``time.sleep``/… — which are matched on the call expression itself).
+
+Sizes declassify: ``len(x)``, ``.shape``/``.nbytes``/``.n_rows``/… of a
+tainted value are cardinality metadata, not row values. Ingest-side
+counts are visible to the operator who owns the input bytes anyway; the
+invariant this engine guards is that raw VALUES (partition keys,
+per-partition aggregates) never reach an export un-noised.
+"""
+
+import ast
+import dataclasses
+from typing import (Callable, Dict, FrozenSet, List, Optional,
+                    Sequence, Set, Tuple)
+
+from pipelinedp_tpu.staticcheck.model import (CallGraph, FunctionInfo,
+                                              Module)
+
+# Bound on recorded path length / origins per summary: deep pipelines
+# stay readable and fixpoints stay small.
+_MAX_PATH = 10
+_MAX_ORIGINS = 8
+_MAX_FIXPOINT_ROUNDS = 12
+
+
+# ---------------------------------------------------------------------------
+# Taint engine
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Origin:
+    """Where a tainted value entered the flow, plus the call path it has
+    taken since (outermost hop first)."""
+    label: str
+    rel: str
+    line: int
+    path: Tuple[str, ...] = ()
+
+    def hop(self, step: str) -> "Origin":
+        if len(self.path) >= _MAX_PATH:
+            return self
+        return dataclasses.replace(self, path=self.path + (step,))
+
+    def render_path(self) -> str:
+        start = f"{self.label} ({self.rel}:{self.line})"
+        return " -> ".join((start,) + self.path)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamTok:
+    """Symbolic taint of a function parameter (summary computation)."""
+    name: str
+    path: Tuple[str, ...] = ()
+
+    def hop(self, step: str) -> "ParamTok":
+        if len(self.path) >= _MAX_PATH:
+            return self
+        return dataclasses.replace(self, path=self.path + (step,))
+
+
+@dataclasses.dataclass
+class TaintConfig:
+    """The rule-owned registries the engine runs against."""
+    # (rel, qualname) -> source label. A call resolving here returns
+    # tainted data.
+    sources: Dict[Tuple[str, str], str]
+    # Resolved project functions whose return is clean regardless of
+    # inputs (DP kernels: noise + threshold before anything escapes).
+    sanitizers: Set[Tuple[str, str]]
+    # Attribute-call names that sanitize (mechanism methods).
+    sanitizer_attrs: FrozenSet[str]
+    # Unresolved dotted callees that sanitize.
+    sanitizer_dotted: FrozenSet[str]
+    # Builtin/unknown callees whose result is size metadata, not values.
+    declass_calls: FrozenSet[str]
+    # Attribute loads that yield size metadata.
+    declass_attrs: FrozenSet[str]
+    # (rel, qualname) of driver release functions: a tainted return or
+    # yield inside them (or a function nested in them) is a sink.
+    release_funcs: Set[Tuple[str, str]]
+    # sink detector: (graph, mod, scope, call) -> list of
+    # (sink_label, [tainted arg expressions]) — see rules.py.
+    sink_args: Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class TaintFinding:
+    rel: str
+    line: int
+    sink: str
+    origin: Origin
+
+
+class _Summary:
+    __slots__ = ("ret_params", "ret_origins", "param_sinks")
+
+    def __init__(self):
+        self.ret_params: Set[str] = set()
+        self.ret_origins: Dict[Tuple[str, str, int], Origin] = {}
+        # (param, sink_label, rel, line, path) — a tainted argument for
+        # `param` reaches `sink` inside this function (transitively).
+        self.param_sinks: Set[Tuple[str, str, str, int, Tuple[str, ...]]]\
+            = set()
+
+    def digest(self) -> Tuple:
+        # Paths are presentation metadata and may differ between rounds;
+        # the fixpoint compares the path-free facts only.
+        return (frozenset(self.ret_params),
+                frozenset(self.ret_origins.keys()),
+                frozenset((p, s, r, ln) for p, s, r, ln, _
+                          in self.param_sinks))
+
+
+def _is_comprehension(node: ast.AST) -> bool:
+    return isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp))
+
+
+class _FunctionPass:
+    """One intraprocedural walk of a function given callee summaries."""
+
+    def __init__(self, engine: "_TaintEngine", info: FunctionInfo):
+        self.engine = engine
+        self.cfg = engine.cfg
+        self.graph = engine.graph
+        self.info = info
+        self.mod = engine.graph.modules[info.rel]
+        self.env: Dict[str, Set] = {}
+        self.summary = _Summary()
+        self.findings: List[TaintFinding] = []
+        self.in_release = (
+            info.key in self.cfg.release_funcs or any(
+                (info.rel, q) in self.cfg.release_funcs
+                for q in info.enclosing))
+
+    # -- expression taint ------------------------------------------------
+
+    def taint_of(self, node: Optional[ast.AST]) -> Set:
+        if node is None or isinstance(node, ast.Constant):
+            return set()
+        if isinstance(node, ast.Name):
+            return set(self.env.get(node.id, ()))
+        if isinstance(node, ast.Attribute):
+            if node.attr in self.cfg.declass_attrs:
+                return set()
+            return self.taint_of(node.value)
+        if isinstance(node, ast.Call):
+            return self.taint_of_call(node)
+        if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            return set()
+        if _is_comprehension(node):
+            out: Set = set()
+            for child in ast.walk(node):
+                if isinstance(child, ast.Call):
+                    out |= self.taint_of_call(child)
+                elif isinstance(child, ast.Name) and \
+                        isinstance(child.ctx, ast.Load):
+                    out |= set(self.env.get(child.id, ()))
+            return out
+        out = set()
+        for child in ast.iter_child_nodes(node):
+            out |= self.taint_of(child)
+        return out
+
+    def _arg_taints(self, call: ast.Call) -> List[Tuple[Optional[str],
+                                                        Set]]:
+        """[(param-name-or-None, taint)] for every argument."""
+        out = []
+        for arg in call.args:
+            node = arg.value if isinstance(arg, ast.Starred) else arg
+            out.append((None, self.taint_of(node)))
+        for kw in call.keywords:
+            out.append((kw.arg, self.taint_of(kw.value)))
+        return out
+
+    def taint_of_call(self, call: ast.Call) -> Set:
+        cfg = self.cfg
+        dotted = self.mod.dotted(call.func) or ""
+        leaf = dotted.rsplit(".", 1)[-1]
+        callee = self.graph.resolve_call(self.mod, call, self.info)
+
+        # Sink check first: the call's own arguments.
+        self._check_sinks(call, callee)
+
+        if callee is not None:
+            if callee.key in cfg.sanitizers:
+                return set()
+            label = cfg.sources.get(callee.key)
+            if label is not None:
+                return {Origin(label=label, rel=self.info.rel,
+                               line=call.lineno)}
+            return self._through_summary(call, callee)
+        # Unresolved callees.
+        if dotted in cfg.sanitizer_dotted or \
+                (isinstance(call.func, ast.Attribute) and
+                 call.func.attr in cfg.sanitizer_attrs):
+            return set()
+        if dotted in cfg.declass_calls or leaf in cfg.declass_calls:
+            return set()
+        # Conservative pass-through: taint in, taint out.
+        out: Set = set()
+        for _, taint in self._arg_taints(call):
+            out |= taint
+        out |= self.taint_of(call.func) if isinstance(
+            call.func, ast.Attribute) else set()
+        return out
+
+    def _through_summary(self, call: ast.Call,
+                         callee: FunctionInfo) -> Set:
+        """Substitute the callee's summary at this call site."""
+        summary = self.engine.summaries.get(callee.key)
+        if summary is None:
+            return set()
+        hop = (f"{callee.qualname} "
+               f"({self.info.rel}:{call.lineno})")
+        out: Set = set()
+        params = callee.params
+        # Map arguments onto parameter names (best effort).
+        arg_map: Dict[str, Set] = {}
+        pos = 0
+        for arg in call.args:
+            if isinstance(arg, ast.Starred):
+                break
+            if pos < len(params):
+                arg_map[params[pos]] = self.taint_of(arg)
+            pos += 1
+        for kw in call.keywords:
+            if kw.arg is not None:
+                arg_map[kw.arg] = self.taint_of(kw.value)
+        # Return taint from params.
+        for pname, taint in arg_map.items():
+            if pname in summary.ret_params:
+                out |= {t.hop(hop) for t in taint}
+            # Param-to-sink flows become findings (origins) or summary
+            # entries (params of THIS function).
+            for p, sink, rel, line, path in summary.param_sinks:
+                if p != pname:
+                    continue
+                for t in taint:
+                    inner = (hop,) + path
+                    if isinstance(t, Origin):
+                        self._emit(rel, line, sink,
+                                   dataclasses.replace(
+                                       t, path=(t.path + inner)[:_MAX_PATH]))
+                    elif isinstance(t, ParamTok):
+                        self.summary.param_sinks.add(
+                            (t.name, sink, rel, line,
+                             (t.path + inner)[:_MAX_PATH]))
+        # Origins generated inside the callee that escape its return.
+        for origin in summary.ret_origins.values():
+            out.add(origin.hop(hop))
+        return out
+
+    # -- sinks -----------------------------------------------------------
+
+    def _check_sinks(self, call: ast.Call,
+                     callee: Optional[FunctionInfo]) -> None:
+        hits = self.cfg.sink_args(self.graph, self.mod, self.info, call,
+                                  callee)
+        for sink_label, exprs in hits:
+            for expr in exprs:
+                for t in self.taint_of(expr):
+                    self._record_sink_taint(sink_label, call.lineno, t)
+
+    def _record_sink_taint(self, sink: str, line: int, t) -> None:
+        if isinstance(t, Origin):
+            self._emit(self.info.rel, line, sink, t)
+        elif isinstance(t, ParamTok):
+            self.summary.param_sinks.add(
+                (t.name, sink, self.info.rel, line, t.path))
+
+    def _emit(self, rel: str, line: int, sink: str,
+              origin: Origin) -> None:
+        self.findings.append(TaintFinding(rel=rel, line=line, sink=sink,
+                                          origin=origin))
+
+    # -- statements ------------------------------------------------------
+
+    def _assign(self, target: ast.AST, taint: Set) -> None:
+        if isinstance(target, ast.Name):
+            if taint:
+                self.env[target.id] = set(taint)
+            else:
+                self.env.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign(elt, taint)
+        elif isinstance(target, ast.Starred):
+            self._assign(target.value, taint)
+        # Attribute/subscript stores: the container keeps its taint.
+
+    def _note_return(self, value: Optional[ast.AST], line: int) -> None:
+        taint = self.taint_of(value)
+        # `return generator()` forwarding a nested generator is not
+        # itself a release: the generator's own yields are checked at
+        # their lines (one finding per actual emit point, not two).
+        forwards_nested = False
+        if isinstance(value, ast.Call):
+            callee = self.graph.resolve_call(self.mod, value, self.info)
+            forwards_nested = (callee is not None and
+                               callee.rel == self.info.rel and
+                               bool(callee.enclosing))
+        for t in taint:
+            if isinstance(t, ParamTok):
+                self.summary.ret_params.add(t.name)
+            elif isinstance(t, Origin):
+                if len(self.summary.ret_origins) < _MAX_ORIGINS:
+                    self.summary.ret_origins.setdefault(
+                        (t.label, t.rel, t.line), t)
+            if self.in_release and not forwards_nested:
+                self._record_sink_taint("driver release value", line, t)
+
+    def _walk_expr_stmts(self, node: ast.AST) -> None:
+        """Visit calls for sink/side effects in a bare expression."""
+        self.taint_of(node)
+
+    def walk(self, stmts: Sequence[ast.stmt]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # own pass via the function index
+            elif isinstance(stmt, ast.Assign):
+                taint = self.taint_of(stmt.value)
+                for t in stmt.targets:
+                    self._assign(t, taint)
+            elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+                if stmt.value is not None:
+                    taint = self.taint_of(stmt.value)
+                    if isinstance(stmt, ast.AugAssign):
+                        taint |= self.taint_of(stmt.target)
+                    self._assign(stmt.target, taint)
+            elif isinstance(stmt, (ast.Return,)):
+                self._note_return(stmt.value, stmt.lineno)
+            elif isinstance(stmt, ast.Expr):
+                if isinstance(stmt.value, (ast.Yield, ast.YieldFrom)):
+                    self._note_return(stmt.value.value, stmt.lineno)
+                else:
+                    self._walk_expr_stmts(stmt.value)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._assign(stmt.target, self.taint_of(stmt.iter))
+                self.walk(stmt.body)
+                self.walk(stmt.orelse)
+            elif isinstance(stmt, ast.While):
+                self._walk_expr_stmts(stmt.test)
+                self.walk(stmt.body)
+                self.walk(stmt.orelse)
+            elif isinstance(stmt, ast.If):
+                self._walk_expr_stmts(stmt.test)
+                self.walk(stmt.body)
+                self.walk(stmt.orelse)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    taint = self.taint_of(item.context_expr)
+                    if item.optional_vars is not None:
+                        self._assign(item.optional_vars, taint)
+                self.walk(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                self.walk(stmt.body)
+                for handler in stmt.handlers:
+                    self.walk(handler.body)
+                self.walk(stmt.orelse)
+                self.walk(stmt.finalbody)
+            elif isinstance(stmt, (ast.Raise, ast.Assert, ast.Delete)):
+                for child in ast.iter_child_nodes(stmt):
+                    self._walk_expr_stmts(child)
+            # pass/break/continue/import/global/nonlocal: nothing flows.
+
+    def run(self) -> None:
+        # Closure seeding: a nested def reads the enclosing scopes'
+        # variables — seed them from the enclosing functions' settled
+        # environments (outer-to-inner, so inner shadowing wins; the
+        # engine's fixpoint rounds make the outer env available). Own
+        # params override last.
+        for outer_qual in self.info.enclosing:
+            outer_env = self.engine.final_envs.get(
+                (self.info.rel, outer_qual))
+            if outer_env:
+                for name, taint in outer_env.items():
+                    self.env[name] = set(taint)
+        # Params carry symbolic taint; yields inside expressions (rare)
+        # are covered by the statement walk's Expr/Return handling.
+        for p in self.info.params:
+            self.env[p] = {ParamTok(name=p)}
+        # Two passes propagate loop-carried taint (monotone: the second
+        # pass starts from the first pass's environment), findings taken
+        # from the settled pass only.
+        body = self.info.node.body
+        self.walk(body)
+        self.findings.clear()
+        self.walk(body)
+        self.engine.final_envs[self.info.key] = self.env
+
+
+class _TaintEngine:
+    def __init__(self, graph: CallGraph, cfg: TaintConfig):
+        self.graph = graph
+        self.cfg = cfg
+        self.summaries: Dict[Tuple[str, str], _Summary] = {}
+        # Settled per-function environments, read by nested defs for
+        # closure-variable seeding.
+        self.final_envs: Dict[Tuple[str, str], Dict[str, Set]] = {}
+
+    def run(self) -> List[TaintFinding]:
+        funcs = list(self.graph.iter_functions())
+        for _ in range(_MAX_FIXPOINT_ROUNDS):
+            changed = False
+            for info in funcs:
+                fp = _FunctionPass(self, info)
+                fp.run()
+                prev = self.summaries.get(info.key)
+                if prev is None or prev.digest() != fp.summary.digest():
+                    changed = True
+                self.summaries[info.key] = fp.summary
+            if not changed:
+                break
+        findings: Dict[Tuple[str, int, str, str], TaintFinding] = {}
+        for info in funcs:
+            fp = _FunctionPass(self, info)
+            fp.run()
+            for f in fp.findings:
+                findings.setdefault(
+                    (f.rel, f.line, f.sink, f.origin.label), f)
+        return list(findings.values())
+
+
+def run_taint(graph: CallGraph, cfg: TaintConfig) -> List[TaintFinding]:
+    return _TaintEngine(graph, cfg).run()
+
+
+# ---------------------------------------------------------------------------
+# Lock engine
+# ---------------------------------------------------------------------------
+
+# Lock identity: (rel, owner-class-or-"", attribute name). Per-class
+# identity is the standard approximation — two instances of one class
+# share a lock *order* even though they hold distinct lock objects.
+LockId = Tuple[str, str, str]
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockingSite:
+    desc: str            # e.g. "Thread.start()", "time.sleep"
+    rel: str
+    line: int
+    path: Tuple[str, ...] = ()
+
+    def hop(self, step: str) -> "BlockingSite":
+        if len(self.path) >= _MAX_PATH:
+            return self
+        return dataclasses.replace(self, path=self.path + (step,))
+
+
+@dataclasses.dataclass(frozen=True)
+class AcquireSite:
+    lock: LockId
+    rel: str
+    line: int
+    path: Tuple[str, ...] = ()
+
+    def hop(self, step: str) -> "AcquireSite":
+        if len(self.path) >= _MAX_PATH:
+            return self
+        return dataclasses.replace(self, path=self.path + (step,))
+
+
+@dataclasses.dataclass
+class LockConfig:
+    # Declared locks per (rel, cls-or-""): lock attribute names from
+    # guarded_by declarations. Names containing "lock" are recognized
+    # undeclared (conservative: ordering applies to every mutex-looking
+    # `with`).
+    declared: Dict[Tuple[str, str], Set[str]]
+    # Attribute names whose call blocks (receiver must not be a string
+    # constant — keeps ",".join() out).
+    blocking_attrs: FrozenSet[str]
+    # Dotted callee names that block.
+    blocking_dotted: FrozenSet[str]
+    # Resolved project callees that block (e.g. mesh.host_fetch).
+    blocking_funcs: Set[Tuple[str, str]]
+    # Dotted prefixes whose attribute calls are never blocking even when
+    # the attr name matches (os.path.join is not Thread.join).
+    nonblocking_prefixes: Tuple[str, ...] = ("os.path.",)
+
+
+@dataclasses.dataclass
+class LockReport:
+    # (held, acquired) -> first witness (rel, line, path-desc)
+    edges: Dict[Tuple[LockId, LockId], Tuple[str, int, str]]
+    # blocking-while-locked findings: (rel, line, held lock, blocking
+    # site with path)
+    blocking: List[Tuple[str, int, LockId, BlockingSite]]
+
+
+def _lock_of_with_item(mod: Module, cfg: LockConfig, item: ast.withitem,
+                       info: FunctionInfo) -> Optional[LockId]:
+    dotted = mod.dotted(item.context_expr)
+    if dotted is None:
+        return None
+    parts = dotted.split(".")
+    if parts[0] == "self" and len(parts) == 2:
+        name = parts[1]
+        owner_cls = info.cls
+        if owner_cls is None:
+            return None
+        declared = cfg.declared.get((info.rel, owner_cls), set())
+        if name in declared or "lock" in name.lower():
+            return (info.rel, owner_cls, name)
+        return None
+    if len(parts) == 1:
+        name = parts[0]
+        declared = cfg.declared.get((info.rel, ""), set())
+        if name in declared or "lock" in name.lower():
+            return (info.rel, "", name)
+    return None
+
+
+def _direct_blocking(mod: Module, cfg: LockConfig, graph: CallGraph,
+                     info: FunctionInfo,
+                     call: ast.Call) -> Optional[str]:
+    """Blocking description when the call itself matches a syntactic
+    blocking pattern, else None."""
+    dotted = mod.dotted(call.func)
+    if dotted in cfg.blocking_dotted:
+        return dotted
+    if dotted is not None and dotted.startswith(
+            cfg.nonblocking_prefixes):
+        return None
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in cfg.blocking_attrs and \
+            not isinstance(call.func.value, ast.Constant):
+        return f".{call.func.attr}()"
+    return None
+
+
+class _LockEngine:
+    def __init__(self, graph: CallGraph, cfg: LockConfig):
+        self.graph = graph
+        self.cfg = cfg
+        # function key -> facts
+        self.may_acquire: Dict[Tuple[str, str], Dict[LockId,
+                                                     AcquireSite]] = {}
+        # Facts are keyed by the ROOT blocking site (desc, rel, line) —
+        # a stable identity, so propagation converges in call-depth
+        # rounds even across call cycles; the human-readable via-chain
+        # lives in BlockingSite.path (length-capped).
+        self.may_block: Dict[Tuple[str, str],
+                             Dict[Tuple[str, str, int],
+                                  BlockingSite]] = {}
+        # Per-function structural events, computed once: the AST walk
+        # (with held-lock scoping) is identical every fixpoint round;
+        # only the propagated facts change.
+        self._events: Dict[Tuple[str, str], List[Tuple]] = {}
+
+    def _function_events(self, info: FunctionInfo) -> List[Tuple]:
+        """[("call", call_node, held) | ("acquire", lock, line, held)]
+        in syntactic order, held as a tuple of LockIds."""
+        cached = self._events.get(info.key)
+        if cached is not None:
+            return cached
+        events: List[Tuple] = []
+        self._walk(info,
+                   lambda call, held: events.append(("call", call, held)),
+                   lambda lock, line, held: events.append(
+                       ("acquire", lock, line, held)))
+        self._events[info.key] = events
+        return events
+
+    # -- per-function structural walk ------------------------------------
+
+    def _walk(self, info: FunctionInfo,
+              on_call, on_acquire) -> None:
+        """Walks the body tracking the held-lock set; invokes
+        ``on_call(call, held)`` for every call and ``on_acquire(lock,
+        line, held)`` for every lock acquisition."""
+        mod = self.graph.modules[info.rel]
+
+        def visit(node: ast.AST, held: Tuple[LockId, ...]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return  # nested defs run later, outside these locks
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in node.items:
+                    visit(item.context_expr, held)
+                    lock = _lock_of_with_item(mod, self.cfg, item, info)
+                    if lock is not None:
+                        on_acquire(lock, node.lineno, held)
+                        acquired.append(lock)
+                inner = held + tuple(acquired)
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, ast.Call):
+                on_call(node, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in info.node.body:
+            visit(stmt, ())
+
+    # -- fixpoint facts --------------------------------------------------
+
+    def _compute_facts(self) -> None:
+        funcs = list(self.graph.iter_functions())
+        for info in funcs:
+            self.may_acquire[info.key] = {}
+            self.may_block[info.key] = {}
+        for _ in range(_MAX_FIXPOINT_ROUNDS):
+            changed = False
+            for info in funcs:
+                acq = dict(self.may_acquire[info.key])
+                blk = dict(self.may_block[info.key])
+                mod = self.graph.modules[info.rel]
+
+                def on_call(call, held, info=info, mod=mod, acq=acq,
+                            blk=blk):
+                    direct = _direct_blocking(mod, self.cfg, self.graph,
+                                              info, call)
+                    if direct is not None:
+                        key = (direct, info.rel, call.lineno)
+                        if key not in blk:
+                            blk[key] = BlockingSite(desc=direct,
+                                                    rel=info.rel,
+                                                    line=call.lineno)
+                    callee = self.graph.resolve_call(mod, call, info)
+                    if callee is None:
+                        return
+                    if callee.key in self.cfg.blocking_funcs:
+                        key = (callee.qualname, info.rel, call.lineno)
+                        if key not in blk:
+                            blk[key] = BlockingSite(desc=callee.qualname,
+                                                    rel=info.rel,
+                                                    line=call.lineno)
+                    hop = (f"{callee.qualname} "
+                           f"({info.rel}:{call.lineno})")
+                    for lock, site in self.may_acquire.get(
+                            callee.key, {}).items():
+                        if lock not in acq:
+                            acq[lock] = AcquireSite(
+                                lock=lock, rel=info.rel,
+                                line=call.lineno).hop(site.rel + ":" +
+                                                      str(site.line))
+                    for key, site in self.may_block.get(
+                            callee.key, {}).items():
+                        if key not in blk:
+                            blk[key] = site.hop(hop)
+
+                def on_acquire(lock, line, held, info=info, acq=acq):
+                    if lock not in acq:
+                        acq[lock] = AcquireSite(lock=lock, rel=info.rel,
+                                                line=line)
+
+                for event in self._function_events(info):
+                    if event[0] == "call":
+                        on_call(event[1], event[2])
+                    else:
+                        on_acquire(event[1], event[2], event[3])
+                if acq.keys() != self.may_acquire[info.key].keys() or \
+                        blk.keys() != self.may_block[info.key].keys():
+                    changed = True
+                self.may_acquire[info.key] = acq
+                self.may_block[info.key] = blk
+            if not changed:
+                break
+
+    # -- report ----------------------------------------------------------
+
+    def run(self) -> LockReport:
+        self._compute_facts()
+        edges: Dict[Tuple[LockId, LockId], Tuple[str, int, str]] = {}
+        blocking: List[Tuple[str, int, LockId, BlockingSite]] = []
+        seen_block: Set[Tuple[str, int, str]] = set()
+        for info in self.graph.iter_functions():
+            mod = self.graph.modules[info.rel]
+
+            def on_call(call, held, info=info, mod=mod):
+                if not held:
+                    return
+                direct = _direct_blocking(mod, self.cfg, self.graph,
+                                          info, call)
+                callee = self.graph.resolve_call(mod, call, info)
+                if direct is not None:
+                    key = (info.rel, call.lineno, direct)
+                    if key not in seen_block:
+                        seen_block.add(key)
+                        blocking.append(
+                            (info.rel, call.lineno, held[-1],
+                             BlockingSite(desc=direct, rel=info.rel,
+                                          line=call.lineno)))
+                if callee is None:
+                    return
+                if callee.key in self.cfg.blocking_funcs:
+                    key = (info.rel, call.lineno, callee.qualname)
+                    if key not in seen_block:
+                        seen_block.add(key)
+                        blocking.append(
+                            (info.rel, call.lineno, held[-1],
+                             BlockingSite(desc=callee.qualname,
+                                          rel=info.rel,
+                                          line=call.lineno)))
+                hop = f"{callee.qualname} ({info.rel}:{call.lineno})"
+                for _key, site in self.may_block.get(callee.key,
+                                                     {}).items():
+                    key = (info.rel, call.lineno, site.desc)
+                    if key not in seen_block:
+                        seen_block.add(key)
+                        blocking.append((info.rel, call.lineno, held[-1],
+                                         site.hop(hop)))
+                for lock in self.may_acquire.get(callee.key, {}):
+                    for h in held:
+                        edges.setdefault(
+                            (h, lock),
+                            (info.rel, call.lineno,
+                             f"via {callee.qualname}"))
+
+            def on_acquire(lock, line, held, info=info):
+                for h in held:
+                    edges.setdefault((h, lock), (info.rel, line, "direct"))
+
+            for event in self._function_events(info):
+                if event[0] == "call":
+                    on_call(event[1], event[2])
+                else:
+                    on_acquire(event[1], event[2], event[3])
+        return LockReport(edges=edges, blocking=blocking)
+
+
+def run_locks(graph: CallGraph, cfg: LockConfig) -> LockReport:
+    return _LockEngine(graph, cfg).run()
+
+
+def find_lock_cycles(
+        edges: Dict[Tuple[LockId, LockId], Tuple[str, int, str]]
+) -> List[List[LockId]]:
+    """Elementary cycles in the lock-order graph (incl. self-loops),
+    deduplicated by rotation."""
+    adj: Dict[LockId, Set[LockId]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    cycles: Dict[Tuple[LockId, ...], List[LockId]] = {}
+
+    def dfs(start: LockId, node: LockId, path: List[LockId],
+            on_path: Set[LockId]) -> None:
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                cyc = list(path)
+                pivot = min(range(len(cyc)), key=lambda i: cyc[i])
+                canon = tuple(cyc[pivot:] + cyc[:pivot])
+                cycles.setdefault(canon, cyc)
+            elif nxt not in on_path and nxt > start:
+                path.append(nxt)
+                on_path.add(nxt)
+                dfs(start, nxt, path, on_path)
+                on_path.remove(nxt)
+                path.pop()
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+    return list(cycles.values())

@@ -37,6 +37,12 @@ REASON_REQUIRED = frozenset({
     "host-transfer",
     "lock-discipline",
     "key-hygiene",
+    # The interprocedural families guard release/deadlock/ledger
+    # invariants; an unexplained waiver on any of them is indistinguishable
+    # from a leak two reviews later.
+    "release-taint",
+    "lock-order",
+    "budget-flow",
 })
 
 _SUPPRESS_RE = re.compile(
@@ -172,12 +178,25 @@ def _import_aliases(tree: ast.AST) -> Dict[str, str]:
 
 def canonical_rel(path: str) -> str:
     """Stable module identity: the path from the ``pipelinedp_tpu``
-    package segment onward (posix-separated), or the cwd-relative path
-    for files outside the package."""
+    package segment onward (posix-separated) — likewise from a
+    ``benchmarks``/``examples`` segment for the perf/demo trees — or the
+    cwd-relative path for files outside all of them."""
     parts = os.path.abspath(path).split(os.sep)
-    if "pipelinedp_tpu" in parts:
-        return "/".join(parts[parts.index("pipelinedp_tpu"):])
+    for anchor in ("pipelinedp_tpu", "benchmarks", "examples"):
+        if anchor in parts:
+            return "/".join(parts[parts.index(anchor):])
     return os.path.relpath(path).replace(os.sep, "/")
+
+
+def module_dotted(rel: str) -> str:
+    """Dotted import name of a canonical rel path:
+    ``pipelinedp_tpu/runtime/telemetry.py`` -> ``pipelinedp_tpu.runtime.
+    telemetry``; package ``__init__.py`` maps to the package itself."""
+    name = rel[:-3] if rel.endswith(".py") else rel
+    name = name.replace("/", ".")
+    if name.endswith(".__init__"):
+        name = name[:-len(".__init__")]
+    return name
 
 
 def parse_source(rel: str, source: str) -> Module:
@@ -221,3 +240,216 @@ def load_tree(paths: Iterable[str]) -> List[Module]:
     for path in iter_python_files(paths):
         modules.append(parse_file(path))
     return modules
+
+
+# ---------------------------------------------------------------------------
+# Project call graph + per-function summary layer
+# ---------------------------------------------------------------------------
+#
+# The interprocedural rule families (release-taint, lock-order,
+# budget-flow) quantify over *flows across functions*, which needs one
+# shared answer to "which function does this call reach?". The graph is
+# deliberately syntactic and conservative:
+#
+#   * bare names resolve through the lexical scope chain (nested defs,
+#     then module level), `self.m()` resolves through the class and its
+#     project-resolvable bases, and dotted calls resolve through the
+#     same import-alias canonicalization Module.dotted already applies —
+#     so `tele.record(...)` lands on runtime/telemetry.py:record however
+#     the import was spelled;
+#   * a call that cannot be resolved to a project function returns None.
+#     Each rule states its own unknown-callee policy (taint passes
+#     through conservatively; lock/budget facts are only claimed for
+#     resolved callees) — see dataflow.py.
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    """One function/method (including nested defs) in the project."""
+    rel: str
+    qualname: str               # "f", "Cls.m", "outer.inner"
+    node: ast.AST               # FunctionDef / AsyncFunctionDef
+    cls: Optional[str]          # enclosing class name, if a method
+    enclosing: Tuple[str, ...]  # qualnames of enclosing functions, outer->in
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.rel, self.qualname)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def params(self) -> List[str]:
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if names and names[0] in ("self", "cls"):
+            names = names[1:]
+        return names
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    rel: str
+    name: str
+    node: ast.ClassDef
+    bases: Tuple[str, ...]      # canonical dotted base names
+
+
+class CallGraph:
+    """Project-wide function index + call resolution over the shared
+    model. Build once per analysis pass and share across rules."""
+
+    def __init__(self, modules: Iterable[Module]):
+        self.modules: Dict[str, Module] = {m.rel: m for m in modules}
+        self.by_dotted: Dict[str, Module] = {
+            module_dotted(rel): m for rel, m in self.modules.items()
+        }
+        self.functions: Dict[Tuple[str, str], FunctionInfo] = {}
+        self.classes: Dict[Tuple[str, str], ClassInfo] = {}
+        # (id(call node), scope qualname) -> resolution. The graph owns
+        # the modules (and therefore the AST nodes), so node ids stay
+        # pinned for its lifetime; fixpoint engines re-resolve the same
+        # call sites every round, and memoizing here is what keeps the
+        # interprocedural pass at seconds on the full tree.
+        self._resolve_memo: Dict[Tuple[int, Optional[str]],
+                                 Optional["FunctionInfo"]] = {}
+        for mod in self.modules.values():
+            self._index_module(mod)
+
+    # -- indexing --------------------------------------------------------
+
+    def _index_module(self, mod: Module) -> None:
+        def walk(node: ast.AST, cls: Optional[str],
+                 enclosing: Tuple[str, ...]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    if not enclosing and cls is None:
+                        self.classes[(mod.rel, child.name)] = ClassInfo(
+                            rel=mod.rel, name=child.name, node=child,
+                            bases=tuple(
+                                d for d in (mod.dotted(b)
+                                            for b in child.bases)
+                                if d is not None))
+                    walk(child, child.name, enclosing)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    prefix = ".".join(enclosing)
+                    qual = (f"{cls}.{child.name}" if cls and not enclosing
+                            else (f"{prefix}.{child.name}" if prefix
+                                  else child.name))
+                    info = FunctionInfo(rel=mod.rel, qualname=qual,
+                                        node=child,
+                                        cls=cls if not enclosing else None,
+                                        enclosing=enclosing)
+                    self.functions[info.key] = info
+                    walk(child, None, enclosing + (qual,))
+                else:
+                    walk(child, cls, enclosing)
+
+        walk(mod.tree, None, ())
+
+    def iter_functions(self) -> Iterable[FunctionInfo]:
+        return self.functions.values()
+
+    # -- resolution ------------------------------------------------------
+
+    def _resolve_class(self, mod: Module,
+                       dotted: str) -> Optional[ClassInfo]:
+        if "." not in dotted:
+            return self.classes.get((mod.rel, dotted))
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            owner = self.by_dotted.get(".".join(parts[:i]))
+            if owner is not None and len(parts) - i == 1:
+                return self.classes.get((owner.rel, parts[i]))
+        return None
+
+    def resolve_method(self, rel: str, cls: str,
+                       name: str) -> Optional[FunctionInfo]:
+        """Method lookup through the class and its project bases."""
+        seen = set()
+        queue = [(rel, cls)]
+        while queue:
+            key = queue.pop(0)
+            if key in seen:
+                continue
+            seen.add(key)
+            hit = self.functions.get((key[0], f"{key[1]}.{name}"))
+            if hit is not None:
+                return hit
+            info = self.classes.get(key)
+            if info is None:
+                continue
+            owner = self.modules.get(key[0])
+            for base in info.bases:
+                base_cls = self._resolve_class(owner, base)
+                if base_cls is not None:
+                    queue.append((base_cls.rel, base_cls.name))
+        return None
+
+    def resolve_call(self, mod: Module, call: ast.Call,
+                     scope: Optional[FunctionInfo] = None
+                     ) -> Optional[FunctionInfo]:
+        """The project function a call lands on, or None (unknown:
+        builtins, third-party, dynamic dispatch on locals)."""
+        memo_key = (id(call), scope.qualname if scope else None)
+        if memo_key in self._resolve_memo:
+            return self._resolve_memo[memo_key]
+        hit = self._resolve_call_uncached(mod, call, scope)
+        self._resolve_memo[memo_key] = hit
+        return hit
+
+    def _resolve_call_uncached(self, mod: Module, call: ast.Call,
+                               scope: Optional[FunctionInfo] = None
+                               ) -> Optional[FunctionInfo]:
+        dotted = mod.dotted(call.func)
+        if dotted is None:
+            return None
+        parts = dotted.split(".")
+        # self.m() -> method of the enclosing class (or a base).
+        if parts[0] == "self" and len(parts) == 2 and scope is not None:
+            cls = scope.cls
+            if cls is None and scope.enclosing:
+                outer = self.functions.get((mod.rel, scope.enclosing[0]))
+                cls = outer.cls if outer is not None else None
+            if cls is not None:
+                return self.resolve_method(mod.rel, cls, parts[1])
+            return None
+        if len(parts) == 1:
+            name = parts[0]
+            # Lexical chain: nested defs of the enclosing functions first.
+            if scope is not None:
+                chain = scope.enclosing + (scope.qualname,)
+                for outer in reversed(chain):
+                    hit = self.functions.get((mod.rel, f"{outer}.{name}"))
+                    if hit is not None:
+                        return hit
+            hit = self.functions.get((mod.rel, name))
+            if hit is not None:
+                return hit
+            cls_info = self.classes.get((mod.rel, name))
+            if cls_info is not None:
+                return self.resolve_method(mod.rel, name, "__init__")
+            return None
+        # Dotted: longest prefix that names a project module.
+        for i in range(len(parts) - 1, 0, -1):
+            owner = self.by_dotted.get(".".join(parts[:i]))
+            if owner is None:
+                continue
+            rest = parts[i:]
+            if len(rest) == 1:
+                hit = self.functions.get((owner.rel, rest[0]))
+                if hit is not None:
+                    return hit
+                if (owner.rel, rest[0]) in self.classes:
+                    return self.resolve_method(owner.rel, rest[0],
+                                               "__init__")
+                return None
+            if len(rest) == 2:
+                if (owner.rel, rest[0]) in self.classes:
+                    return self.resolve_method(owner.rel, rest[0], rest[1])
+                return None
+            return None
+        return None

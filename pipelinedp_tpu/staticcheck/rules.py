@@ -14,7 +14,8 @@ import collections
 import re
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
-from pipelinedp_tpu.staticcheck.model import Finding, Module
+from pipelinedp_tpu.staticcheck import dataflow
+from pipelinedp_tpu.staticcheck.model import CallGraph, Finding, Module
 
 Rule = collections.namedtuple("Rule", ["rule_id", "help", "fn"])
 
@@ -960,3 +961,504 @@ def broad_except(modules: List[Module]) -> Iterator[Finding]:
                 "broad `except Exception` without a classification "
                 "comment — classify-and-reraise (see runtime/retry.py "
                 "sites) or annotate `# noqa: BLE001 - <reason>`")
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural families (8-10): one shared call graph per pass
+# ---------------------------------------------------------------------------
+
+# Rules 8-10 are flows across functions; they share one CallGraph (and
+# the dataflow engines built on it) per analyze() pass instead of each
+# re-deriving it. The cache is keyed by the identities of the Module
+# objects (core.analyze hands each rule a fresh list wrapping the SAME
+# parsed modules).
+_GRAPH_CACHE: "collections.OrderedDict[tuple, CallGraph]" = \
+    collections.OrderedDict()
+
+
+def _call_graph(modules: List[Module]) -> CallGraph:
+    key = tuple(id(m) for m in modules)
+    hit = _GRAPH_CACHE.get(key)
+    if hit is None:
+        # The entry pins the module list: while it lives, no id in the
+        # key can be recycled by the allocator for a different Module.
+        hit = (CallGraph(modules), list(modules))
+        _GRAPH_CACHE[key] = hit
+        while len(_GRAPH_CACHE) > 4:
+            _GRAPH_CACHE.popitem(last=False)
+    return hit[0]
+
+
+# ---------------------------------------------------------------------------
+# (8) release-taint
+# ---------------------------------------------------------------------------
+
+_EXECUTOR_REL = "pipelinedp_tpu/executor.py"
+_COLUMNAR_REL = "pipelinedp_tpu/columnar.py"
+_INGEST_REL = "pipelinedp_tpu/ingest.py"
+_OBSERVABILITY_REL = "pipelinedp_tpu/runtime/observability.py"
+
+# Raw-row sources: functions whose return carries un-noised row-column
+# data (encoded codes, partition vocabularies, raw value columns).
+TAINT_SOURCES: Dict[Tuple[str, str], str] = {
+    (_COLUMNAR_REL, "factorize"): "columnar.factorize",
+    (_COLUMNAR_REL, "encode_with_vocab"): "columnar.encode_with_vocab",
+    (_COLUMNAR_REL, "encode_columns"): "columnar.encode_columns",
+    (_COLUMNAR_REL, "encode"): "columnar.encode",
+    (_INGEST_REL, "chunk_factorize"): "ingest.chunk_factorize",
+    (_INGEST_REL, "stream_encode_columns"):
+        "ingest.stream_encode_columns",
+    (_INGEST_REL, "encode_shard"): "ingest.encode_shard",
+    (_INGEST_REL, "encode_local_shard_to_mesh"):
+        "ingest.encode_local_shard_to_mesh",
+    (_INGEST_REL, "ChunkedVocabEncoder.encode"):
+        "ChunkedVocabEncoder.encode",
+    (_INGEST_REL, "ChunkedVocabEncoder.merge"):
+        "ChunkedVocabEncoder.merge",
+    (_INGEST_REL, "ChunkedVocabEncoder.vocabulary"):
+        "ChunkedVocabEncoder.vocabulary",
+}
+
+# DP release points: values coming out of these are noised and/or
+# DP-threshold-selected — taint is cleared. (Bounding/offset kernels are
+# deliberately NOT here: bounded-but-un-noised stats are still raw.)
+TAINT_SANITIZERS: Set[Tuple[str, str]] = {
+    (_EXECUTOR_REL, "aggregate_kernel"),
+    (_EXECUTOR_REL, "select_kept_pair_stream"),
+    (_EXECUTOR_REL, "select_partitions_kernel"),
+    (_EXECUTOR_REL, "sweep_kernel"),
+    ("pipelinedp_tpu/parallel/large_p.py", "_block_kernel_dev"),
+    ("pipelinedp_tpu/parallel/large_p.py", "_selection_block_kernel"),
+    ("pipelinedp_tpu/parallel/large_p.py", "_sharded_block_kernel"),
+    ("pipelinedp_tpu/parallel/large_p.py", "_sharded_selection_block"),
+    ("pipelinedp_tpu/parallel/large_p.py", "_sharded_select_compact"),
+    ("pipelinedp_tpu/parallel/sharded.py", "_sharded_kernel"),
+    ("pipelinedp_tpu/parallel/sharded.py", "_sharded_select_kernel"),
+    ("pipelinedp_tpu/ops/selection_ops.py", "sample_keep_decisions"),
+    ("pipelinedp_tpu/ops/noise.py", "laplace_noise"),
+    ("pipelinedp_tpu/ops/noise.py", "gaussian_noise"),
+    ("pipelinedp_tpu/ops/noise.py", "additive_noise"),
+    ("pipelinedp_tpu/dp_computations.py", "apply_laplace_mechanism"),
+    ("pipelinedp_tpu/dp_computations.py", "apply_gaussian_mechanism"),
+    ("pipelinedp_tpu/dp_computations.py", "_add_random_noise"),
+    ("pipelinedp_tpu/dp_computations.py", "add_noise_vector"),
+    ("pipelinedp_tpu/dp_computations.py", "compute_dp_var"),
+}
+
+# Mechanism methods sanitize wherever the receiver came from.
+TAINT_SANITIZER_ATTRS = frozenset({
+    "add_noise", "compute_mean", "add_noise_vector",
+})
+TAINT_SANITIZER_DOTTED = frozenset()
+
+# Cardinality/metadata declassifiers (module docstring of dataflow.py).
+TAINT_DECLASS_CALLS = frozenset({"len", "bool", "isinstance", "hasattr",
+                                 "id", "type", "range"})
+TAINT_DECLASS_ATTRS = frozenset({"shape", "ndim", "size", "nbytes",
+                                 "dtype", "n_rows", "n_partitions",
+                                 "itemsize"})
+
+# Driver release functions: the engine-facing normalization points whose
+# return/yield IS the released output — anything tainted leaving here
+# un-noised is a privacy leak, not a telemetry nit.
+TAINT_RELEASE_FUNCS: Set[Tuple[str, str]] = {
+    (_EXECUTOR_REL, "lazy_aggregate"),
+    (_EXECUTOR_REL, "lazy_select_partitions"),
+}
+
+# Observability entry points that serialize their arguments off-process.
+_OBS_EXPORT_FUNCS = frozenset({
+    "export_process_state", "write_pod_rollup", "record_mechanism",
+    "persist_odometer", "account_bytes", "release_bytes",
+})
+
+
+def _taint_sink_args(graph, mod, scope, call, callee):
+    """Sink detector for release-taint (dataflow.TaintConfig.sink_args):
+    [(sink label, [arg expressions whose taint is a finding])]."""
+    hits = []
+    dotted = mod.dotted(call.func) or ""
+    leaf = dotted.rsplit(".", 1)[-1]
+    attr = call.func.attr if isinstance(call.func, ast.Attribute) else None
+    kw_exprs = [kw.value for kw in call.keywords]
+    if callee is not None and callee.rel == _OBSERVABILITY_REL and \
+            callee.qualname in _OBS_EXPORT_FUNCS:
+        hits.append((f"observability export ({callee.qualname})",
+                     list(call.args) + kw_exprs))
+        return hits
+    if leaf == "span" and (
+            (callee is not None and
+             callee.rel == "pipelinedp_tpu/runtime/trace.py") or
+            ".span" in dotted or dotted == "span"):
+        hits.append(("trace-span attr", kw_exprs))
+    elif attr == "set" and not call.args and call.keywords:
+        # Span token attr update: sp.set(bytes=..., rows=...).
+        hits.append(("trace-span attr", kw_exprs))
+    elif leaf == "instant":
+        hits.append(("trace instant attr", kw_exprs))
+    elif leaf == "record" and call.args and \
+            isinstance(call.args[0], ast.Constant):
+        hits.append(("telemetry counter attr",
+                     list(call.args[1:]) + kw_exprs))
+    elif leaf == "set_gauge" and len(call.args) >= 2:
+        hits.append(("telemetry gauge value", [call.args[1]]))
+    elif attr == "put" and len(call.args) == 3:
+        # BlockJournal.put(job_id, key, record): the persisted payload.
+        hits.append(("journal payload", [call.args[1], call.args[2]]))
+    return hits
+
+
+@rule(
+    "release-taint",
+    "Values derived from raw row columns (columnar/ingest sources) must "
+    "pass through a registered DP mechanism (dp_computations mechanisms, "
+    "the noised/selection kernels) before reaching an export sink: "
+    "trace-span/instant attrs, telemetry.record/set_gauge values, "
+    "journal payloads, observability exports, or the drivers' released "
+    "return values. Interprocedural: findings carry the full "
+    "source->sink call path. Sizes (len/.shape/.nbytes/...) are "
+    "cardinality metadata and declassify.")
+def release_taint(modules: List[Module]) -> Iterator[Finding]:
+    graph = _call_graph(modules)
+    cfg = dataflow.TaintConfig(
+        sources=TAINT_SOURCES,
+        sanitizers=TAINT_SANITIZERS,
+        sanitizer_attrs=TAINT_SANITIZER_ATTRS,
+        sanitizer_dotted=TAINT_SANITIZER_DOTTED,
+        declass_calls=TAINT_DECLASS_CALLS,
+        declass_attrs=TAINT_DECLASS_ATTRS,
+        release_funcs=TAINT_RELEASE_FUNCS,
+        sink_args=_taint_sink_args,
+    )
+    for f in sorted(dataflow.run_taint(graph, cfg),
+                    key=lambda f: (f.rel, f.line, f.sink,
+                                   f.origin.label)):
+        yield Finding(
+            "release-taint", f.rel, f.line,
+            f"un-noised raw-row-derived value reaches {f.sink} — route "
+            f"it through a registered DP mechanism first, or suppress "
+            f"with a reason naming the sanctioned release. Path: "
+            f"{f.origin.render_path()} -> {f.sink} ({f.rel}:{f.line})")
+
+
+# ---------------------------------------------------------------------------
+# (9) lock-order
+# ---------------------------------------------------------------------------
+
+# Syntactic blocking patterns: calls that can wait on another thread,
+# the scheduler, a device or the disk. Receiver-string constants are
+# excluded by the engine (",".join() is not Thread.join()).
+LOCK_BLOCKING_ATTRS = frozenset({
+    "join", "start", "result", "acquire", "wait", "serve_forever",
+    "shutdown", "fsync",
+})
+LOCK_BLOCKING_DOTTED = frozenset({
+    "time.sleep", "os.fsync", "subprocess.run", "subprocess.check_call",
+    "subprocess.check_output",
+})
+LOCK_BLOCKING_FUNCS: Set[Tuple[str, str]] = {
+    ("pipelinedp_tpu/parallel/mesh.py", "host_fetch"),
+    ("pipelinedp_tpu/parallel/mesh.py", "sync_fetch"),
+}
+
+_CALLER_HOLDS_RE = re.compile(r"caller holds", re.IGNORECASE)
+
+
+def _declared_locks(modules: List[Module]
+                    ) -> Dict[Tuple[str, str], Set[str]]:
+    """{(rel, cls-or-""): lock names} from guarded_by declarations."""
+    declared: Dict[Tuple[str, str], Set[str]] = {}
+    for mod in modules:
+        for stmt in mod.tree.body:
+            decl = _guarded_decl(mod, stmt)
+            if decl is not None:
+                declared.setdefault((mod.rel, ""), set()).add(decl[0])
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for stmt in cls.body:
+                decl = _guarded_decl(mod, stmt)
+                if decl is not None:
+                    declared.setdefault((mod.rel, cls.name),
+                                        set()).add(decl[0])
+    return declared
+
+
+def _lock_name(lock: "dataflow.LockId") -> str:
+    rel, cls, name = lock
+    owner = f"{cls}." if cls else ""
+    return f"{rel}:{owner}{name}"
+
+
+def _caller_holds_helpers(graph: CallGraph
+                          ) -> Dict[Tuple[str, str], str]:
+    """Functions whose def line carries a lock-discipline suppression
+    documented as 'caller holds <lock>': {func key: lock attr name}."""
+    out: Dict[Tuple[str, str], str] = {}
+    for info in graph.iter_functions():
+        mod = graph.modules[info.rel]
+        sup = mod.suppression_for("lock-discipline", info.node.lineno)
+        if sup is None or not sup.reason or \
+                not _CALLER_HOLDS_RE.search(sup.reason):
+            continue
+        declared = _declared_locks([mod]).get(
+            (info.rel, info.cls or ""), set())
+        m = re.search(r"(_[a-z_]*lock[a-z_]*)", sup.reason)
+        lock = m.group(1) if m else None
+        if lock is None and len(declared) == 1:
+            lock = next(iter(declared))
+        if lock is not None:
+            out[info.key] = lock
+    return out
+
+
+@rule(
+    "lock-order",
+    "The lock-acquisition graph over the runtime must be acyclic "
+    "(a cycle is a deadlock two threads can reach), no blocking call "
+    "(queue waits, thread join/start, future result, host_fetch, "
+    "sleep, fsync) may run while a lock is held — another thread may "
+    "need that lock to make the blocking operation complete — and a "
+    "helper documented 'caller holds <lock>' must actually be called "
+    "with the lock held at every resolved call site. Interprocedural: "
+    "held locks propagate through the call graph and findings carry "
+    "the call path.")
+def lock_order(modules: List[Module]) -> Iterator[Finding]:
+    graph = _call_graph(modules)
+    cfg = dataflow.LockConfig(
+        declared=_declared_locks(modules),
+        blocking_attrs=LOCK_BLOCKING_ATTRS,
+        blocking_dotted=LOCK_BLOCKING_DOTTED,
+        blocking_funcs=LOCK_BLOCKING_FUNCS,
+    )
+    report = dataflow.run_locks(graph, cfg)
+
+    # (a) deadlock proof: the acquisition graph must be acyclic.
+    for cycle in dataflow.find_lock_cycles(report.edges):
+        ring = cycle + cycle[:1]
+        witness_rel, witness_line, _ = report.edges[(ring[0], ring[1])]
+        yield Finding(
+            "lock-order", witness_rel, witness_line,
+            "lock-order cycle (deadlock reachable): " +
+            " -> ".join(_lock_name(l) for l in ring) +
+            " — two threads taking these locks in opposite orders wait "
+            "on each other forever; impose one global order")
+
+    # (b) blocking while holding a lock.
+    for rel, line, held, site in sorted(
+            report.blocking, key=lambda b: (b[0], b[1], b[3].desc)):
+        path = (" via " + " -> ".join(site.path)) if site.path else ""
+        yield Finding(
+            "lock-order", rel, line,
+            f"blocking operation {site.desc} while holding "
+            f"{_lock_name(held)}{path} — a thread that needs this lock "
+            f"to let the operation complete deadlocks (and every other "
+            f"contender stalls for the operation's full duration); move "
+            f"the wait outside the critical section")
+
+    # (c) caller-holds-lock helpers: verify every resolved call site.
+    helpers = _caller_holds_helpers(graph)
+    if helpers:
+        held_at: Dict[Tuple[str, str],
+                      List[Tuple[str, int, Set[str]]]] = {}
+        engine = dataflow._LockEngine(graph, cfg)
+        for info in graph.iter_functions():
+            mod = graph.modules[info.rel]
+
+            def on_call(call, held, info=info, mod=mod):
+                callee = graph.resolve_call(mod, call, info)
+                if callee is not None and callee.key in helpers:
+                    held_at.setdefault(callee.key, []).append(
+                        (info.rel, call.lineno,
+                         {lock[2] for lock in held}))
+
+            engine._walk(info, on_call, lambda *a: None)
+        for key, lock in sorted(helpers.items()):
+            for rel, line, held_names in held_at.get(key, []):
+                if lock not in held_names:
+                    yield Finding(
+                        "lock-order", rel, line,
+                        f"{key[1]} is documented 'caller holds "
+                        f"{lock}' but this call site does not hold it — "
+                        f"the helper touches guarded state unlocked")
+
+
+# ---------------------------------------------------------------------------
+# (10) budget-flow
+# ---------------------------------------------------------------------------
+
+_BUDGET_REL = "pipelinedp_tpu/budget_accounting.py"
+_DP_COMPUTATIONS_REL = "pipelinedp_tpu/dp_computations.py"
+
+# Noise-mechanism constructors: only dp_computations may build them (and
+# only from a registered MechanismSpec, via create_additive_mechanism /
+# create_mean_mechanism).
+_MECHANISM_CONSTRUCTORS = frozenset({
+    "LaplaceMechanism", "GaussianMechanism",
+})
+_MECHANISM_FACTORY_ATTRS = frozenset({
+    "create_from_epsilon", "create_from_epsilon_delta",
+    "create_from_std_deviation",
+})
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _register_calls_referencing(stmts: Iterable[ast.stmt],
+                                var: str) -> bool:
+    """True when some statement calls *_register_mechanism(...) with
+    `var` reachable in its arguments (MechanismSpecInternal wrapping
+    included)."""
+    for stmt in stmts:
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            leaf = func.attr if isinstance(func, ast.Attribute) else \
+                (func.id if isinstance(func, ast.Name) else "")
+            if leaf != "_register_mechanism":
+                continue
+            for arg in list(node.args) + [kw.value
+                                          for kw in node.keywords]:
+                if var in _names_in(arg):
+                    return True
+    return False
+
+
+@rule(
+    "budget-flow",
+    "Every constructed MechanismSpec must reach BudgetAccountant."
+    "_register_mechanism on all paths (the static dual of the runtime "
+    "no_new_mechanisms guard): specs may only be constructed in "
+    "budget_accounting.py and must be registered in the same suite "
+    "before any return; noise mechanisms (Laplace/Gaussian) may only be "
+    "built inside dp_computations.py from a registered spec; "
+    "_register_mechanism may only be called from request_budget "
+    "(graph-build time); and a request_budget() result must be bound — "
+    "a discarded spec is budget spent on noise nobody can calibrate.")
+def budget_flow(modules: List[Module]) -> Iterator[Finding]:
+    graph = _call_graph(modules)
+    for info in graph.iter_functions():
+        mod = graph.modules[info.rel]
+        fn = info.node
+        # (1) + (2): MechanismSpec construction siting + registration.
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.dotted(node.func) or ""
+            leaf = dotted.rsplit(".", 1)[-1]
+            if leaf == "MechanismSpec" and (
+                    dotted == "MechanismSpec" or
+                    dotted.endswith("budget_accounting.MechanismSpec") or
+                    ".MechanismSpec" in dotted):
+                if info.rel != _BUDGET_REL:
+                    yield Finding(
+                        "budget-flow", info.rel, node.lineno,
+                        "MechanismSpec constructed outside "
+                        "budget_accounting.py — specs exist only as "
+                        "receipts of BudgetAccountant.request_budget, "
+                        "which registers them with the ledger; an "
+                        "ad-hoc spec is unaccounted noise")
+            # (3): direct mechanism construction outside dp_computations.
+            ctor = leaf if leaf in _MECHANISM_CONSTRUCTORS else None
+            factory = (node.func.attr
+                       if isinstance(node.func, ast.Attribute) and
+                       node.func.attr in _MECHANISM_FACTORY_ATTRS
+                       else None)
+            if (ctor or factory) and info.rel not in (
+                    _DP_COMPUTATIONS_REL,):
+                what = ctor or factory
+                yield Finding(
+                    "budget-flow", info.rel, node.lineno,
+                    f"noise mechanism built directly ({what}) outside "
+                    f"dp_computations.py — mechanisms must be created "
+                    f"by create_additive_mechanism/create_mean_mechanism "
+                    f"from a MechanismSpec the ledger registered, or "
+                    f"the noise it draws is outside every privacy proof")
+        # Registration-dominance inside budget_accounting.py.
+        if info.rel == _BUDGET_REL:
+            yield from _check_spec_registration(mod, info)
+        # (4): discarded request_budget results. Only the ACCOUNTANT's
+        # request_budget returns the spec receipt; a combiner's
+        # same-named hook stores its spec itself and returns None.
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Call):
+                call = node.value
+                leaf = (call.func.attr
+                        if isinstance(call.func, ast.Attribute)
+                        else (call.func.id
+                              if isinstance(call.func, ast.Name)
+                              else ""))
+                resolved = graph.resolve_call(mod, call, info)
+                dotted = mod.dotted(call.func) or ""
+                accountant_recv = "accountant" in \
+                    dotted.rsplit(".", 1)[0].lower()
+                if leaf == "request_budget" and (
+                        accountant_recv or
+                        (resolved is not None and
+                         resolved.rel == _BUDGET_REL)):
+                    yield Finding(
+                        "budget-flow", info.rel, node.lineno,
+                        "request_budget() result discarded — the ledger "
+                        "registered (and will spend) budget for a "
+                        "mechanism whose spec nobody holds, so its noise "
+                        "can never be calibrated; bind the returned "
+                        "MechanismSpec or drop the request")
+        # (5): _register_mechanism called outside request_budget.
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = (node.func.attr
+                    if isinstance(node.func, ast.Attribute)
+                    else (node.func.id
+                          if isinstance(node.func, ast.Name) else ""))
+            if leaf != "_register_mechanism":
+                continue
+            if info.rel == _BUDGET_REL and info.name in (
+                    "request_budget", "_register_mechanism"):
+                continue
+            yield Finding(
+                "budget-flow", info.rel, node.lineno,
+                f"_register_mechanism called from {info.qualname} — "
+                f"registration belongs to request_budget (graph-build "
+                f"time) only; any other caller is the static shape of "
+                f"the double-spend no_new_mechanisms guards against")
+
+
+def _check_spec_registration(mod: Module,
+                             info) -> Iterator[Finding]:
+    """Within budget_accounting.py: a `x = MechanismSpec(...)` must be
+    followed, in the same statement suite, by a _register_mechanism call
+    referencing x."""
+    def suites(node: ast.AST) -> Iterator[List[ast.stmt]]:
+        for child in ast.walk(node):
+            for field in ("body", "orelse", "finalbody"):
+                stmts = getattr(child, field, None)
+                if isinstance(stmts, list) and stmts and \
+                        isinstance(stmts[0], ast.stmt):
+                    yield stmts
+
+    for suite in suites(info.node):
+        for i, stmt in enumerate(suite):
+            if not (isinstance(stmt, ast.Assign) and
+                    isinstance(stmt.value, ast.Call)):
+                continue
+            dotted = mod.dotted(stmt.value.func) or ""
+            if dotted.rsplit(".", 1)[-1] != "MechanismSpec":
+                continue
+            targets = [t.id for t in stmt.targets
+                       if isinstance(t, ast.Name)]
+            if not targets:
+                continue
+            var = targets[0]
+            if not _register_calls_referencing(suite[i + 1:], var):
+                yield Finding(
+                    "budget-flow", mod.rel, stmt.lineno,
+                    f"MechanismSpec bound to {var!r} is never passed to "
+                    f"_register_mechanism in this suite — a spec that "
+                    f"skips the ledger is noise outside the privacy "
+                    f"proof; register it (or construct it inside the "
+                    f"_register_mechanism call)")

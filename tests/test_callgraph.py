@@ -1,0 +1,317 @@
+"""Unit suite for the project call graph + dataflow engines.
+
+Covers resolution (import aliasing, from-imports, method dispatch
+through inheritance, nested defs, constructors), recursion convergence,
+and the stated unknown-callee policies (taint passes through; lock
+facts are only claimed for resolved callees).
+"""
+
+import pytest
+
+from pipelinedp_tpu.staticcheck import dataflow
+from pipelinedp_tpu.staticcheck import model
+from pipelinedp_tpu.staticcheck.model import CallGraph
+
+pytestmark = pytest.mark.staticcheck
+
+
+def _graph(sources):
+    return CallGraph([model.parse_source(rel, src)
+                      for rel, src in sources.items()])
+
+
+def _call_in(graph, rel, lineno=None):
+    """First ast.Call in the module (optionally at a given line)."""
+    import ast
+    mod = graph.modules[rel]
+    calls = [n for n in ast.walk(mod.tree) if isinstance(n, ast.Call)]
+    if lineno is not None:
+        calls = [c for c in calls if c.lineno == lineno]
+    return mod, calls[0]
+
+
+def _scope(graph, rel, qualname):
+    return graph.functions[(rel, qualname)]
+
+
+class TestResolution:
+
+    def test_module_dotted(self):
+        assert model.module_dotted("pipelinedp_tpu/runtime/telemetry.py") \
+            == "pipelinedp_tpu.runtime.telemetry"
+        assert model.module_dotted("pipelinedp_tpu/__init__.py") == \
+            "pipelinedp_tpu"
+
+    def test_import_alias_resolves(self):
+        g = _graph({
+            "pipelinedp_tpu/runtime/telemetry.py": (
+                "def record(name):\n    pass\n"),
+            "pipelinedp_tpu/user.py": (
+                "import pipelinedp_tpu.runtime.telemetry as tele\n"
+                "def f():\n"
+                "    tele.record('x')\n"),
+        })
+        mod, call = _call_in(g, "pipelinedp_tpu/user.py")
+        hit = g.resolve_call(mod, call, _scope(g, "pipelinedp_tpu/user.py",
+                                               "f"))
+        assert hit is not None
+        assert hit.key == ("pipelinedp_tpu/runtime/telemetry.py",
+                           "record")
+
+    def test_from_import_resolves(self):
+        g = _graph({
+            "pipelinedp_tpu/runtime/telemetry.py": (
+                "def record(name):\n    pass\n"),
+            "pipelinedp_tpu/user.py": (
+                "from pipelinedp_tpu.runtime.telemetry import record\n"
+                "def f():\n"
+                "    record('x')\n"),
+        })
+        mod, call = _call_in(g, "pipelinedp_tpu/user.py")
+        hit = g.resolve_call(mod, call,
+                             _scope(g, "pipelinedp_tpu/user.py", "f"))
+        assert hit.key == ("pipelinedp_tpu/runtime/telemetry.py",
+                           "record")
+
+    def test_self_method_dispatch_through_base_class(self):
+        g = _graph({
+            "pipelinedp_tpu/base.py": (
+                "class Base:\n"
+                "    def helper(self):\n"
+                "        pass\n"),
+            "pipelinedp_tpu/impl.py": (
+                "from pipelinedp_tpu.base import Base\n"
+                "class Impl(Base):\n"
+                "    def run(self):\n"
+                "        self.helper()\n"),
+        })
+        mod, call = _call_in(g, "pipelinedp_tpu/impl.py")
+        hit = g.resolve_call(mod, call,
+                             _scope(g, "pipelinedp_tpu/impl.py",
+                                    "Impl.run"))
+        assert hit.key == ("pipelinedp_tpu/base.py", "Base.helper")
+
+    def test_override_wins_over_base(self):
+        g = _graph({
+            "pipelinedp_tpu/m.py": (
+                "class Base:\n"
+                "    def helper(self):\n"
+                "        pass\n"
+                "class Impl(Base):\n"
+                "    def helper(self):\n"
+                "        pass\n"
+                "    def run(self):\n"
+                "        self.helper()\n"),
+        })
+        mod, call = _call_in(g, "pipelinedp_tpu/m.py")
+        hit = g.resolve_call(mod, call,
+                             _scope(g, "pipelinedp_tpu/m.py", "Impl.run"))
+        assert hit.qualname == "Impl.helper"
+
+    def test_nested_def_resolves_through_lexical_chain(self):
+        g = _graph({
+            "pipelinedp_tpu/m.py": (
+                "def outer():\n"
+                "    def inner():\n"
+                "        pass\n"
+                "    inner()\n"),
+        })
+        mod, call = _call_in(g, "pipelinedp_tpu/m.py", lineno=4)
+        hit = g.resolve_call(mod, call,
+                             _scope(g, "pipelinedp_tpu/m.py", "outer"))
+        assert hit.qualname == "outer.inner"
+
+    def test_constructor_resolves_to_init(self):
+        g = _graph({
+            "pipelinedp_tpu/m.py": (
+                "class C:\n"
+                "    def __init__(self, x):\n"
+                "        self.x = x\n"
+                "def f():\n"
+                "    return C(1)\n"),
+        })
+        mod, call = _call_in(g, "pipelinedp_tpu/m.py", lineno=5)
+        hit = g.resolve_call(mod, call,
+                             _scope(g, "pipelinedp_tpu/m.py", "f"))
+        assert hit.qualname == "C.__init__"
+
+    def test_unknown_callee_returns_none(self):
+        g = _graph({
+            "pipelinedp_tpu/m.py": (
+                "import numpy as np\n"
+                "def f(x):\n"
+                "    return np.asarray(x)\n"),
+        })
+        mod, call = _call_in(g, "pipelinedp_tpu/m.py")
+        assert g.resolve_call(
+            mod, call, _scope(g, "pipelinedp_tpu/m.py", "f")) is None
+
+
+def _taint_cfg(sources=None, release=None):
+    return dataflow.TaintConfig(
+        sources=sources or {},
+        sanitizers=set(),
+        sanitizer_attrs=frozenset({"add_noise"}),
+        sanitizer_dotted=frozenset(),
+        declass_calls=frozenset({"len"}),
+        declass_attrs=frozenset({"shape"}),
+        release_funcs=release or set(),
+        sink_args=lambda graph, mod, scope, call, callee: (
+            [("sink", [kw.value for kw in call.keywords])]
+            if getattr(call.func, "attr", "") == "sink_fn" else []),
+    )
+
+
+class TestTaintEngine:
+
+    SRC = {"pipelinedp_tpu/src.py": "def raw():\n    return 1\n"}
+    KEY = ("pipelinedp_tpu/src.py", "raw")
+
+    def test_recursion_converges(self):
+        g = _graph({
+            **self.SRC,
+            "pipelinedp_tpu/m.py": (
+                "import out\n"
+                "from pipelinedp_tpu.src import raw\n"
+                "def rec(x, n):\n"
+                "    if n == 0:\n"
+                "        return x\n"
+                "    return rec(x, n - 1)\n"
+                "def f(n):\n"
+                "    v = rec(raw(), n)\n"
+                "    out.sink_fn(value=v)\n"),
+        })
+        findings = dataflow.run_taint(g, _taint_cfg({self.KEY: "raw"}))
+        assert len(findings) == 1
+        assert findings[0].origin.label == "raw"
+        # The recursive hop shows in the path.
+        assert "rec" in findings[0].origin.render_path()
+
+    def test_mutual_recursion_converges(self):
+        g = _graph({
+            **self.SRC,
+            "pipelinedp_tpu/m.py": (
+                "from pipelinedp_tpu.src import raw\n"
+                "def a(x):\n"
+                "    return b(x)\n"
+                "def b(x):\n"
+                "    return a(x)\n"
+                "def f():\n"
+                "    return a(raw())\n"),
+        })
+        # Terminates (fixpoint round cap) without findings: no sink.
+        assert dataflow.run_taint(g, _taint_cfg({self.KEY: "raw"})) == []
+
+    def test_unknown_callee_is_pass_through(self):
+        g = _graph({
+            **self.SRC,
+            "pipelinedp_tpu/m.py": (
+                "import out, mystery\n"
+                "from pipelinedp_tpu.src import raw\n"
+                "def f():\n"
+                "    v = mystery.blend(raw())\n"
+                "    out.sink_fn(value=v)\n"),
+        })
+        findings = dataflow.run_taint(g, _taint_cfg({self.KEY: "raw"}))
+        assert len(findings) == 1
+
+    def test_sanitizer_attr_clears(self):
+        g = _graph({
+            **self.SRC,
+            "pipelinedp_tpu/m.py": (
+                "import out\n"
+                "from pipelinedp_tpu.src import raw\n"
+                "def f(mech):\n"
+                "    v = mech.add_noise(raw())\n"
+                "    out.sink_fn(value=v)\n"),
+        })
+        assert dataflow.run_taint(g, _taint_cfg({self.KEY: "raw"})) == []
+
+    def test_declassifier_clears(self):
+        g = _graph({
+            **self.SRC,
+            "pipelinedp_tpu/m.py": (
+                "import out\n"
+                "from pipelinedp_tpu.src import raw\n"
+                "def f():\n"
+                "    out.sink_fn(value=len(raw()), shape=raw().shape)\n"),
+        })
+        assert dataflow.run_taint(g, _taint_cfg({self.KEY: "raw"})) == []
+
+    def test_reassignment_clears_taint(self):
+        g = _graph({
+            **self.SRC,
+            "pipelinedp_tpu/m.py": (
+                "import out\n"
+                "from pipelinedp_tpu.src import raw\n"
+                "def f():\n"
+                "    v = raw()\n"
+                "    v = 0\n"
+                "    out.sink_fn(value=v)\n"),
+        })
+        assert dataflow.run_taint(g, _taint_cfg({self.KEY: "raw"})) == []
+
+
+class TestLockEngine:
+
+    def _cfg(self):
+        return dataflow.LockConfig(
+            declared={},
+            blocking_attrs=frozenset({"join"}),
+            blocking_dotted=frozenset({"time.sleep"}),
+            blocking_funcs=set())
+
+    def test_transitive_acquire_edge(self):
+        g = _graph({
+            "pipelinedp_tpu/m.py": (
+                "import threading\n"
+                "_lock_a = threading.Lock()\n"
+                "_lock_b = threading.Lock()\n"
+                "def inner():\n"
+                "    with _lock_b:\n"
+                "        pass\n"
+                "def f():\n"
+                "    with _lock_a:\n"
+                "        inner()\n"),
+        })
+        report = dataflow.run_locks(g, self._cfg())
+        a = ("pipelinedp_tpu/m.py", "", "_lock_a")
+        b = ("pipelinedp_tpu/m.py", "", "_lock_b")
+        assert (a, b) in report.edges
+        assert dataflow.find_lock_cycles(report.edges) == []
+
+    def test_unknown_callee_claims_no_lock_facts(self):
+        g = _graph({
+            "pipelinedp_tpu/m.py": (
+                "import threading, mystery\n"
+                "_lock = threading.Lock()\n"
+                "def f():\n"
+                "    with _lock:\n"
+                "        mystery.do_something()\n"),
+        })
+        report = dataflow.run_locks(g, self._cfg())
+        assert report.edges == {} and report.blocking == []
+
+    def test_string_join_not_blocking(self):
+        g = _graph({
+            "pipelinedp_tpu/m.py": (
+                "import threading\n"
+                "_lock = threading.Lock()\n"
+                "def f(parts):\n"
+                "    with _lock:\n"
+                "        return ','.join(parts)\n"),
+        })
+        assert dataflow.run_locks(g, self._cfg()).blocking == []
+
+    def test_find_lock_cycles_three_way(self):
+        a, b, c = ("m", "", "_lock_a"), ("m", "", "_lock_b"), \
+            ("m", "", "_lock_c")
+        edges = {(a, b): ("m", 1, "d"), (b, c): ("m", 2, "d"),
+                 (c, a): ("m", 3, "d")}
+        (cycle,) = dataflow.find_lock_cycles(edges)
+        assert set(cycle) == {a, b, c}
+
+    def test_self_loop_cycle(self):
+        a = ("m", "", "_lock_a")
+        (cycle,) = dataflow.find_lock_cycles({(a, a): ("m", 1, "d")})
+        assert cycle == [a]
