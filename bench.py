@@ -333,6 +333,78 @@ def _bench_multihost():
         return {"multihost_error": f"{type(e).__name__}: {e}"}
 
 
+def _bench_service(on_tpu):
+    """`service` receipt key: the resident multi-tenant session layer
+    driven end to end — one warm job compiles the shared entry points,
+    then 3 tenants fan 8 identical-spec jobs over one backend. Reports
+    jobs/sec and job-latency percentiles (queue wait included), the jit
+    cache misses the REUSE jobs added (0 = every tenant after the first
+    hit the warm compile cache), and whether every tenant's ledger
+    reconciles bit-exactly with its jobs' accountants."""
+    import numpy as np
+
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu.runtime import trace as rt_trace
+    from pipelinedp_tpu.service import DPAggregationService, JobSpec
+
+    try:
+        rng = np.random.default_rng(11)
+        n_rows, n_partitions = 20_000, 256
+        rows = list(zip(rng.integers(0, 2_000, n_rows).tolist(),
+                        rng.integers(0, n_partitions, n_rows).tolist(),
+                        rng.uniform(0.0, 5.0, n_rows).tolist()))
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            noise_kind=pdp.NoiseKind.LAPLACE,
+            max_partitions_contributed=4,
+            max_contributions_per_partition=8,
+            min_value=0.0, max_value=5.0)
+
+        def spec(seed):
+            return JobSpec(params=params, epsilon=1.0, delta=1e-6,
+                           noise_seed=seed)
+
+        was_traced = rt_trace.enabled()
+        rt_trace.enable()  # the jit probe behind the reuse counts
+        try:
+            with DPAggregationService(pdp.TPUBackend(),
+                                      max_concurrent_jobs=4,
+                                      queue_timeout_s=600.0) as svc:
+                # Warm job: compiles the shared entry points once.
+                svc.submit("tenant-0", spec(0), rows).result(timeout=600)
+                handles = []
+                start = time.perf_counter()
+                for j in range(8):
+                    handles.append(
+                        svc.submit(f"tenant-{j % 3}", spec(j + 1), rows))
+                for handle in handles:
+                    handle.result(timeout=600)
+                elapsed = time.perf_counter() - start
+                latencies = sorted(h.latency_s for h in handles)
+                reuse_misses = sum(h.jit_cache_misses or 0
+                                   for h in handles)
+                reconciled = svc.ledgers_reconciled()
+        finally:
+            if not was_traced:
+                rt_trace.disable()
+        return {
+            "service": {
+                "service_jobs_per_sec": round(len(handles) / elapsed, 2),
+                "service_p50_job_latency_s": round(
+                    latencies[len(latencies) // 2], 4),
+                "service_p99_job_latency_s": round(
+                    latencies[min(len(latencies) - 1,
+                                  int(len(latencies) * 0.99))], 4),
+                "service_compile_reuse_misses": reuse_misses,
+                "service_ledger_reconciled": reconciled,
+                "service_jobs": len(handles) + 1,
+                "service_tenants": 3,
+            }
+        }
+    except Exception as e:  # noqa: BLE001 - the receipt must survive service-bench breakage; tests/test_service.py owns failing on it
+        return {"service": {"error": f"{type(e).__name__}: {e}"}}
+
+
 def _bench_select_partitions(jax, on_tpu):
     """Standalone DP partition selection at P = 10^7 via the O(kept)
     blocked route (parallel/large_p.select_partitions_blocked): neither a
@@ -801,6 +873,10 @@ def main():
     # cross-host exchange volume (0 on a single-controller run). ---
     multihost_detail = _bench_multihost()
 
+    # --- Resident multi-tenant service: jobs/sec, latency percentiles,
+    # compile reuse across tenants, ledger reconciliation. ---
+    service_detail = _bench_service(on_tpu)
+
     # --- BASELINE configs 1-3 (LocalBackend ref, Gaussian+public,
     # compound combiner). ---
     baseline_detail = _bench_baseline_configs(jax, jnp, on_tpu)
@@ -932,6 +1008,7 @@ def main():
                 **select_detail,
                 **reshard_detail,
                 **multihost_detail,
+                **service_detail,
                 **baseline_detail,
                 "runtime_fault_counters": fault_counters,
                 "runtime_phase_timings": phase_timings,

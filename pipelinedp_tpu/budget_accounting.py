@@ -159,6 +159,16 @@ class BudgetAccountant(abc.ABC):
         return BudgetAccountantScope(self, weight)
 
     @property
+    def total_epsilon(self) -> float:
+        """The (eps, delta)-DP budget this ledger apportions — the
+        admission grant a multi-tenant session accounts against."""
+        return self._total_epsilon
+
+    @property
+    def total_delta(self) -> float:
+        return self._total_delta
+
+    @property
     def mechanism_count(self) -> int:
         """Number of mechanisms registered in the ledger.
 

@@ -257,6 +257,84 @@ def validate_coordinator_address(coordinator_address, obj_name: str) -> None:
             f"integer in [1, 65535].")
 
 
+def validate_max_concurrent_jobs(max_concurrent_jobs, obj_name: str) -> None:
+    """Validates the service worker-pool width: an integer >= 1.
+
+    Raises:
+        ValueError: max_concurrent_jobs is not a positive integer (it is
+        the number of jobs the resident service executes concurrently —
+        0 would admit work that no worker can ever run).
+    """
+    if (not isinstance(max_concurrent_jobs, numbers.Number) or
+            isinstance(max_concurrent_jobs, bool) or
+            max_concurrent_jobs != int(max_concurrent_jobs) or
+            max_concurrent_jobs < 1):
+        raise ValueError(
+            f"{obj_name}: max_concurrent_jobs must be an integer >= 1, "
+            f"but {max_concurrent_jobs!r} given — it sizes the service's "
+            f"worker pool; submissions beyond it queue rather than "
+            f"rejecting.")
+
+
+def validate_tenant_budget_epsilon(tenant_budget_epsilon,
+                                   obj_name: str) -> None:
+    """Validates a tenant's lifetime epsilon budget: a positive number
+    (math.inf = unlimited — the ledger still records spend).
+
+    Raises:
+        ValueError: tenant_budget_epsilon is not a positive number.
+    """
+    if (not isinstance(tenant_budget_epsilon, numbers.Number) or
+            isinstance(tenant_budget_epsilon, bool) or
+            math.isnan(tenant_budget_epsilon) or tenant_budget_epsilon <= 0):
+        raise ValueError(
+            f"{obj_name}: tenant_budget_epsilon must be a positive "
+            f"number, but {tenant_budget_epsilon!r} given — it is the "
+            f"lifetime epsilon a tenant's ledger may accumulate before "
+            f"submissions are refused (math.inf disables the cap).")
+
+
+def validate_queue_timeout_s(queue_timeout_s, obj_name: str) -> None:
+    """Validates the admission-queue wait bound: a positive finite
+    number of seconds.
+
+    Raises:
+        ValueError: queue_timeout_s is not a positive finite number (a
+        non-positive bound would shed every queued job on dequeue).
+    """
+    if (not isinstance(queue_timeout_s, numbers.Number) or
+            isinstance(queue_timeout_s, bool) or
+            math.isnan(queue_timeout_s)):
+        raise ValueError(f"{obj_name}: queue_timeout_s must be a number "
+                         f"of seconds, but {queue_timeout_s!r} given.")
+    if queue_timeout_s <= 0 or math.isinf(queue_timeout_s):
+        raise ValueError(
+            f"{obj_name}: queue_timeout_s must be positive and finite, "
+            f"but queue_timeout_s={queue_timeout_s} given — jobs that "
+            f"wait in the admission queue longer than this are shed "
+            f"with a retry-after instead of running arbitrarily late.")
+
+
+def validate_shed_watermark_fraction(shed_watermark_fraction,
+                                     obj_name: str) -> None:
+    """Validates the load-shed memory threshold: a number in (0, 1].
+
+    Raises:
+        ValueError: shed_watermark_fraction is not a number in (0, 1]
+        (it is the fraction of the device-memory limit above which the
+        service sheds new submissions instead of OOMing running jobs).
+    """
+    if (not isinstance(shed_watermark_fraction, numbers.Number) or
+            isinstance(shed_watermark_fraction, bool) or
+            math.isnan(shed_watermark_fraction) or
+            not 0 < shed_watermark_fraction <= 1):
+        raise ValueError(
+            f"{obj_name}: shed_watermark_fraction must be a number in "
+            f"(0, 1], but {shed_watermark_fraction!r} given — admissions "
+            f"are shed when the live device-memory watermark exceeds "
+            f"this fraction of the memory limit.")
+
+
 def validate_journal(journal, obj_name: str) -> None:
     """Validates a BlockJournal-shaped object: get/put record accessors.
 

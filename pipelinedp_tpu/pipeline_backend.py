@@ -564,6 +564,43 @@ class TPUBackend(LocalBackend):
     def is_tpu(self) -> bool:
         return True
 
+    def for_job(self,
+                job_id: Optional[str] = None,
+                noise_seed: Optional[int] = None,
+                journal=None) -> 'TPUBackend':
+        """A job-scoped view of this backend for concurrent multiplexing.
+
+        The multi-tenant service (pipelinedp_tpu/service/) holds ONE
+        backend/mesh for its lifetime but runs many jobs on it at once;
+        each job needs its own noise seed and job id without mutating
+        the shared backend under a concurrent sibling. The derived
+        backend shares the mesh and every data-plane/runtime knob —
+        jit-compiled entry points are cached per function + shapes +
+        static config, so identical specs submitted through different
+        for_job views hit the SAME compiled programs (the compile-cache
+        reuse the service asserts) — while job_id/noise_seed/journal
+        override per job. Metrics exporters and distributed bring-up
+        stay owned by the parent: a view never starts or stops either.
+        """
+        return TPUBackend(
+            mesh=self.mesh,
+            max_partitions=self.max_partitions,
+            noise_seed=(self.noise_seed if noise_seed is None
+                        else noise_seed),
+            secure_noise=self.secure_noise,
+            large_partition_threshold=self.large_partition_threshold,
+            reshard=self.reshard,
+            retry=self.retry,
+            journal=(self.journal if journal is None else journal),
+            job_id=(self.job_id if job_id is None else job_id),
+            block_partitions=self.block_partitions,
+            timeout_s=self.timeout_s,
+            watchdog=self.watchdog,
+            elastic=self.elastic,
+            min_devices=self.min_devices,
+            pipeline_depth=self.pipeline_depth,
+            encode_threads=self.encode_threads)
+
     def dump_trace(self, path: str, job_id: Optional[str] = None) -> str:
         """Writes the recorded trace as Chrome/Perfetto trace-event JSON
         (load in ui.perfetto.dev or chrome://tracing). With a job_id,

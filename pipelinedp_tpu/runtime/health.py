@@ -69,8 +69,14 @@ _DEGRADING_COUNTERS = frozenset({
     "mesh_degradations",
 })
 _STALLING_COUNTERS = frozenset({"block_timeouts", "watchdog_timeouts"})
+# jit_cache_misses is tracked but neutral (like journal_replays): a
+# compile is not adversity, but per-job attribution through the
+# job_scope thread-local is what lets the multi-tenant service prove
+# compile-cache REUSE — a second tenant submitting an identical spec
+# must show 0 misses on its own job record, not on a racy process-wide
+# counter delta.
 _TRACKED_COUNTERS = (_DEGRADING_COUNTERS | _STALLING_COUNTERS |
-                     frozenset({"journal_replays"}))
+                     frozenset({"journal_replays", "jit_cache_misses"}))
 
 
 def _process_index() -> int:
@@ -241,7 +247,12 @@ class JobHealth:
 _registry_lock = threading.Lock()
 _registry: Dict[str, JobHealth] = {}
 _current = threading.local()
-_GUARDED_BY = guarded_by("_registry_lock", "_registry")
+# Process-wide count of live track()/job_scope entries across ALL
+# threads (the thread-local stack only answers for its own thread):
+# telemetry.reset() consults it to refuse a process-wide epoch reset
+# while any job is mid-flight — the resident-service guard.
+_active_scopes = 0
+_GUARDED_BY = guarded_by("_registry_lock", "_registry", "_active_scopes")
 
 
 def for_job(job_id: str) -> JobHealth:
@@ -264,9 +275,17 @@ def current_or(job_id: str) -> JobHealth:
     return current() or for_job(job_id)
 
 
+def active_job_scopes() -> int:
+    """Live track()/job_scope entries across every thread right now
+    (0 = no job is being attributed anywhere in the process)."""
+    with _registry_lock:
+        return _active_scopes
+
+
 @contextlib.contextmanager
 def track(health: Optional[JobHealth]):
     """Makes `health` the thread's current job for telemetry forwarding."""
+    global _active_scopes
     if health is None:
         yield None
         return
@@ -274,10 +293,14 @@ def track(health: Optional[JobHealth]):
     if stack is None:
         stack = _current.stack = []
     stack.append(health)
+    with _registry_lock:
+        _active_scopes += 1
     try:
         yield health
     finally:
         stack.pop()
+        with _registry_lock:
+            _active_scopes -= 1
 
 
 @contextlib.contextmanager

@@ -564,38 +564,42 @@ def odometer_report(accountant=None,
     return report
 
 
-def persist_odometer(journal, job_id: str) -> None:
-    """Writes the full ordered audit trail through the BlockJournal
+def persist_odometer(journal, job_id: str,
+                     records: Optional[List[Dict[str, Any]]] = None) -> None:
+    """Writes an ordered audit trail through the BlockJournal
     (key ``__odometer__``): CRC-verified, fsync-then-rename, scoped to
     the journal's controller process — the same durability and
     (job_id, process_index) isolation block results get. Called by
     runtime/entry.py at driver teardown when a journal is configured;
-    idempotent (the trail only grows, and a re-write supersedes)."""
+    idempotent (the trail only grows, and a re-write supersedes).
+
+    By default the process's full in-memory trail is written; pass
+    ``records`` (ordered dicts in the ``OdometerRecord.to_dict`` /
+    ``load_odometer`` shape) to persist an explicit trail instead —
+    the multi-tenant service's TenantLedger does, so one tenant's
+    ledger of record never absorbs a co-resident tenant's records."""
     from pipelinedp_tpu.runtime.journal import BlockRecord
 
-    records = _records_snapshot()
-    n = len(records)
+    rows = (records if records is not None else
+            [r.to_dict() for r in _records_snapshot()])
+    n = len(rows)
+
+    def _col(key, none_value=None):
+        return [none_value if r.get(key) is None else r[key] for r in rows]
+
     record = BlockRecord(
-        ids=np.asarray([r.seq for r in records], dtype=np.int64),
+        ids=np.asarray(_col("seq"), dtype=np.int64),
         outputs={
-            "eps": np.asarray(
-                [np.nan if r.eps is None else r.eps for r in records],
-                dtype=np.float64),
-            "delta": np.asarray(
-                [np.nan if r.delta is None else r.delta for r in records],
-                dtype=np.float64),
-            "weight": np.asarray([r.weight for r in records], np.float64),
-            "sensitivity": np.asarray([r.sensitivity for r in records],
-                                      np.float64),
-            "count": np.asarray([r.count for r in records], np.int64),
-            "process_index": np.asarray(
-                [r.process_index for r in records], np.int32),
-            "job_id": np.asarray([r.job_id or "" for r in records],
-                                 dtype=np.str_),
-            "metric": np.asarray([r.metric or "" for r in records],
-                                 dtype=np.str_),
-            "mechanism_kind": np.asarray(
-                [r.mechanism_kind for r in records], dtype=np.str_),
+            "eps": np.asarray(_col("eps", np.nan), dtype=np.float64),
+            "delta": np.asarray(_col("delta", np.nan), dtype=np.float64),
+            "weight": np.asarray(_col("weight"), np.float64),
+            "sensitivity": np.asarray(_col("sensitivity"), np.float64),
+            "count": np.asarray(_col("count"), np.int64),
+            "process_index": np.asarray(_col("process_index"), np.int32),
+            "job_id": np.asarray(_col("job_id", ""), dtype=np.str_),
+            "metric": np.asarray(_col("metric", ""), dtype=np.str_),
+            "mechanism_kind": np.asarray(_col("mechanism_kind", ""),
+                                         dtype=np.str_),
         } if n else {})
     journal.put(job_id, ODOMETER_KEY, record)
 

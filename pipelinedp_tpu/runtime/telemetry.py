@@ -35,6 +35,7 @@ causal order between the spans they interrupted.
 """
 
 import collections
+import logging
 import threading
 from typing import Any, Dict
 
@@ -115,6 +116,20 @@ REGISTRY: Dict[str, Metric] = {
         _counter("trace_dropped_events",
                  "trace events dropped because the bounded trace buffer "
                  "was full (trace_summary flags the epoch as truncated)"),
+        _counter("service_jobs_admitted",
+                 "jobs a DPAggregationService worker picked up and "
+                 "started executing (admission passed, queue wait over)"),
+        _counter("service_jobs_queued",
+                 "jobs accepted by DPAggregationService.submit into the "
+                 "admission queue (every admitted job passes through it; "
+                 "admitted + shed + still-queued partitions this count)"),
+        _counter("service_jobs_shed",
+                 "service submissions refused by load shedding: the "
+                 "device-memory watermark crossed the shed fraction at "
+                 "submit, or a queued job outlived queue_timeout_s "
+                 "(typed AdmissionRejectedError with retry-after; "
+                 "tenant-budget refusals are NOT sheds and raise "
+                 "TenantBudgetExceededError uncounted here)"),
         _gauge("pipeline_queue_depth",
                "encoded chunks currently staged between the host encode "
                "pool and the device accumulator (bounded by "
@@ -136,6 +151,12 @@ REGISTRY: Dict[str, Metric] = {
         _gauge("device_memory_peak_bytes",
                "peak device-memory watermark observed this epoch (same "
                "sources as device_memory_live_bytes)"),
+        _gauge("service_active_jobs",
+               "jobs currently executing on the DPAggregationService "
+               "worker pool (bounded by max_concurrent_jobs)"),
+        _gauge("service_queue_depth",
+               "jobs waiting in the service admission queue (admitted "
+               "but not yet picked up by a worker)"),
     )
 }
 
@@ -327,13 +348,33 @@ def delta(before: Dict[str, int]) -> Dict[str, int]:
     return {k: v for k, v in out.items() if v}
 
 
-def reset() -> None:
+def reset(force: bool = False) -> None:
     """Coordinated epoch reset: counters, gauges, timings, job timings,
     trace buffers, per-job health states, memory watermarks AND the
     budget odometer clear together, so test isolation and long-running
     processes can never mix epochs (a counter from one epoch attributed
     to another job's health, or a stale trace buffer leaking into the
-    next run's export)."""
+    next run's export).
+
+    Guarded under a resident service: resetting while any job_scope is
+    active on some thread would wipe a LIVE job's health record,
+    counters and odometer records out from under it — mid-run scrapes
+    would report a healthy empty epoch and the job's ledger records
+    would vanish before its teardown persisted them. With active scopes
+    the reset therefore warns and no-ops; pass force=True to reset
+    anyway (the concurrency-safety stress test does, deliberately)."""
+    # Lazy import (health imports telemetry at module load).
+    from pipelinedp_tpu.runtime import health as _health
+    if not force:
+        active = _health.active_job_scopes()
+        if active:
+            logging.warning(
+                "telemetry.reset(): %d job_scope(s) are active — a "
+                "process-wide epoch reset would corrupt live jobs' "
+                "health/odometer state, so the reset is skipped. Wait "
+                "for the jobs to finish (or pass force=True if you "
+                "really mean it).", active)
+            return
     with _lock:
         counters.clear()
         _timings.clear()

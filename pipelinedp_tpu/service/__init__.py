@@ -1,0 +1,41 @@
+"""Multi-tenant DP-aggregation service: the resident session layer.
+
+Turns the batch runtime into a long-running backend multiplexing many
+concurrent tenants over one device set:
+
+  * DPAggregationService — one TPUBackend/mesh for the service's
+    lifetime; submit(tenant_id, spec, source) -> JobHandle runs jobs on
+    a bounded worker pool, each under its own job_scope, with
+    cross-tenant compile-cache reuse for identical kernel specs.
+  * TenantLedger — persisted per-tenant budget ledgers (the odometer
+    records of PR 10 as the ledger of record, journal-durable across
+    service restarts); admission refuses jobs whose epsilon exceeds the
+    tenant's lifetime budget before any mechanism registers.
+  * Admission control — priority FIFO up to max_concurrent_jobs,
+    queueing beyond, load shedding by the device-memory watermark and
+    the queue wait bound (typed AdmissionRejectedError + retry-after).
+
+See README "Service mode" and examples/service_demo.py.
+"""
+
+from pipelinedp_tpu.service.errors import (
+    AdmissionRejectedError,
+    TenantBudgetExceededError,
+)
+from pipelinedp_tpu.service.ledger import TenantLedger
+from pipelinedp_tpu.service.service import (
+    DPAggregationService,
+    JobHandle,
+    JobSpec,
+    JobStatus,
+)
+
+__all__ = [
+    "AdmissionRejectedError",
+    "DPAggregationService",
+    "JobHandle",
+    "JobSpec",
+    "JobStatus",
+    "TenantBudgetExceededError",
+    "TenantLedger",
+]

@@ -1,0 +1,226 @@
+"""Per-tenant privacy-budget ledgers — the persisted odometer records
+(runtime/observability.py) promoted to the ledger of record.
+
+A batch run's accountant dies with its process; a resident service
+multiplexing many tenants needs each tenant's CUMULATIVE spend to
+outlive every job, every accountant and every service restart. The
+TenantLedger keeps exactly the odometer's per-mechanism record shape
+(seq, job, metric, mechanism kind, weight/sensitivity, eps/delta
+share, process provenance) and persists the trail through the same
+CRC-verified BlockJournal machinery (key ``__odometer__``, fsync-then-
+rename), keyed by the tenant id — so an auditor reads one store for
+both block results and budget provenance, and a restarted service
+reloads the trail through the same integrity checks a block replay
+gets.
+
+Accounting discipline (two-phase, mirroring the admission flow):
+
+  * ``reserve(job_id, epsilon)`` — the admission grant. Refused with
+    TenantBudgetExceededError when recorded spend + in-flight
+    reservations + the request would exceed the lifetime budget; the
+    refusal happens BEFORE any accountant or mechanism exists, so a
+    rejected job provably spends nothing.
+  * ``charge(job_id, records)`` — job completion converts the
+    reservation into per-mechanism ledger records (the job's odometer
+    trail, eps shares resolved by compute_budgets). Per job, the
+    ledger's eps sum reproduces ``BudgetAccountant.spent_epsilon()``
+    BIT-EXACTLY: records append in registration order and fold with
+    the same left-to-right float64 sum the accountant uses, and the
+    npz round-trip stores float64 exactly.
+  * ``charge_forfeit(job_id, epsilon)`` — a job that failed AFTER
+    registering mechanisms may have released noised values already;
+    the full admission grant is conservatively charged as one
+    synthetic record (over-counting is privacy-safe; under-counting
+    never is). A job that failed before any registration releases its
+    reservation instead.
+"""
+
+import threading
+from typing import Any, Dict, List, Optional
+
+from pipelinedp_tpu import input_validators
+from pipelinedp_tpu.runtime import observability
+from pipelinedp_tpu.runtime.concurrency import guarded_by
+from pipelinedp_tpu.service.errors import TenantBudgetExceededError
+
+
+class TenantLedger:
+    """One tenant's lifetime budget ledger (thread-safe; shared by the
+    service's concurrent workers)."""
+
+    # Workers reserve/charge concurrently while submit() reads
+    # remaining budget; persistence runs OUTSIDE the lock (journal.put
+    # fsyncs) with a version re-check loop for write ordering.
+    _GUARDED_BY = guarded_by("_lock", "_records", "_reserved", "_version")
+
+    def __init__(self, tenant_id: str, lifetime_epsilon: float, journal):
+        input_validators.validate_job_id(tenant_id, "TenantLedger")
+        input_validators.validate_tenant_budget_epsilon(
+            lifetime_epsilon, "TenantLedger")
+        self.tenant_id = tenant_id
+        self.lifetime_epsilon = float(lifetime_epsilon)
+        self._journal = journal
+        self._lock = threading.Lock()
+        self._reserved: Dict[str, float] = {}
+        # The ledger of record, reloaded through the CRC-verified
+        # journal read path: a trail this process (or a predecessor)
+        # persisted survives restarts; a corrupt trail quarantines like
+        # any journal record and the tenant starts from what verifies.
+        self._records: List[Dict[str, Any]] = list(
+            observability.load_odometer(journal, tenant_id))
+        self._version = 0
+
+    # -- queries ---------------------------------------------------------
+
+    @staticmethod
+    def _job_sums(records: List[Dict[str, Any]]) -> Dict[str, float]:
+        """Per-job eps sums, each folded in record order — the same
+        left-to-right sum BudgetAccountant.spent_epsilon() computes, so
+        a job's ledger spend reproduces its accountant bit-exactly."""
+        sums: Dict[str, float] = {}
+        for r in records:
+            if r.get("eps") is None:
+                continue
+            job = r.get("job_id") or ""
+            sums[job] = sums.get(job, 0.0) + r["eps"] * r.get("count", 1)
+        return sums
+
+    def spent_epsilon(self) -> float:
+        """Cumulative recorded spend: the sum of per-job spends (each
+        bit-exact vs its accountant), in first-recorded job order."""
+        with self._lock:
+            records = list(self._records)
+        return sum(self._job_sums(records).values())
+
+    def job_spent_epsilon(self, job_id: str) -> float:
+        """One job's recorded spend (0.0 when the job never charged)."""
+        with self._lock:
+            records = list(self._records)
+        return self._job_sums(records).get(job_id, 0.0)
+
+    def reserved_epsilon(self) -> float:
+        with self._lock:
+            return sum(self._reserved.values())
+
+    def remaining_epsilon(self) -> float:
+        """Lifetime budget minus recorded spend minus in-flight
+        reservations (never below 0)."""
+        with self._lock:
+            records = list(self._records)
+            reserved = sum(self._reserved.values())
+        spent = sum(self._job_sums(records).values())
+        return max(self.lifetime_epsilon - spent - reserved, 0.0)
+
+    def records(self) -> List[Dict[str, Any]]:
+        """The ordered ledger trail (copies)."""
+        with self._lock:
+            return [dict(r) for r in self._records]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            records = list(self._records)
+            reserved = dict(self._reserved)
+        sums = self._job_sums(records)
+        spent = sum(sums.values())
+        return {
+            "tenant_id": self.tenant_id,
+            "lifetime_epsilon": self.lifetime_epsilon,
+            "spent_epsilon": spent,
+            "reserved_epsilon": sum(reserved.values()),
+            "remaining_epsilon": max(
+                self.lifetime_epsilon - spent - sum(reserved.values()),
+                0.0),
+            "jobs": sums,
+            "mechanisms": len(records),
+        }
+
+    def reconciles(self, job_id: str, accountant) -> bool:
+        """True iff the job's ledger spend equals the accountant's
+        apportioned epsilon bit-exactly (the acceptance bar: the ledger
+        of record IS the accountant's trail, not an approximation)."""
+        return self.job_spent_epsilon(job_id) == accountant.spent_epsilon()
+
+    # -- admission lifecycle ---------------------------------------------
+
+    def reserve(self, job_id: str, epsilon: float) -> None:
+        """Admission grant: reserves `epsilon` against the lifetime
+        budget, or raises TenantBudgetExceededError — before any
+        accountant or mechanism exists for the job."""
+        epsilon = float(epsilon)
+        with self._lock:
+            records = list(self._records)
+            reserved = sum(self._reserved.values())
+            spent = sum(self._job_sums(records).values())
+            if spent + reserved + epsilon > self.lifetime_epsilon:
+                raise TenantBudgetExceededError(
+                    f"tenant {self.tenant_id!r}: requested epsilon "
+                    f"{epsilon} exceeds the remaining lifetime budget "
+                    f"(lifetime {self.lifetime_epsilon}, recorded spend "
+                    f"{spent}, in-flight reservations {reserved}). The "
+                    f"job was refused before any mechanism registered; "
+                    f"nothing was spent.")
+            self._reserved[job_id] = epsilon
+
+    def release(self, job_id: str) -> None:
+        """Drops a reservation without charging (job shed before it
+        ran, or failed before any mechanism registered)."""
+        with self._lock:
+            self._reserved.pop(job_id, None)
+
+    def charge(self, job_id: str,
+               records: List[Dict[str, Any]]) -> float:
+        """Converts the reservation into ledger records (the job's
+        ordered odometer trail) and persists the full trail. Returns
+        the job's recorded spend."""
+        stamped = []
+        for r in records:
+            row = dict(r)
+            row["job_id"] = job_id
+            stamped.append(row)
+        with self._lock:
+            self._reserved.pop(job_id, None)
+            base = len(self._records)
+            for i, row in enumerate(stamped):
+                row["seq"] = base + i
+            self._records.extend(stamped)
+            self._version += 1
+        self._persist_latest()
+        return self.job_spent_epsilon(job_id)
+
+    def charge_forfeit(self, job_id: str, epsilon: float,
+                       reason: str = "job_failed") -> None:
+        """Charges the FULL admission grant of a failed job that had
+        already registered mechanisms (its releases may have left the
+        process; under-counting is never privacy-safe)."""
+        from pipelinedp_tpu.runtime import health as rt_health
+        self.charge(job_id, [{
+            "seq": 0,
+            "job_id": job_id,
+            "metric": "admission_grant_forfeit",
+            "mechanism_kind": reason,
+            "weight": 1.0,
+            "sensitivity": 0.0,
+            "count": 1,
+            "process_index": rt_health._process_index(),
+            "eps": float(epsilon),
+            "delta": 0.0,
+        }])
+
+    # -- persistence -----------------------------------------------------
+
+    def _persist_latest(self) -> None:
+        """Persists the trail through the journal, OUTSIDE the lock
+        (journal.put fsyncs — a blocking write must never run under a
+        lock workers contend on). Two concurrent charges could persist
+        out of order, so the version re-check loops until the trail
+        this thread wrote is the newest — the last write always carries
+        every record."""
+        while True:
+            with self._lock:
+                version = self._version
+                trail = [dict(r) for r in self._records]
+            observability.persist_odometer(self._journal, self.tenant_id,
+                                           records=trail)
+            with self._lock:
+                if self._version == version:
+                    return

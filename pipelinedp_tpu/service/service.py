@@ -1,0 +1,625 @@
+"""Resident multi-tenant DP-aggregation service.
+
+The batch runtime answers one call; "millions of users" means a
+long-running backend multiplexing many tenants over one device set.
+DPAggregationService is that session layer, built from parts that
+already exist:
+
+  * **One backend, many jobs.** The service holds ONE TPUBackend (and
+    its mesh) for its lifetime. Each submitted job runs on a bounded
+    worker pool under its own job-scoped view
+    (``TPUBackend.for_job``): per-job noise seed and job id, shared
+    mesh and data-plane knobs — and, because jit entry points cache by
+    function + shapes + static config, identical specs submitted by
+    DIFFERENT tenants hit the same compiled programs (asserted: the
+    second identical-spec submission records 0 jit cache misses on its
+    own job health record).
+  * **Job isolation for free.** Every job executes inside its own
+    ``health.job_scope(job_id)`` on its worker thread: counters,
+    durations, gauges, odometer records and trace events attribute to
+    the job exactly as they do in batch mode — the service only makes
+    them concurrent.
+  * **Tenant ledgers of record.** Per-tenant cumulative spend lives in
+    a TenantLedger persisted through the CRC-verified BlockJournal
+    (the PR 10 odometer records ARE the ledger rows). submit() loads
+    the tenant's recorded spend, reserves the requested epsilon, and
+    refuses over-budget jobs with TenantBudgetExceededError BEFORE any
+    accountant or mechanism exists. Execution runs under
+    ``no_new_mechanisms`` at the session boundary, so a running job
+    can never spend past its admission grant.
+  * **Admission control.** A priority FIFO admits up to
+    ``max_concurrent_jobs`` concurrently and queues the rest; a queued
+    job that outlives ``queue_timeout_s`` is shed, and submissions are
+    shed up front when the live device-memory watermark (PR 10 gauges)
+    crosses ``shed_watermark_fraction`` of the memory limit — a typed
+    AdmissionRejectedError with a retry-after instead of an OOM that
+    would take running jobs down with it.
+
+Declared service metrics: ``service_jobs_admitted`` /
+``service_jobs_queued`` / ``service_jobs_shed`` counters and
+``service_active_jobs`` / ``service_queue_depth`` gauges — scrapeable
+live through the backend's Prometheus exporters like every other
+declared metric.
+"""
+
+import dataclasses
+import hashlib
+import logging
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from pipelinedp_tpu import aggregate_params as agg_params
+from pipelinedp_tpu import budget_accounting
+from pipelinedp_tpu import dp_engine
+from pipelinedp_tpu import input_validators
+from pipelinedp_tpu import pipeline_backend
+from pipelinedp_tpu.data_extractors import DataExtractors
+from pipelinedp_tpu.runtime import health as rt_health
+from pipelinedp_tpu.runtime import observability as rt_observability
+from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+from pipelinedp_tpu.runtime.concurrency import guarded_by
+from pipelinedp_tpu.runtime.journal import BlockJournal
+from pipelinedp_tpu.service.errors import AdmissionRejectedError
+from pipelinedp_tpu.service.ledger import TenantLedger
+
+
+def _tuple_extractors() -> DataExtractors:
+    """Default extractors for (privacy_id, partition_key, value) rows —
+    the columnar/streamed entries never consult them."""
+    return DataExtractors(privacy_id_extractor=lambda r: r[0],
+                          partition_extractor=lambda r: r[1],
+                          value_extractor=lambda r: r[2])
+
+
+@dataclasses.dataclass
+class JobSpec:
+    """One submission's aggregation request + privacy grant.
+
+    params is an AggregateParams (DP aggregation) or a
+    SelectPartitionsParams (standalone DP partition selection).
+    epsilon/delta are the job's FULL budget — the admission grant the
+    tenant ledger reserves; the job's accountant is constructed with
+    exactly this budget, so the grant is also the hard spend ceiling.
+    noise_seed pins the job's base PRNG key (None = fresh
+    nondeterministic); priority orders the admission queue (LOWER
+    values run first, >= 0; FIFO within a priority).
+    """
+    params: Any
+    epsilon: float
+    delta: float = 0.0
+    data_extractors: Optional[DataExtractors] = None
+    public_partitions: Any = None
+    noise_seed: Optional[int] = None
+    priority: int = 0
+
+    @property
+    def is_select_partitions(self) -> bool:
+        return isinstance(self.params, agg_params.SelectPartitionsParams)
+
+    @property
+    def cache_key(self) -> str:
+        """Digest of the kernel-relevant spec: jobs sharing it compile
+        the same entry points (given same-bucket data shapes), which is
+        what the per-spec compile-reuse stats group by."""
+        payload = repr((type(self.params).__name__, self.params,
+                        self.public_partitions is not None))
+        return hashlib.sha1(payload.encode()).hexdigest()[:12]
+
+
+class JobStatus:
+    QUEUED = "QUEUED"
+    RUNNING = "RUNNING"
+    DONE = "DONE"
+    FAILED = "FAILED"
+    SHED = "SHED"
+
+
+class JobHandle:
+    """Future-like handle of one submitted job."""
+
+    _GUARDED_BY = guarded_by("_lock", "_status", "_result", "_error",
+                             "_spent_epsilon", "_jit_cache_misses",
+                             "_started_at", "_finished_at")
+
+    def __init__(self, job_id: str, tenant_id: str, spec: JobSpec):
+        self.job_id = job_id
+        self.tenant_id = tenant_id
+        self.spec = spec
+        self._lock = threading.Lock()
+        self._done = threading.Event()
+        self._status = JobStatus.QUEUED
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+        self._spent_epsilon: Optional[float] = None
+        self._jit_cache_misses: Optional[int] = None
+        self._queued_at = time.monotonic()
+        self._started_at: Optional[float] = None
+        self._finished_at: Optional[float] = None
+
+    # -- worker-side transitions ----------------------------------------
+
+    def _set_running(self) -> None:
+        with self._lock:
+            self._status = JobStatus.RUNNING
+            self._started_at = time.monotonic()
+
+    def _complete(self, result: Any, spent_epsilon: float,
+                  jit_cache_misses: int) -> None:
+        with self._lock:
+            self._status = JobStatus.DONE
+            self._result = result
+            self._spent_epsilon = spent_epsilon
+            self._jit_cache_misses = jit_cache_misses
+            self._finished_at = time.monotonic()
+        self._done.set()
+
+    def _fail(self, error: BaseException, shed: bool = False) -> None:
+        with self._lock:
+            self._status = JobStatus.SHED if shed else JobStatus.FAILED
+            self._error = error
+            self._finished_at = time.monotonic()
+        self._done.set()
+
+    # -- caller-side queries ---------------------------------------------
+
+    @property
+    def status(self) -> str:
+        with self._lock:
+            return self._status
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        """The job's released DP result; re-raises the job's failure
+        (including AdmissionRejectedError for queue-timeout sheds)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"job {self.job_id!r} did not finish within {timeout}s "
+                f"(status {self.status})")
+        with self._lock:
+            if self._error is not None:
+                raise self._error
+            return self._result
+
+    def exception(self,
+                  timeout: Optional[float] = None
+                  ) -> Optional[BaseException]:
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"job {self.job_id!r} still "
+                               f"{self.status} after {timeout}s")
+        with self._lock:
+            return self._error
+
+    @property
+    def spent_epsilon(self) -> Optional[float]:
+        """The completed job's accountant spend (None until DONE) —
+        bit-exactly what the tenant ledger recorded for this job."""
+        with self._lock:
+            return self._spent_epsilon
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        """Submit-to-finish wall seconds (queue wait included; None
+        while the job is still queued or running)."""
+        with self._lock:
+            if self._finished_at is None:
+                return None
+            return self._finished_at - self._queued_at
+
+    @property
+    def jit_cache_misses(self) -> Optional[int]:
+        """Compiles attributed to THIS job's health record (None until
+        DONE; requires tracing — runtime/trace.probe_jit only counts
+        with trace enabled). 0 on an identical-spec resubmission is the
+        compile-cache-reuse proof."""
+        with self._lock:
+            return self._jit_cache_misses
+
+
+@dataclasses.dataclass
+class _Job:
+    """Internal queue entry."""
+    job_id: str
+    tenant_id: str
+    spec: JobSpec
+    source: Any
+    ledger: TenantLedger
+    handle: JobHandle
+    enqueued_at: float
+
+
+# Sentinel priority: strictly below every job (user priorities clamp to
+# >= 0), so stop() preempts queued work and workers exit immediately.
+_STOP_PRIORITY = -1
+
+
+class DPAggregationService:
+    """See module docstring.
+
+    Args:
+        backend: the TPUBackend (and mesh) the service owns for its
+            lifetime. Per-job views derive from it (``for_job``); its
+            metrics exporters/trace knobs serve the whole service.
+        ledger_dir: directory for the tenant ledgers of record
+            (BlockJournal-persisted odometer trails, one per tenant —
+            reloaded on service restart). None keeps ledgers in memory
+            only (tests; no restart durability).
+        max_concurrent_jobs: worker-pool width — jobs beyond it queue.
+        tenant_budget_epsilon: every tenant's lifetime epsilon budget
+            (math.inf disables the cap; the ledger still records).
+        queue_timeout_s: a job that waits in the admission queue longer
+            than this is shed with a retry-after instead of running
+            arbitrarily late (also the default retry-after for
+            watermark sheds).
+        shed_watermark_fraction: submissions are shed while the live
+            device-memory watermark exceeds this fraction of the
+            memory limit.
+        memory_limit_bytes: the shed check's denominator. None reads
+            the platform's per-device ``bytes_limit`` where available
+            (TPU/GPU) and disables the check where not (CPU without an
+            explicit limit).
+    """
+
+    _GUARDED_BY = guarded_by("_lock", "_ledgers", "_handles", "_seq",
+                             "_active_jobs", "_stopped", "_spec_stats")
+
+    def __init__(self,
+                 backend: pipeline_backend.TPUBackend,
+                 ledger_dir: Optional[str] = None,
+                 *,
+                 max_concurrent_jobs: int = 2,
+                 tenant_budget_epsilon: float = float("inf"),
+                 queue_timeout_s: float = 30.0,
+                 shed_watermark_fraction: float = 0.9,
+                 memory_limit_bytes: Optional[int] = None):
+        if not isinstance(backend, pipeline_backend.TPUBackend):
+            raise ValueError(
+                f"DPAggregationService: backend must be a TPUBackend "
+                f"(the service owns one device set for its lifetime), "
+                f"but {type(backend).__name__} given.")
+        input_validators.validate_max_concurrent_jobs(
+            max_concurrent_jobs, "DPAggregationService")
+        input_validators.validate_tenant_budget_epsilon(
+            tenant_budget_epsilon, "DPAggregationService")
+        input_validators.validate_queue_timeout_s(
+            queue_timeout_s, "DPAggregationService")
+        input_validators.validate_shed_watermark_fraction(
+            shed_watermark_fraction, "DPAggregationService")
+        self._backend = backend
+        self._ledger_journal = BlockJournal(ledger_dir)
+        self._ledger_dir = ledger_dir
+        self._max_concurrent_jobs = int(max_concurrent_jobs)
+        self._tenant_budget_epsilon = float(tenant_budget_epsilon)
+        self._queue_timeout_s = float(queue_timeout_s)
+        self._shed_watermark_fraction = float(shed_watermark_fraction)
+        self._memory_limit_bytes = (None if memory_limit_bytes is None
+                                    else int(memory_limit_bytes))
+        self._lock = threading.Lock()
+        self._ledgers: Dict[str, TenantLedger] = {}
+        self._handles: List[JobHandle] = []
+        self._seq = 0
+        self._active_jobs = 0
+        self._stopped = False
+        # spec cache_key -> {"jobs": n, "jit_cache_misses": m}: the
+        # cross-tenant compile-reuse evidence (bench receipt key).
+        self._spec_stats: Dict[str, Dict[str, int]] = {}
+        self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
+        self._workers = [
+            threading.Thread(target=self._worker_loop,
+                             name=f"dp-service-worker-{i}", daemon=True)
+            for i in range(self._max_concurrent_jobs)
+        ]
+        for worker in self._workers:
+            worker.start()
+
+    # -- lifecycle -------------------------------------------------------
+
+    def __enter__(self) -> "DPAggregationService":
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> None:
+        self.stop()
+
+    def stop(self, timeout_s: float = 30.0) -> None:
+        """Stops the worker pool. Running jobs finish; queued jobs that
+        never ran fail with AdmissionRejectedError and release their
+        ledger reservations."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        for _ in self._workers:
+            with self._lock:
+                self._seq += 1
+                seq = self._seq
+            self._queue.put((_STOP_PRIORITY, seq, None))
+        for worker in self._workers:
+            worker.join(timeout=timeout_s)
+        # Workers exited on the preempting sentinels; drain what queued
+        # behind them.
+        while True:
+            try:
+                _, _, job = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if job is None:
+                continue
+            job.ledger.release(job.job_id)
+            job.handle._fail(
+                AdmissionRejectedError(
+                    f"job {job.job_id!r} cancelled: service stopped "
+                    f"before a worker picked it up"))
+        self._set_queue_depth()
+
+    # -- tenant ledgers --------------------------------------------------
+
+    def tenant_ledger(self, tenant_id: str) -> TenantLedger:
+        """The tenant's ledger, loaded from the ledger journal on first
+        use (which is how recorded spend survives a service restart)."""
+        with self._lock:
+            ledger = self._ledgers.get(tenant_id)
+        if ledger is not None:
+            return ledger
+        # Construct outside the lock (the reload reads journal files);
+        # a concurrent first-use race is settled by setdefault.
+        ledger = TenantLedger(tenant_id, self._tenant_budget_epsilon,
+                              self._ledger_journal)
+        with self._lock:
+            return self._ledgers.setdefault(tenant_id, ledger)
+
+    def ledgers(self) -> Dict[str, Dict[str, Any]]:
+        """{tenant_id: ledger snapshot} for every tenant seen."""
+        with self._lock:
+            ledgers = dict(self._ledgers)
+        return {tid: led.snapshot() for tid, led in ledgers.items()}
+
+    def ledgers_reconciled(self) -> bool:
+        """True iff every completed job's ledger spend equals its
+        accountant's spent epsilon bit-exactly (the acceptance bar for
+        the ledger being the ledger OF RECORD)."""
+        with self._lock:
+            handles = list(self._handles)
+        for handle in handles:
+            if handle.status != JobStatus.DONE:
+                continue
+            ledger = self.tenant_ledger(handle.tenant_id)
+            if ledger.job_spent_epsilon(
+                    handle.job_id) != handle.spent_epsilon:
+                return False
+        return True
+
+    # -- admission -------------------------------------------------------
+
+    def submit(self, tenant_id: str, spec: JobSpec,
+               source: Any) -> JobHandle:
+        """Admits one job for a tenant, or raises.
+
+        Raises AdmissionRejectedError (with retry_after_s) when the
+        memory watermark sheds the submission, TenantBudgetExceededError
+        when the tenant's lifetime budget cannot cover spec.epsilon —
+        both BEFORE any accountant or mechanism exists for the job.
+        """
+        input_validators.validate_job_id(tenant_id,
+                                         "DPAggregationService.submit")
+        if not isinstance(spec, JobSpec):
+            raise ValueError(
+                f"DPAggregationService.submit: spec must be a JobSpec, "
+                f"but {type(spec).__name__} given.")
+        input_validators.validate_epsilon_delta(spec.epsilon, spec.delta,
+                                                "JobSpec")
+        with self._lock:
+            stopped = self._stopped
+        if stopped:
+            raise RuntimeError(
+                "DPAggregationService.submit: the service is stopped.")
+        self._shed_check()
+        ledger = self.tenant_ledger(tenant_id)
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+        job_id = f"{tenant_id}--j{seq:05d}"
+        # The admission grant: raises TenantBudgetExceededError while
+        # the job still consists of nothing but this reservation.
+        ledger.reserve(job_id, spec.epsilon)
+        handle = JobHandle(job_id, tenant_id, spec)
+        job = _Job(job_id=job_id, tenant_id=tenant_id, spec=spec,
+                   source=source, ledger=ledger, handle=handle,
+                   enqueued_at=time.monotonic())
+        with self._lock:
+            self._handles.append(handle)
+        rt_telemetry.record("service_jobs_queued")
+        self._queue.put((max(int(spec.priority), 0), seq, job))
+        self._set_queue_depth()
+        return handle
+
+    def _shed_check(self) -> None:
+        """Load shedding by memory watermark: refuse new work while the
+        device set is nearly full instead of OOMing the jobs already on
+        it. The watermark comes from the PR 10 gauges (platform memory
+        stats where available, the byte accountant elsewhere)."""
+        limit = self._memory_limit_bytes
+        if limit is None:
+            limit = _device_bytes_limit()
+        if not limit:
+            return
+        wm = rt_observability.memory_watermark()
+        threshold = self._shed_watermark_fraction * limit
+        if wm["live_bytes"] > threshold:
+            rt_telemetry.record("service_jobs_shed")
+            raise AdmissionRejectedError(
+                f"DPAggregationService: submission shed — live device "
+                f"memory {wm['live_bytes']}B (source "
+                f"{wm['source']!r}) exceeds "
+                f"{self._shed_watermark_fraction:.0%} of the "
+                f"{limit}B limit; retry after "
+                f"{self._queue_timeout_s}s.",
+                retry_after_s=self._queue_timeout_s)
+
+    # -- execution -------------------------------------------------------
+
+    def _set_queue_depth(self) -> None:
+        rt_telemetry.set_gauge("service_queue_depth",
+                               self._queue.qsize(), job_id=None)
+
+    def _worker_loop(self) -> None:
+        while True:
+            _, _, job = self._queue.get()
+            self._set_queue_depth()
+            if job is None:
+                return
+            waited = time.monotonic() - job.enqueued_at
+            if waited > self._queue_timeout_s:
+                # Shed on dequeue: the job outlived its queue bound, so
+                # running it now would be arbitrarily late — the caller
+                # gets a typed retry-after and the reservation returns
+                # to the tenant's budget.
+                rt_telemetry.record("service_jobs_shed")
+                job.ledger.release(job.job_id)
+                job.handle._fail(
+                    AdmissionRejectedError(
+                        f"job {job.job_id!r} shed: waited "
+                        f"{waited:.1f}s in the admission queue "
+                        f"(queue_timeout_s={self._queue_timeout_s}); "
+                        f"retry after {self._queue_timeout_s}s.",
+                        retry_after_s=self._queue_timeout_s),
+                    shed=True)
+                continue
+            rt_telemetry.record("service_jobs_admitted")
+            with self._lock:
+                self._active_jobs += 1
+                active = self._active_jobs
+            rt_telemetry.set_gauge("service_active_jobs", active,
+                                   job_id=None)
+            job.handle._set_running()
+            try:
+                self._run_job(job)
+            finally:
+                with self._lock:
+                    self._active_jobs -= 1
+                    active = self._active_jobs
+                rt_telemetry.set_gauge("service_active_jobs", active,
+                                       job_id=None)
+
+    def _run_job(self, job: _Job) -> None:
+        """Runs one admitted job on this worker thread, inside its own
+        job_scope, with its own accountant and backend view; converts
+        the admission reservation into ledger records (or releases /
+        forfeits it on failure)."""
+        spec = job.spec
+        accountant = budget_accounting.NaiveBudgetAccountant(
+            total_epsilon=spec.epsilon, total_delta=spec.delta)
+        backend = self._backend.for_job(job_id=job.job_id,
+                                        noise_seed=spec.noise_seed)
+        engine = dp_engine.DPEngine(accountant, backend)
+        extractors = spec.data_extractors or _tuple_extractors()
+        try:
+            with rt_health.job_scope(job.job_id):
+                if spec.is_select_partitions:
+                    lazy = engine.select_partitions(job.source, spec.params,
+                                                    extractors)
+                else:
+                    lazy = engine.aggregate(job.source, spec.params,
+                                            extractors,
+                                            spec.public_partitions)
+                accountant.compute_budgets()
+                # The session boundary: every mechanism registered at
+                # graph build, the budget is final — device execution
+                # (and any retry/replay inside it) must not grow the
+                # ledger, or the job would spend past its admission
+                # grant.
+                with accountant.no_new_mechanisms(
+                        f"service execution of job {job.job_id}"):
+                    if spec.is_select_partitions:
+                        result = list(lazy)
+                    else:
+                        result = dict(lazy)
+        except Exception as e:  # noqa: BLE001 - the worker must survive ANY job failure: the error re-raises to the caller through handle.result(), and the ledger settles conservatively below
+            if accountant.mechanism_count:
+                # Mechanisms registered: releases may have left the
+                # process before the failure — forfeit the full grant
+                # (over-counting is privacy-safe).
+                job.ledger.charge_forfeit(job.job_id, spec.epsilon,
+                                          reason=type(e).__name__)
+            else:
+                job.ledger.release(job.job_id)
+            logging.warning(
+                "service: job %s for tenant %s failed (%s: %s); "
+                "admission grant %s.", job.job_id, job.tenant_id,
+                type(e).__name__, str(e).splitlines()[0][:200],
+                "forfeited" if accountant.mechanism_count else
+                "released")
+            job.handle._fail(e)
+            return
+        records = rt_observability.odometer_report(
+            accountant=accountant)["records"]
+        spent = accountant.spent_epsilon()
+        job.ledger.charge(job.job_id, records)
+        misses = int(
+            rt_health.for_job(job.job_id).snapshot()["counters"].get(
+                "jit_cache_misses", 0))
+        key = spec.cache_key
+        with self._lock:
+            stats = self._spec_stats.setdefault(
+                key, {"jobs": 0, "jit_cache_misses": 0})
+            stats["jobs"] += 1
+            stats["jit_cache_misses"] += misses
+        job.handle._complete(result, spent, misses)
+
+    # -- introspection ---------------------------------------------------
+
+    def handles(self) -> List[JobHandle]:
+        with self._lock:
+            return list(self._handles)
+
+    def compile_reuse(self) -> Dict[str, Dict[str, int]]:
+        """{spec cache_key: {"jobs", "jit_cache_misses"}} — a key whose
+        second..nth jobs added 0 misses shared every compiled entry
+        point with the first (requires tracing for the probe)."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._spec_stats.items()}
+
+    def stats(self) -> Dict[str, Any]:
+        """Service-level rollup for receipts and debugging."""
+        counters = rt_telemetry.snapshot()
+        with self._lock:
+            active = self._active_jobs
+            handles = list(self._handles)
+        by_status: Dict[str, int] = {}
+        for handle in handles:
+            by_status[handle.status] = by_status.get(handle.status, 0) + 1
+        return {
+            "jobs_admitted": counters.get("service_jobs_admitted", 0),
+            "jobs_queued": counters.get("service_jobs_queued", 0),
+            "jobs_shed": counters.get("service_jobs_shed", 0),
+            "active_jobs": active,
+            "queue_depth": self._queue.qsize(),
+            "jobs_by_status": by_status,
+            "compile_reuse": self.compile_reuse(),
+            "ledgers": self.ledgers(),
+            "ledgers_reconciled": self.ledgers_reconciled(),
+        }
+
+
+def _device_bytes_limit() -> Optional[int]:
+    """Summed per-device memory limit from the platform's memory stats
+    (None where unsupported — CPU — or before jax imports; the shed
+    check then needs an explicit memory_limit_bytes)."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        total = 0
+        for device in jax.local_devices():
+            stats = device.memory_stats()
+            if stats and stats.get("bytes_limit"):
+                total += int(stats["bytes_limit"])
+        return total or None
+    except Exception:  # noqa: BLE001 - absent/partial memory-stats support means "no platform limit", exactly what memory_limit_bytes exists to override
+        return None

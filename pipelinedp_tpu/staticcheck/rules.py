@@ -750,6 +750,7 @@ def registry_drift(modules: List[Module]) -> Iterator[Finding]:
 _ENTRY_REL = "pipelinedp_tpu/runtime/entry.py"
 _VALIDATORS_REL = "pipelinedp_tpu/input_validators.py"
 _BACKEND_REL = "pipelinedp_tpu/pipeline_backend.py"
+_SERVICE_REL = "pipelinedp_tpu/service/service.py"
 
 # Runtime knob -> the input_validators function that must vet it.
 KNOB_VALIDATORS: Dict[str, str] = {
@@ -767,6 +768,12 @@ KNOB_VALIDATORS: Dict[str, str] = {
     "coordinator_address": "validate_coordinator_address",
     "metrics_port": "validate_metrics_port",
     "metrics_path": "validate_metrics_path",
+    # Multi-tenant service knobs (validated in
+    # DPAggregationService.__init__ — the service API boundary).
+    "max_concurrent_jobs": "validate_max_concurrent_jobs",
+    "tenant_budget_epsilon": "validate_tenant_budget_epsilon",
+    "queue_timeout_s": "validate_queue_timeout_s",
+    "shed_watermark_fraction": "validate_shed_watermark_fraction",
 }
 
 # Data-plane parameters: configuration, not failure semantics — adding
@@ -778,6 +785,9 @@ KNOB_EXEMPT = frozenset({
     # TPUBackend configuration
     "mesh", "max_partitions", "noise_seed", "secure_noise",
     "large_partition_threshold",
+    # DPAggregationService configuration (data-plane: where ledgers
+    # live and what the shed check divides by — not failure semantics)
+    "ledger_dir", "memory_limit_bytes",
 })
 
 _DRIVER_FUNCS: Dict[str, Tuple[str, ...]] = {
@@ -915,6 +925,20 @@ def knob_validation(modules: List[Module]) -> Iterator[Finding]:
                 knobs, backend_mod.rel, "TPUBackend",
                 _invoked_validators(init, backend_mod),
                 "TPUBackend.__init__")
+
+    # The multi-tenant service is its own API boundary: every defaulted
+    # DPAggregationService.__init__ parameter is a runtime knob under
+    # the same discipline as TPUBackend's.
+    service_mod = by_rel.get(_SERVICE_REL)
+    if service_mod is not None:
+        init = _find_funcdef(service_mod, "__init__",
+                             cls="DPAggregationService")
+        if init is not None:
+            yield from check_knobs(
+                _keyword_knobs(init), service_mod.rel,
+                "DPAggregationService",
+                _invoked_validators(init, service_mod),
+                "DPAggregationService.__init__")
 
     # Reverse direction: a mapping whose knob no longer exists anywhere
     # is stale — it would silently pass while guarding nothing.

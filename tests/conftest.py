@@ -69,6 +69,13 @@ def pytest_configure(config):
         "Prometheus export, memory watermarks, the privacy-budget "
         "odometer and the cross-process rollup (tier-1, NOT slow; "
         "select alone with -m observability)")
+    config.addinivalue_line(
+        "markers",
+        "service: the resident multi-tenant DP-aggregation service — "
+        "concurrent tenants over one backend, persisted tenant budget "
+        "ledgers, admission control/load shedding, cross-job "
+        "compile-cache reuse (tier-1, NOT slow; select alone with "
+        "-m service)")
 
 
 @pytest.fixture(autouse=True)

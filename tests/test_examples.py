@@ -32,6 +32,7 @@ EXAMPLES = [
     ],
     ["examples/experimental/custom_combiners.py", "--generate_rows", "5000"],
     ["examples/quickstart.py", "--rows", "2000"],
+    ["examples/service_demo.py", "--rows", "1000"],
 ]
 
 

@@ -135,6 +135,34 @@ class TestKnobRejection:
         with pytest.raises(ValueError, match="watchdog"):
             pipeline_backend.TPUBackend(watchdog=5.0)
 
+    def test_service_rejects_bad_knobs(self):
+        """The DPAggregationService boundary is under the same
+        discipline: every service knob maps to an invoked validator
+        (the rule proves invocation; this proves behavior)."""
+        from pipelinedp_tpu.service import DPAggregationService
+        backend = pipeline_backend.TPUBackend()
+        with pytest.raises(ValueError, match="max_concurrent_jobs"):
+            DPAggregationService(backend, max_concurrent_jobs=-1)
+        with pytest.raises(ValueError, match="tenant_budget_epsilon"):
+            DPAggregationService(backend, tenant_budget_epsilon=0)
+        with pytest.raises(ValueError, match="queue_timeout_s"):
+            DPAggregationService(backend, queue_timeout_s=float("inf"))
+        with pytest.raises(ValueError, match="shed_watermark_fraction"):
+            DPAggregationService(backend, shed_watermark_fraction=0.0)
+
+    def test_service_knob_without_validation_is_flagged(self):
+        """A new defaulted DPAggregationService.__init__ parameter with
+        no validator mapping drifts loudly."""
+        found = _findings({
+            "pipelinedp_tpu/service/service.py": (
+                "class DPAggregationService:\n"
+                "    def __init__(self, backend, ledger_dir=None, *,\n"
+                "                 brand_new_service_knob=1):\n"
+                "        self._backend = backend\n"),
+        })
+        assert any("brand_new_service_knob" in f.message and
+                   "no validator mapping" in f.message for f in found)
+
     def test_driver_rejects_bad_elastic_and_min_devices(self):
         import numpy as np
         from pipelinedp_tpu.parallel import large_p, make_mesh, sharded

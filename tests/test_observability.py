@@ -569,7 +569,10 @@ class TestResetVsConcurrentJobScopes:
             t.start()
         try:
             for _ in range(30):
-                telemetry.reset()
+                # force=True: this test deliberately exercises the
+                # reset-vs-live-scope concurrency safety the guard
+                # would otherwise (correctly) refuse.
+                telemetry.reset(force=True)
                 time.sleep(0.002)
         finally:
             stop.set()
@@ -591,11 +594,13 @@ class TestResetVsConcurrentJobScopes:
         assert snaps["after-race"]["counters"]["block_retries"] == 3
 
     def test_reset_mid_scope_keeps_thread_consistent(self):
-        """A reset INSIDE an open scope: the thread's tracked JobHealth
-        keeps accepting events (orphaned, never crashing); the next
-        scope re-registers cleanly."""
+        """A FORCED reset INSIDE an open scope: the thread's tracked
+        JobHealth keeps accepting events (orphaned, never crashing);
+        the next scope re-registers cleanly. (The unforced reset now
+        refuses while scopes are live — tests/test_service.py
+        TestResetGuard pins that.)"""
         with rt_health.job_scope("orphan-job"):
-            telemetry.reset()
+            telemetry.reset(force=True)
             telemetry.record("block_retries")  # posts to the orphan
         assert "orphan-job" not in rt_health.snapshot_all()
         with rt_health.job_scope("orphan-job"):

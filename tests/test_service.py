@@ -1,0 +1,482 @@
+"""Multi-tenant service coverage (pipelinedp_tpu/service/).
+
+The contracts under test:
+
+  * **Bit-identity under concurrency** — two tenants submitting at the
+    same time over ONE backend produce exactly the outputs their
+    serial, service-less runs produce (per-job accountants, per-job
+    noise seeds, per-job backend views; nothing shared but the mesh
+    and the compile caches).
+  * **Ledger of record** — per-tenant cumulative spend is the job's
+    odometer trail: disjoint between tenants, bit-exactly equal to
+    each job's ``BudgetAccountant.spent_epsilon()``, durable across a
+    service restart through the CRC-verified journal.
+  * **Admission control** — a tenant at its lifetime budget is refused
+    BEFORE any mechanism registers; the memory-watermark shed and the
+    queue-timeout shed raise typed AdmissionRejectedError with a
+    retry-after and release their reservations.
+  * **Compile-cache reuse** — the second tenant submitting an
+    identical spec records 0 jit cache misses on its own job record.
+  * **The reset guard** — telemetry.reset() warns and no-ops while any
+    job_scope is live (a resident service always has some), so an
+    epoch reset can no longer wipe a running job's state.
+"""
+
+import threading
+import time
+
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.runtime import health as rt_health
+from pipelinedp_tpu.runtime import observability as obs
+from pipelinedp_tpu.runtime import telemetry
+from pipelinedp_tpu.runtime import trace
+from pipelinedp_tpu.service import (
+    AdmissionRejectedError,
+    DPAggregationService,
+    JobSpec,
+    JobStatus,
+    TenantBudgetExceededError,
+    TenantLedger,
+)
+
+pytestmark = pytest.mark.service
+
+
+@pytest.fixture(autouse=True)
+def _service_epoch():
+    telemetry.reset()
+    yield
+    trace.disable()
+    telemetry.reset()
+
+
+ROWS_A = [("u1", "A", 1.0), ("u1", "A", 2.0), ("u2", "A", 1.0),
+          ("u2", "B", 3.0), ("u3", "A", 2.0), ("u3", "B", 1.0)]
+ROWS_B = [("v1", "X", 4.0), ("v1", "Y", 1.0), ("v2", "X", 2.0),
+          ("v2", "Y", 2.0), ("v3", "X", 1.0)]
+
+
+def _params():
+    return pdp.AggregateParams(metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+                               max_partitions_contributed=2,
+                               max_contributions_per_partition=3,
+                               min_value=0.0,
+                               max_value=5.0)
+
+
+def _spec(seed, public, epsilon=1.0):
+    return JobSpec(params=_params(), epsilon=epsilon, delta=1e-6,
+                   noise_seed=seed, public_partitions=public)
+
+
+def _extractors():
+    return pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                              partition_extractor=lambda r: r[1],
+                              value_extractor=lambda r: r[2])
+
+
+def _reference_run(spec, rows):
+    """The serial, service-less run of the same spec: same noise seed,
+    same budget, fresh accountant — the bit-identity baseline."""
+    backend = pdp.TPUBackend(noise_seed=spec.noise_seed)
+    accountant = pdp.NaiveBudgetAccountant(total_epsilon=spec.epsilon,
+                                           total_delta=spec.delta)
+    engine = pdp.DPEngine(accountant, backend)
+    lazy = engine.aggregate(rows, spec.params, _extractors(),
+                            spec.public_partitions)
+    accountant.compute_budgets()
+    return dict(lazy), accountant
+
+
+class _SlowRows:
+    """Row source whose iteration stalls — holds a worker busy so
+    queue-timeout and stop() behavior become observable."""
+
+    def __init__(self, rows, delay_s):
+        self._rows = rows
+        self._delay_s = delay_s
+
+    def __iter__(self):
+        time.sleep(self._delay_s)
+        return iter(self._rows)
+
+
+class _PoisonRows:
+    """Row source that explodes mid-iteration — a job failure AFTER
+    its mechanisms registered (graph build saw a valid collection)."""
+
+    def __iter__(self):
+        raise RuntimeError("injected source failure")
+
+
+class TestConcurrentBitIdentity:
+
+    def test_two_tenants_concurrent_equal_serial(self):
+        spec_a = _spec(seed=11, public=["A", "B"])
+        spec_b = _spec(seed=23, public=["X", "Y"])
+        want_a, acc_a = _reference_run(spec_a, ROWS_A)
+        want_b, acc_b = _reference_run(spec_b, ROWS_B)
+        with DPAggregationService(pdp.TPUBackend(),
+                                  max_concurrent_jobs=2,
+                                  tenant_budget_epsilon=10.0) as svc:
+            ha = svc.submit("tenant-a", spec_a, ROWS_A)
+            hb = svc.submit("tenant-b", spec_b, ROWS_B)
+            got_a = ha.result(timeout=120)
+            got_b = hb.result(timeout=120)
+            # Bit-identical to the serial runs: float equality, not
+            # approx — same seeds, same kernel, same release.
+            assert got_a == want_a
+            assert got_b == want_b
+            # Disjoint ledgers, each reconciling bit-exactly with its
+            # job's accountant.
+            led_a = svc.tenant_ledger("tenant-a")
+            led_b = svc.tenant_ledger("tenant-b")
+            assert led_a.job_spent_epsilon(ha.job_id) == \
+                acc_a.spent_epsilon()
+            assert led_b.job_spent_epsilon(hb.job_id) == \
+                acc_b.spent_epsilon()
+            assert led_a.job_spent_epsilon(hb.job_id) == 0.0
+            assert led_b.job_spent_epsilon(ha.job_id) == 0.0
+            assert svc.ledgers_reconciled()
+            assert ha.spent_epsilon == acc_a.spent_epsilon()
+
+    def test_select_partitions_job(self):
+        params = pdp.SelectPartitionsParams(max_partitions_contributed=2)
+        rows = [(f"u{i}", "P", 0.0) for i in range(200)] + \
+               [(f"u{i}", "Q", 0.0) for i in range(200)]
+        spec = JobSpec(params=params, epsilon=5.0, delta=1e-4,
+                       noise_seed=3)
+        with DPAggregationService(pdp.TPUBackend()) as svc:
+            handle = svc.submit("tenant-s", spec, rows)
+            kept = handle.result(timeout=120)
+            assert set(kept) <= {"P", "Q"}
+            assert len(kept) == 2  # 200 ids each: kept w.p. ~1
+            assert handle.spent_epsilon == pytest.approx(5.0)
+            assert svc.ledgers_reconciled()
+
+
+class TestTenantBudget:
+
+    def test_exhausted_tenant_rejected_before_any_registration(self):
+        with DPAggregationService(pdp.TPUBackend(),
+                                  tenant_budget_epsilon=1.0) as svc:
+            first = svc.submit("tenant-x", _spec(7, ["A", "B"],
+                                                 epsilon=0.8), ROWS_A)
+            assert first.result(timeout=120) is not None
+            before = telemetry.snapshot().get("budget_registrations", 0)
+            mechanisms_before = obs.odometer_report()["mechanisms"]
+            with pytest.raises(TenantBudgetExceededError) as exc:
+                svc.submit("tenant-x", _spec(8, ["A", "B"], epsilon=0.5),
+                           ROWS_A)
+            assert exc.value.retry_after_s is None
+            # Rejected before the job existed: zero new mechanisms,
+            # zero new odometer records.
+            assert telemetry.snapshot().get("budget_registrations",
+                                            0) == before
+            assert obs.odometer_report()["mechanisms"] == \
+                mechanisms_before
+            # A grant that still fits is admitted.
+            ok = svc.submit("tenant-x", _spec(9, ["A", "B"],
+                                              epsilon=0.2), ROWS_A)
+            assert ok.result(timeout=120) is not None
+
+    def test_reservations_count_against_concurrent_submissions(self):
+        # One worker, lifetime 1.0: while the first 0.7 job is still
+        # queued/running, a second 0.7 must already be refused.
+        with DPAggregationService(pdp.TPUBackend(),
+                                  max_concurrent_jobs=1,
+                                  tenant_budget_epsilon=1.0) as svc:
+            slow = _SlowRows(ROWS_A, delay_s=0.3)
+            h1 = svc.submit("tenant-r", _spec(1, ["A", "B"], epsilon=0.7),
+                            slow)
+            with pytest.raises(TenantBudgetExceededError):
+                svc.submit("tenant-r", _spec(2, ["A", "B"], epsilon=0.7),
+                           ROWS_A)
+            assert h1.result(timeout=120) is not None
+
+    def test_failed_before_registration_releases_grant(self):
+        with DPAggregationService(pdp.TPUBackend(),
+                                  tenant_budget_epsilon=1.0) as svc:
+            bad = JobSpec(params=_params(), epsilon=0.9, delta=1e-6,
+                          noise_seed=1, public_partitions=["A"])
+            handle = svc.submit("tenant-f", bad, None)  # col=None fails
+            with pytest.raises(Exception):
+                handle.result(timeout=120)
+            assert handle.status == JobStatus.FAILED
+            ledger = svc.tenant_ledger("tenant-f")
+            assert ledger.spent_epsilon() == 0.0
+            assert ledger.reserved_epsilon() == 0.0
+
+    def test_failed_after_registration_forfeits_grant(self):
+        with DPAggregationService(pdp.TPUBackend(),
+                                  tenant_budget_epsilon=1.0) as svc:
+            spec = _spec(1, ["A"], epsilon=0.9)
+            handle = svc.submit("tenant-g", spec, _PoisonRows())
+            with pytest.raises(RuntimeError, match="injected source"):
+                handle.result(timeout=120)
+            ledger = svc.tenant_ledger("tenant-g")
+            # The full admission grant is conservatively charged: the
+            # graph existed, so a release cannot be ruled out.
+            assert ledger.spent_epsilon() == 0.9
+            records = ledger.records()
+            assert records[-1]["metric"] == "admission_grant_forfeit"
+
+
+class TestLedgerPersistence:
+
+    def test_ledger_survives_service_restart(self, tmp_path):
+        ledger_dir = str(tmp_path)
+        spec = _spec(5, ["A", "B"], epsilon=0.6)
+        with DPAggregationService(pdp.TPUBackend(), ledger_dir,
+                                  tenant_budget_epsilon=1.0) as svc:
+            handle = svc.submit("tenant-p", spec, ROWS_A)
+            handle.result(timeout=120)
+            spent = handle.spent_epsilon
+            assert spent == 0.6
+        # A FRESH service over the same ledger directory reloads the
+        # trail through the CRC-verified journal read path.
+        with DPAggregationService(pdp.TPUBackend(), ledger_dir,
+                                  tenant_budget_epsilon=1.0) as svc2:
+            ledger = svc2.tenant_ledger("tenant-p")
+            assert ledger.spent_epsilon() == spent  # bit-exact
+            assert ledger.job_spent_epsilon(handle.job_id) == spent
+            with pytest.raises(TenantBudgetExceededError):
+                svc2.submit("tenant-p", _spec(6, ["A", "B"], epsilon=0.5),
+                            ROWS_A)
+            ok = svc2.submit("tenant-p", _spec(7, ["A", "B"],
+                                               epsilon=0.3), ROWS_A)
+            assert ok.result(timeout=120) is not None
+            assert svc2.ledgers_reconciled()
+
+    def test_ledger_records_ride_the_odometer_format(self, tmp_path):
+        from pipelinedp_tpu.runtime import journal as rt_journal
+        journal = rt_journal.BlockJournal(str(tmp_path))
+        ledger = TenantLedger("tenant-o", 2.0, journal)
+        ledger.reserve("job-1", 1.0)
+        ledger.charge("job-1", [{
+            "seq": 0, "job_id": "job-1", "metric": "count",
+            "mechanism_kind": "MechanismType.LAPLACE", "weight": 1.0,
+            "sensitivity": 1.0, "count": 1, "process_index": 0,
+            "eps": 1.0, "delta": 0.0,
+        }])
+        loaded = obs.load_odometer(
+            rt_journal.BlockJournal(str(tmp_path)), "tenant-o")
+        assert len(loaded) == 1
+        assert loaded[0]["eps"] == 1.0
+        assert loaded[0]["metric"] == "count"
+
+
+class TestCompileCacheReuse:
+
+    def test_second_identical_spec_zero_jit_misses(self):
+        trace.enable()  # probe_jit only attributes with tracing on
+        spec1 = _spec(seed=41, public=["A", "B"])
+        spec2 = _spec(seed=42, public=["A", "B"])
+        assert spec1.cache_key == spec2.cache_key
+        with DPAggregationService(pdp.TPUBackend(),
+                                  max_concurrent_jobs=1) as svc:
+            h1 = svc.submit("tenant-1", spec1, ROWS_A)
+            h1.result(timeout=120)
+            h2 = svc.submit("tenant-2", spec2, ROWS_A)
+            h2.result(timeout=120)
+            # Same spec, same row bucket -> the second tenant's job hit
+            # every compiled entry point the first one built.
+            assert h2.jit_cache_misses == 0
+            reuse = svc.compile_reuse()[spec1.cache_key]
+            assert reuse["jobs"] == 2
+            assert reuse["jit_cache_misses"] == (h1.jit_cache_misses or 0)
+
+    def test_distinct_specs_distinct_cache_keys(self):
+        a = _spec(1, ["A"])
+        b = JobSpec(params=pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT],
+            max_partitions_contributed=1,
+            max_contributions_per_partition=1,
+            min_value=0.0, max_value=1.0), epsilon=1.0, delta=1e-6)
+        assert a.cache_key != b.cache_key
+
+
+class TestAdmissionControl:
+
+    def test_watermark_shed_with_injected_squeeze(self, monkeypatch):
+        monkeypatch.setattr(
+            obs, "memory_watermark",
+            lambda: {"live_bytes": 9_000, "peak_bytes": 9_000,
+                     "source": "accounted"})
+        with DPAggregationService(pdp.TPUBackend(),
+                                  shed_watermark_fraction=0.5,
+                                  memory_limit_bytes=10_000) as svc:
+            before = telemetry.snapshot().get("service_jobs_shed", 0)
+            with pytest.raises(AdmissionRejectedError) as exc:
+                svc.submit("tenant-m", _spec(1, ["A"]), ROWS_A)
+            assert exc.value.retry_after_s is not None
+            assert not isinstance(exc.value, TenantBudgetExceededError)
+            assert telemetry.snapshot()["service_jobs_shed"] == before + 1
+            # Squeeze clears -> admission resumes.
+            monkeypatch.setattr(
+                obs, "memory_watermark",
+                lambda: {"live_bytes": 100, "peak_bytes": 9_000,
+                         "source": "accounted"})
+            handle = svc.submit("tenant-m", _spec(1, ["A", "B"]), ROWS_A)
+            assert handle.result(timeout=120) is not None
+
+    def test_queue_timeout_sheds_and_releases_reservation(self):
+        with DPAggregationService(pdp.TPUBackend(),
+                                  max_concurrent_jobs=1,
+                                  tenant_budget_epsilon=2.0,
+                                  queue_timeout_s=0.05) as svc:
+            slow = _SlowRows(ROWS_A, delay_s=0.5)
+            h1 = svc.submit("tenant-q", _spec(1, ["A", "B"]), slow)
+            h2 = svc.submit("tenant-q", _spec(2, ["A", "B"]), ROWS_A)
+            with pytest.raises(AdmissionRejectedError) as exc:
+                h2.result(timeout=120)
+            assert exc.value.retry_after_s == pytest.approx(0.05)
+            assert h2.status == JobStatus.SHED
+            assert h1.result(timeout=120) is not None
+            ledger = svc.tenant_ledger("tenant-q")
+            assert ledger.reserved_epsilon() == 0.0
+            assert ledger.spent_epsilon() == h1.spent_epsilon
+
+    def test_stop_cancels_queued_jobs_and_releases_grants(self):
+        svc = DPAggregationService(pdp.TPUBackend(),
+                                   max_concurrent_jobs=1,
+                                   tenant_budget_epsilon=5.0)
+        slow = _SlowRows(ROWS_A, delay_s=0.3)
+        h1 = svc.submit("tenant-z", _spec(1, ["A", "B"]), slow)
+        h2 = svc.submit("tenant-z", _spec(2, ["A", "B"]), ROWS_A)
+        # Let the single worker pick h1 up; the stop sentinel preempts
+        # everything still queued (h2), never a running job.
+        deadline = time.monotonic() + 10
+        while h1.status == JobStatus.QUEUED and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        svc.stop()
+        assert h1.done() and h2.done()
+        assert h1.status == JobStatus.DONE
+        with pytest.raises(AdmissionRejectedError, match="stopped"):
+            h2.result(timeout=1)
+        assert svc.tenant_ledger("tenant-z").reserved_epsilon() == 0.0
+        with pytest.raises(RuntimeError, match="stopped"):
+            svc.submit("tenant-z", _spec(3, ["A"]), ROWS_A)
+
+    def test_priority_orders_the_queue(self):
+        with DPAggregationService(pdp.TPUBackend(),
+                                  max_concurrent_jobs=1,
+                                  queue_timeout_s=60.0) as svc:
+            order = []
+            slow = _SlowRows(ROWS_A, delay_s=0.2)
+            h0 = svc.submit("t", _spec(1, ["A", "B"]), slow)
+            # Queued while the worker is busy: the urgent (lower
+            # priority value) job must run before the earlier lazy one.
+            lazy_spec = _spec(2, ["A", "B"])
+            lazy_spec.priority = 5
+            urgent_spec = _spec(3, ["A", "B"])
+            urgent_spec.priority = 1
+            h_lazy = svc.submit("t", lazy_spec, _Recorder(order, "lazy"))
+            h_urgent = svc.submit("t", urgent_spec,
+                                  _Recorder(order, "urgent"))
+            h0.result(timeout=120)
+            h_lazy.result(timeout=120)
+            h_urgent.result(timeout=120)
+            assert order == ["urgent", "lazy"]
+
+
+class _Recorder:
+    """Row source that records when it is first iterated."""
+
+    def __init__(self, order, name):
+        self._order = order
+        self._name = name
+
+    def __iter__(self):
+        self._order.append(self._name)
+        return iter(ROWS_A)
+
+
+class TestServiceMetrics:
+
+    def test_service_counters_export_through_strict_parser(self):
+        with DPAggregationService(pdp.TPUBackend()) as svc:
+            handle = svc.submit("tenant-e", _spec(1, ["A", "B"]), ROWS_A)
+            handle.result(timeout=120)
+        parsed = obs.parse_prometheus(obs.render_prometheus())
+        assert parsed["pdp_service_jobs_queued"]["samples"][""] >= 1.0
+        assert parsed["pdp_service_jobs_admitted"]["samples"][""] >= 1.0
+        assert parsed["pdp_service_jobs_shed"]["samples"][""] == 0.0
+        assert parsed["pdp_service_active_jobs"]["type"] == "gauge"
+        assert parsed["pdp_service_active_jobs"]["samples"][""] == 0.0
+        assert parsed["pdp_service_queue_depth"]["samples"][""] == 0.0
+
+    def test_stats_rollup(self):
+        with DPAggregationService(pdp.TPUBackend()) as svc:
+            svc.submit("tenant-e", _spec(1, ["A", "B"]),
+                       ROWS_A).result(timeout=120)
+            stats = svc.stats()
+            assert stats["jobs_admitted"] >= 1
+            assert stats["jobs_by_status"][JobStatus.DONE] == 1
+            assert stats["ledgers_reconciled"]
+            assert "tenant-e" in stats["ledgers"]
+
+
+class TestValidation:
+
+    def test_bad_knobs_rejected(self):
+        backend = pdp.TPUBackend()
+        with pytest.raises(ValueError, match="max_concurrent_jobs"):
+            DPAggregationService(backend, max_concurrent_jobs=0)
+        with pytest.raises(ValueError, match="tenant_budget_epsilon"):
+            DPAggregationService(backend, tenant_budget_epsilon=-1.0)
+        with pytest.raises(ValueError, match="queue_timeout_s"):
+            DPAggregationService(backend, queue_timeout_s=0)
+        with pytest.raises(ValueError, match="shed_watermark_fraction"):
+            DPAggregationService(backend, shed_watermark_fraction=1.5)
+        with pytest.raises(ValueError, match="TPUBackend"):
+            DPAggregationService(pdp.LocalBackend())
+
+    def test_path_unsafe_tenant_id_rejected(self):
+        with DPAggregationService(pdp.TPUBackend()) as svc:
+            with pytest.raises(ValueError, match="path"):
+                svc.submit("ten/ant", _spec(1, ["A"]), ROWS_A)
+
+    def test_bad_spec_rejected(self):
+        with DPAggregationService(pdp.TPUBackend()) as svc:
+            with pytest.raises(ValueError, match="JobSpec"):
+                svc.submit("tenant", _params(), ROWS_A)
+            with pytest.raises(ValueError, match="epsilon"):
+                svc.submit("tenant", _spec(1, ["A"], epsilon=-1.0),
+                           ROWS_A)
+
+
+class TestResetGuard:
+
+    def test_reset_refuses_while_job_scope_active(self):
+        """The satellite regression: a process-wide epoch reset during
+        a live job would wipe its health/odometer state — the guard
+        warns and no-ops instead."""
+        started = threading.Event()
+        release = threading.Event()
+
+        def hold():
+            with rt_health.job_scope("live-job"):
+                telemetry.record("block_retries")
+                started.set()
+                release.wait(20)
+
+        worker = threading.Thread(target=hold)
+        worker.start()
+        try:
+            assert started.wait(10)
+            assert rt_health.active_job_scopes() == 1
+            telemetry.reset()  # guard: no-op while the scope is live
+            assert telemetry.snapshot().get("block_retries") == 1
+            assert rt_health.snapshot_all().get("live-job") is not None
+            telemetry.reset(force=True)  # explicit override still works
+            assert telemetry.snapshot() == {}
+        finally:
+            release.set()
+            worker.join(timeout=20)
+        assert rt_health.active_job_scopes() == 0
+        telemetry.reset()  # no scopes left: the plain reset works again
+        assert telemetry.snapshot() == {}
