@@ -513,6 +513,36 @@ def _records_snapshot() -> List[OdometerRecord]:
         return list(_odo_records)
 
 
+def prune_odometer(accountant=None, job_id: Optional[str] = None) -> int:
+    """Removes one accountant's (identity, via weakref) and/or one
+    job's records from the in-memory trail; returns how many went.
+
+    The resident multi-tenant service calls this once a job's trail has
+    been charged to its TenantLedger of record: without pruning, a
+    long-running process accumulates every job's records forever and
+    each completion's odometer_report(accountant=...) scan costs
+    O(total mechanisms ever registered). At least one filter is
+    required — an unfiltered wipe of the whole trail is reset_epoch()'s
+    job, with its active-job-scope guard."""
+    if accountant is None and job_id is None:
+        raise ValueError(
+            "prune_odometer: pass accountant= and/or job_id= — an "
+            "unfiltered prune of the full trail is a reset, which "
+            "telemetry.reset()/reset_epoch() own (with the live-job "
+            "guard this bypass would lose).")
+    with _odo_lock:
+        kept = []
+        removed = 0
+        for record in _odo_records:
+            if ((accountant is None or record.accountant() is accountant)
+                    and (job_id is None or record.job_id == job_id)):
+                removed += 1
+            else:
+                kept.append(record)
+        _odo_records[:] = kept
+    return removed
+
+
 def odometer_report(accountant=None,
                     job_id: Optional[str] = None) -> Dict[str, Any]:
     """Spent-vs-remaining over the ordered audit trail.

@@ -35,6 +35,7 @@ Accounting discipline (two-phase, mirroring the admission flow):
     reservation instead.
 """
 
+import re
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -42,6 +43,11 @@ from pipelinedp_tpu import input_validators
 from pipelinedp_tpu.runtime import observability
 from pipelinedp_tpu.runtime.concurrency import guarded_by
 from pipelinedp_tpu.service.errors import TenantBudgetExceededError
+
+# The service's job-id format is "<tenant>--j<seq>"; the ledger parses
+# the seq back out so a restarted service can seed its sequence past
+# every persisted job id (see max_job_seq).
+_JOB_SEQ_RE = re.compile(r"--j(\d+)$")
 
 
 class TenantLedger:
@@ -101,6 +107,23 @@ class TenantLedger:
     def reserved_epsilon(self) -> float:
         with self._lock:
             return sum(self._reserved.values())
+
+    def max_job_seq(self) -> int:
+        """Largest job-sequence number among this ledger's recorded and
+        in-flight job ids (0 when none match the service format). A
+        restarted service starts its sequence PAST this: its job ids
+        must never collide with a prior run's persisted ids, or
+        job_spent_epsilon()/reconciles() would merge two runs' records
+        under one id and the per-job bit-exact reconciliation breaks."""
+        with self._lock:
+            job_ids = {r.get("job_id") for r in self._records}
+            job_ids.update(self._reserved)
+        best = 0
+        for job_id in job_ids:
+            match = _JOB_SEQ_RE.search(job_id or "")
+            if match:
+                best = max(best, int(match.group(1)))
+        return best
 
     def remaining_epsilon(self) -> float:
         """Lifetime budget minus recorded spend minus in-flight

@@ -238,6 +238,26 @@ class _Job:
 # >= 0), so stop() preempts queued work and workers exit immediately.
 _STOP_PRIORITY = -1
 
+# A resident service outlives millions of submissions: completed
+# handles beyond this bound are evicted (oldest first; queued/running
+# handles are always kept) so _handles and the stats() /
+# ledgers_reconciled() scans stay O(recent), not O(service lifetime).
+_MAX_RETAINED_HANDLES = 1024
+
+
+def _evict_done(handles: List[JobHandle],
+                cap: int) -> List[JobHandle]:
+    """Drops the oldest FINISHED handles until len <= cap (or until
+    only unfinished handles remain — those are never dropped)."""
+    excess = len(handles) - cap
+    kept = []
+    for handle in handles:
+        if excess > 0 and handle.done():
+            excess -= 1
+            continue
+        kept.append(handle)
+    return kept
+
 
 class DPAggregationService:
     """See module docstring.
@@ -421,6 +441,12 @@ class DPAggregationService:
         self._shed_check()
         ledger = self.tenant_ledger(tenant_id)
         with self._lock:
+            # Job ids must stay unique across service restarts: the
+            # reloaded ledger keeps prior-run job ids in the same
+            # format, and a colliding id would merge two runs' records
+            # in job_spent_epsilon()/reconciles(). Seed the sequence
+            # past everything the tenant's ledger has seen.
+            self._seq = max(self._seq, ledger.max_job_seq())
             self._seq += 1
             seq = self._seq
         job_id = f"{tenant_id}--j{seq:05d}"
@@ -432,9 +458,24 @@ class DPAggregationService:
                    source=source, ledger=ledger, handle=handle,
                    enqueued_at=time.monotonic())
         with self._lock:
-            self._handles.append(handle)
+            # Re-checked at enqueue time: if stop() set _stopped after
+            # the early check, the workers are exiting and the drain
+            # may already have emptied the queue — a job put now would
+            # never complete and its reservation would leak. Enqueue
+            # and the _stopped flag flip under the same lock, so every
+            # job is either visible to stop()'s drain or refused here.
+            admitted = not self._stopped
+            if admitted:
+                self._handles.append(handle)
+                if len(self._handles) > _MAX_RETAINED_HANDLES:
+                    self._handles = _evict_done(self._handles,
+                                                _MAX_RETAINED_HANDLES)
+                self._queue.put((max(int(spec.priority), 0), seq, job))
+        if not admitted:
+            ledger.release(job_id)
+            raise RuntimeError(
+                "DPAggregationService.submit: the service is stopped.")
         rt_telemetry.record("service_jobs_queued")
-        self._queue.put((max(int(spec.priority), 0), seq, job))
         self._set_queue_depth()
         return handle
 
@@ -499,6 +540,12 @@ class DPAggregationService:
             job.handle._set_running()
             try:
                 self._run_job(job)
+            except Exception as e:  # noqa: BLE001 - last-ditch guard: _run_job settles the ledger itself, but anything escaping it (a charge/persist failure, a bug in the failure handler) must still fail the handle — or the caller blocks in result() forever and the pool permanently loses this worker
+                logging.exception(
+                    "service: job %s for tenant %s crashed outside its "
+                    "failure handler", job.job_id, job.tenant_id)
+                if not job.handle.done():
+                    job.handle._fail(e)
             finally:
                 with self._lock:
                     self._active_jobs -= 1
@@ -548,18 +595,28 @@ class DPAggregationService:
                                           reason=type(e).__name__)
             else:
                 job.ledger.release(job.job_id)
+            rt_observability.prune_odometer(accountant=accountant)
+            # Fail the handle BEFORE formatting the log line: a
+            # formatting surprise must never leave the caller blocked
+            # in result() with the ledger already settled.
+            job.handle._fail(e)
             logging.warning(
                 "service: job %s for tenant %s failed (%s: %s); "
                 "admission grant %s.", job.job_id, job.tenant_id,
-                type(e).__name__, str(e).splitlines()[0][:200],
+                type(e).__name__,
+                (str(e).splitlines() or [""])[0][:200],
                 "forfeited" if accountant.mechanism_count else
                 "released")
-            job.handle._fail(e)
             return
         records = rt_observability.odometer_report(
             accountant=accountant)["records"]
         spent = accountant.spent_epsilon()
         job.ledger.charge(job.job_id, records)
+        # The trail is charged to the tenant's ledger of record — drop
+        # it from the process-global odometer, or a resident service
+        # grows that trail (and every odometer_report scan) without
+        # bound over its lifetime.
+        rt_observability.prune_odometer(accountant=accountant)
         misses = int(
             rt_health.for_job(job.job_id).snapshot()["counters"].get(
                 "jit_cache_misses", 0))
@@ -574,6 +631,10 @@ class DPAggregationService:
     # -- introspection ---------------------------------------------------
 
     def handles(self) -> List[JobHandle]:
+        """Retained job handles: every queued/running job, plus the
+        most recent completed ones (bounded — see
+        _MAX_RETAINED_HANDLES); stats() and ledgers_reconciled() roll
+        up over this window, the ledgers keep the full history."""
         with self._lock:
             return list(self._handles)
 

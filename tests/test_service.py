@@ -40,6 +40,7 @@ from pipelinedp_tpu.service import (
     TenantBudgetExceededError,
     TenantLedger,
 )
+from pipelinedp_tpu.service import service as service_module
 
 pytestmark = pytest.mark.service
 
@@ -109,6 +110,14 @@ class _PoisonRows:
 
     def __iter__(self):
         raise RuntimeError("injected source failure")
+
+
+class _EmptyMsgPoison:
+    """Row source whose failure carries an EMPTY message (str(e) == "")
+    — the shape that used to crash the failure handler's log line."""
+
+    def __iter__(self):
+        raise ValueError()
 
 
 class TestConcurrentBitIdentity:
@@ -209,6 +218,26 @@ class TestTenantBudget:
             assert ledger.spent_epsilon() == 0.0
             assert ledger.reserved_epsilon() == 0.0
 
+    def test_empty_message_failure_keeps_worker_alive(self):
+        """Regression: a job failing with an empty exception message
+        used to IndexError inside the failure handler's log formatting
+        AFTER the ledger settled but BEFORE the handle failed — the
+        worker thread died, result() blocked forever, and the pool
+        permanently lost a worker."""
+        with DPAggregationService(pdp.TPUBackend(),
+                                  max_concurrent_jobs=1,
+                                  tenant_budget_epsilon=5.0) as svc:
+            bad = svc.submit("tenant-w", _spec(1, ["A"]),
+                             _EmptyMsgPoison())
+            with pytest.raises(ValueError):
+                bad.result(timeout=120)
+            assert bad.status == JobStatus.FAILED
+            # The single worker survived the crash: the next job on
+            # the same worker still runs to completion.
+            ok = svc.submit("tenant-w", _spec(2, ["A", "B"]), ROWS_A)
+            assert ok.result(timeout=120) is not None
+            assert svc.tenant_ledger("tenant-w").reserved_epsilon() == 0.0
+
     def test_failed_after_registration_forfeits_grant(self):
         with DPAggregationService(pdp.TPUBackend(),
                                   tenant_budget_epsilon=1.0) as svc:
@@ -248,6 +277,32 @@ class TestLedgerPersistence:
             ok = svc2.submit("tenant-p", _spec(7, ["A", "B"],
                                                epsilon=0.3), ROWS_A)
             assert ok.result(timeout=120) is not None
+            assert svc2.ledgers_reconciled()
+
+    def test_restart_job_ids_never_collide_with_persisted(self, tmp_path):
+        """Regression: a restarted service used to restart its job
+        sequence at 1, so its first job reused a persisted job id and
+        job_spent_epsilon()/reconciles() merged two runs' records."""
+        ledger_dir = str(tmp_path)
+        with DPAggregationService(pdp.TPUBackend(), ledger_dir,
+                                  tenant_budget_epsilon=2.0) as svc:
+            h1 = svc.submit("tenant-c", _spec(5, ["A", "B"], epsilon=0.6),
+                            ROWS_A)
+            h1.result(timeout=120)
+        with DPAggregationService(pdp.TPUBackend(), ledger_dir,
+                                  tenant_budget_epsilon=2.0) as svc2:
+            # The FIRST submission after restart (nothing consumed the
+            # would-be colliding sequence number first).
+            h2 = svc2.submit("tenant-c",
+                             _spec(6, ["A", "B"], epsilon=0.6), ROWS_A)
+            h2.result(timeout=120)
+            assert h2.job_id != h1.job_id
+            ledger = svc2.tenant_ledger("tenant-c")
+            # Per-job spends stay per-job — no cross-run merge.
+            assert ledger.job_spent_epsilon(h1.job_id) == h1.spent_epsilon
+            assert ledger.job_spent_epsilon(h2.job_id) == h2.spent_epsilon
+            assert ledger.spent_epsilon() == \
+                h1.spent_epsilon + h2.spent_epsilon
             assert svc2.ledgers_reconciled()
 
     def test_ledger_records_ride_the_odometer_format(self, tmp_path):
@@ -361,6 +416,25 @@ class TestAdmissionControl:
         with pytest.raises(RuntimeError, match="stopped"):
             svc.submit("tenant-z", _spec(3, ["A"]), ROWS_A)
 
+    def test_submit_racing_stop_releases_reservation(self, monkeypatch):
+        """Regression: stop() landing between submit's admission checks
+        and its enqueue used to leave the job in a queue no worker
+        would ever read — the handle never completed and the tenant's
+        reservation leaked. The enqueue now re-checks _stopped under
+        the lock and refuses (releasing the grant) instead."""
+        svc = DPAggregationService(pdp.TPUBackend(),
+                                   tenant_budget_epsilon=1.0)
+        orig_shed_check = svc._shed_check
+
+        def shed_check_then_stop():
+            orig_shed_check()
+            svc.stop()  # the race, made deterministic
+
+        monkeypatch.setattr(svc, "_shed_check", shed_check_then_stop)
+        with pytest.raises(RuntimeError, match="stopped"):
+            svc.submit("tenant-race", _spec(1, ["A"]), ROWS_A)
+        assert svc.tenant_ledger("tenant-race").reserved_epsilon() == 0.0
+
     def test_priority_orders_the_queue(self):
         with DPAggregationService(pdp.TPUBackend(),
                                   max_concurrent_jobs=1,
@@ -393,6 +467,49 @@ class _Recorder:
     def __iter__(self):
         self._order.append(self._name)
         return iter(ROWS_A)
+
+
+class TestResidentGrowthBounds:
+    """A resident service must not grow without bound: completed jobs
+    leave the process-global odometer (their ledger is the record) and
+    completed handles are evicted beyond a retention cap."""
+
+    def test_completed_jobs_prune_their_odometer_records(self):
+        with DPAggregationService(pdp.TPUBackend()) as svc:
+            svc.submit("tenant-1", _spec(1, ["A", "B"]),
+                       ROWS_A).result(timeout=120)
+            svc.submit("tenant-2", _spec(2, ["A", "B"]),
+                       ROWS_A).result(timeout=120)
+            # Both jobs' trails moved to their tenant ledgers of
+            # record; the global trail holds nothing for them.
+            assert obs.odometer_report()["mechanisms"] == 0
+            assert svc.ledgers_reconciled()
+            assert svc.tenant_ledger("tenant-1").records()
+
+    def test_failed_jobs_prune_their_odometer_records(self):
+        with DPAggregationService(pdp.TPUBackend(),
+                                  tenant_budget_epsilon=2.0) as svc:
+            handle = svc.submit("tenant-p", _spec(1, ["A"], epsilon=0.5),
+                                _PoisonRows())
+            with pytest.raises(RuntimeError):
+                handle.result(timeout=120)
+            assert obs.odometer_report()["mechanisms"] == 0
+
+    def test_handle_retention_is_bounded(self, monkeypatch):
+        monkeypatch.setattr(service_module, "_MAX_RETAINED_HANDLES", 3)
+        with DPAggregationService(pdp.TPUBackend()) as svc:
+            for i in range(6):
+                svc.submit("tenant-h", _spec(i + 1, ["A", "B"]),
+                           ROWS_A).result(timeout=120)
+            retained = svc.handles()
+            assert len(retained) == 3
+            # Newest completed jobs are the ones kept.
+            assert all(h.status == JobStatus.DONE for h in retained)
+            assert svc.ledgers_reconciled()
+            # The ledger keeps the FULL history regardless of handle
+            # eviction.
+            ledger = svc.tenant_ledger("tenant-h")
+            assert len(ledger.snapshot()["jobs"]) == 6
 
 
 class TestServiceMetrics:
