@@ -685,6 +685,64 @@ def _bench_end_to_end(on_tpu):
     second_warm_misses = rt_telemetry.delta(misses_before).get(
         "jit_cache_misses", 0)
     rt_trace.reset()
+
+    # --- Device-resident encode (encode_mode="hash_device") vs the
+    # host encoder, same data, both warm. The netflix shape above is
+    # the wrong comparator for ENCODE work (integer keys factorize at
+    # memcpy speed and file parsing dominates its wall), so this
+    # section uses the heavy host-encode shape the streaming dryrun
+    # established — composite string keys, a ~300K-entry user
+    # vocabulary, fine-grained 4K-row chunks (network-granularity
+    # streaming): there the host route's sequential vocabulary stitch
+    # (per-chunk remap + index rebuild over the full vocabulary) is the
+    # wall the ROADMAP names, and the hash route replaces it with
+    # vectorized hashing + in-jit code assignment. Byte-arrival
+    # boundary: chunks are pre-materialized raw columns, so both modes
+    # time exactly "everything after byte arrival".
+    n_de = 800_000 if not on_tpu else 8_000_000
+    de_chunk = 4_000
+    rng_de = np.random.default_rng(23)
+    de_pid = np.char.add(
+        np.char.add("user_",
+                    rng_de.integers(0, 300_000, n_de).astype(str)),
+        np.char.add("_sess", rng_de.integers(0, 3, n_de).astype(str)))
+    de_pk = np.char.add("movie_",
+                        rng_de.integers(0, 2_000, n_de).astype(str))
+    de_vals = rng_de.uniform(0, 5, n_de)
+
+    def de_chunks():
+        return [(de_pid[i:i + de_chunk], de_pk[i:i + de_chunk],
+                 de_vals[i:i + de_chunk])
+                for i in range(0, n_de, de_chunk)]
+
+    def run_encode_mode(mode):
+        start = time.perf_counter()
+        accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                               total_delta=1e-6)
+        engine = pdp.DPEngine(
+            accountant,
+            pdp.TPUBackend(noise_seed=13, encode_threads=2,
+                           encode_mode=mode))
+        result = engine.aggregate(pdp.ChunkSource(de_chunks()), params,
+                                  extractors)
+        accountant.compute_budgets()
+        n_kept = sum(1 for _ in result)
+        return time.perf_counter() - start, n_kept
+
+    run_encode_mode("host")  # compiles for this shape
+    host_encode_sec, n_kept_host_enc = run_encode_mode("host")
+    run_encode_mode("hash_device")  # warm the hash-route kernels
+    misses_before = rt_telemetry.snapshot()
+    with rt_trace.scoped():
+        with rt_trace.span("e2e_device_encode"):
+            device_sec, n_kept_device = run_encode_mode("hash_device")
+        device_summary = rt_trace.trace_summary()
+    device_second_warm_misses = rt_telemetry.delta(misses_before).get(
+        "jit_cache_misses", 0)
+    device_breakdown = _phase_breakdown(device_summary, device_sec)
+    rt_trace.reset()
+    assert n_kept_device == n_kept_host_enc, (
+        "device-encode release diverged from the host encode")
     os.unlink(path)
     # Note for cross-round comparisons: rounds <= 4 reported a single
     # compile-inclusive "end_to_end_sec"; that old key corresponds to
@@ -705,6 +763,24 @@ def _bench_end_to_end(on_tpu):
         # 0 == every row shape of the second warm pipelined call hit the
         # persistent compile cache (the bucketed-padding guarantee).
         "e2e_pipelined_second_warm_jit_cache_misses": second_warm_misses,
+        # Device-resident ingest (encode_mode="hash_device") vs the
+        # host encoder over the SAME heavy-encode stream (composite
+        # string keys, 300K-entry vocabulary, 4K-row chunks), both
+        # warm; the device-mode phase breakdown shows host
+        # encode/factorize is no longer the dominant phase (no host
+        # factorization runs at all — "ingest" is hashing + upload,
+        # "ingest.device_codes" the in-jit code assignment).
+        "e2e_device_encode_rows": n_de,
+        "e2e_sec_host_encode": round(host_encode_sec, 3),
+        "e2e_rows_per_sec_host_encode": round(n_de / host_encode_sec),
+        "e2e_sec_device_encode": round(device_sec, 3),
+        "e2e_rows_per_sec_device_encode": round(n_de / device_sec),
+        "e2e_device_encode_speedup": round(
+            host_encode_sec / device_sec, 2),
+        "e2e_device_encode_kept_partitions": n_kept_device,
+        "e2e_device_encode_second_warm_jit_cache_misses":
+            device_second_warm_misses,
+        "e2e_device_encode_phase_breakdown": device_breakdown,
         "e2e_phase_breakdown": breakdown,
         "trace_summary": {
             "spans": dict(list(summary["spans"].items())[:12]),
@@ -745,10 +821,32 @@ def _bench_ingest():
         fb_elapsed = time.perf_counter() - start
     finally:
         ingest_mod._pd, columnar._pd = saved
+
+    # Device-resident encode: the same columns through the hash-device
+    # route (host work = hashing only; factorization runs inside jit).
+    # Warm once so the factorize-kernel compile does not bill the
+    # steady-state number, then time a full encode to device arrays.
+    import jax
+
+    chunk = 1 << 19
+
+    def dev_chunks():
+        return [(pids[i:i + chunk], pks[i:i + chunk], vals[i:i + chunk])
+                for i in range(0, n, chunk)]
+
+    ingest_mod.stream_encode_columns(dev_chunks(),
+                                     encode_mode="hash_device",
+                                     encode_threads=2)
+    start = time.perf_counter()
+    dev_encoded = ingest_mod.stream_encode_columns(
+        dev_chunks(), encode_mode="hash_device", encode_threads=2)
+    jax.block_until_ready((dev_encoded.pid, dev_encoded.pk))
+    dev_elapsed = time.perf_counter() - start
     return {
         "ingest_rows": n,
         "ingest_rows_per_sec": round(n / elapsed),
         "ingest_fallback_rows_per_sec": round(n / fb_elapsed),
+        "ingest_device_rows_per_sec": round(n / dev_elapsed),
         "ingest_partitions": encoded.n_partitions,
     }
 

@@ -60,6 +60,10 @@ from pipelinedp_tpu.pipeline_backend import (
 # (runtime/pipeline.py) under the backend's encode_threads /
 # pipeline_depth knobs.
 from pipelinedp_tpu.runtime.pipeline import ChunkSource
+# Raised (instead of silently merging two partitions) when the
+# hash-device encode mode detects a 64-bit key-hash collision and the
+# chunk source cannot be re-iterated for the exact-encoder fallback.
+from pipelinedp_tpu.device_encode import HashCollisionError
 
 # Beam/Spark backends exist only when the corresponding framework is
 # importable (reference exports them unconditionally from

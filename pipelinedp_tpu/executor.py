@@ -1123,6 +1123,9 @@ def stream_chunk_source(backend, source, public_list=None):
     threads = getattr(backend, "encode_threads", None)
     if threads is None:
         threads = rt_pipeline.default_encode_threads()
+    encode_mode = getattr(source, "encode_mode", None)
+    if encode_mode is None:
+        encode_mode = getattr(backend, "encode_mode", "host")
     from pipelinedp_tpu import ingest
     with rt_watchdog.activate(wd):
         return ingest.stream_encode_columns(
@@ -1130,7 +1133,8 @@ def stream_chunk_source(backend, source, public_list=None):
             public_partitions=public_list,
             nonfinite=source.nonfinite,
             encode_threads=threads,
-            pipeline_depth=getattr(backend, "pipeline_depth", None))
+            pipeline_depth=getattr(backend, "pipeline_depth", None),
+            encode_mode=encode_mode)
 
 
 def _encode_input(backend, rows, data_extractors, public_list=None):
@@ -1199,6 +1203,8 @@ def lazy_select_partitions(backend, col, params, data_extractors,
                         selection, **runtime_kwargs)
             vocab = encoded.partition_vocab
             n_real = len(vocab)
+            if hasattr(vocab, "prefetch"):
+                vocab.prefetch(idx for idx in kept_ids if idx < n_real)
             for idx in kept_ids:
                 if idx < n_real:
                     # staticcheck: disable=release-taint — sanctioned release: partition keys are decoded ONLY at indices the DP selection kernel kept (noise + threshold); the selection mechanism registered with the ledger is the sanitizer
@@ -1232,6 +1238,8 @@ def lazy_select_partitions(backend, col, params, data_extractors,
         with rt_trace.span("drain"):
             kept_idx = np.nonzero(np.asarray(keep))[0]
         with rt_trace.span("post_process"):
+            if hasattr(vocab, "prefetch"):
+                vocab.prefetch(idx for idx in kept_idx if idx < n_real)
             for idx in kept_idx:
                 if idx < n_real:
                     # staticcheck: disable=release-taint — sanctioned release: partition keys are decoded ONLY at indices the DP selection kernel kept (noise + threshold); the selection mechanism registered with the ledger is the sanitizer
@@ -1533,6 +1541,13 @@ def _decode_rows(outputs, row_idx_pairs, partition_vocab: Sequence[Any],
         name for entry in build_plan(compound) for name in entry.outputs
     ]
     n_real = len(partition_vocab)
+    row_idx_pairs = list(row_idx_pairs)
+    if hasattr(partition_vocab, "prefetch"):
+        # Hash-encoded vocabulary (device_encode.HashVocab): decode
+        # EXACTLY the DP-selected indices in one O(kept) batch instead
+        # of one lookup round trip per emitted partition.
+        partition_vocab.prefetch(
+            idx for _, idx in row_idx_pairs if idx < n_real)
     for row, idx in row_idx_pairs:
         if idx >= n_real:
             continue  # padding partitions beyond the vocabulary

@@ -174,6 +174,24 @@ def validate_encode_threads(encode_threads, obj_name: str) -> None:
             f"threads feeding the staging queue (None auto-sizes).")
 
 
+def validate_encode_mode(encode_mode, obj_name: str) -> None:
+    """Validates the ingest encode mode: "host" or "hash_device".
+
+    Raises:
+        ValueError: encode_mode is not one of the two modes ("host" is
+        the exact chunked vocabulary encoder; "hash_device" hashes keys
+        on the host and factorizes on device, with partition-key decode
+        deferred to DP-selected indices).
+    """
+    if encode_mode not in ("host", "hash_device"):
+        raise ValueError(
+            f"{obj_name}: encode_mode must be 'host' or 'hash_device', "
+            f"but {encode_mode!r} given — 'host' runs the exact chunked "
+            f"vocabulary encoder, 'hash_device' the on-device hash "
+            f"factorization with decode-at-selected-indices (falls back "
+            f"to 'host' on a detected hash collision).")
+
+
 def validate_metrics_port(metrics_port, obj_name: str) -> None:
     """Validates the live-metrics scrape port: an integer in [0, 65535].
 

@@ -412,6 +412,19 @@ class TPUBackend(LocalBackend):
             bit-identical — the accumulator reproduces executor.pad_rows
             exactly, so the same compiled kernel sees the same arrays
             and releases the same noise.
+        encode_mode: how streamed (ChunkSource) input is vocabulary-
+            encoded. "host" (default): the exact chunked host encoder —
+            per-chunk factorize on the encode pool, sequential
+            vocabulary stitch on the consumer. "hash_device": chunk
+            workers only HASH raw keys (vectorized, order-independent),
+            raw hash columns stream host->device once, dense
+            first-occurrence codes are assigned inside jit
+            (device_encode.py), and partition keys are decoded only at
+            the DP-selected indices. Bit-identical outputs to "host"
+            under the same noise keys; a detected 64-bit hash collision
+            (counted in ingest_hash_collisions) falls back to the exact
+            host encoder when the chunk source is re-iterable. A
+            ChunkSource(encode_mode=...) overrides this per source.
         coordinator_address: jax.distributed coordinator endpoint
             ("host:port"). With num_processes, brings up the
             multi-controller runtime at backend construction
@@ -465,6 +478,7 @@ class TPUBackend(LocalBackend):
                  trace: bool = False,
                  pipeline_depth: Optional[int] = None,
                  encode_threads: Optional[int] = None,
+                 encode_mode: str = "host",
                  coordinator_address: Optional[str] = None,
                  num_processes: Optional[int] = None,
                  metrics_port: Optional[int] = None,
@@ -495,6 +509,7 @@ class TPUBackend(LocalBackend):
         if encode_threads is not None:
             input_validators.validate_encode_threads(
                 encode_threads, "TPUBackend")
+        input_validators.validate_encode_mode(encode_mode, "TPUBackend")
         if num_processes is not None:
             input_validators.validate_num_processes(
                 num_processes, "TPUBackend")
@@ -537,6 +552,7 @@ class TPUBackend(LocalBackend):
         self.trace = trace
         self.pipeline_depth = pipeline_depth
         self.encode_threads = encode_threads
+        self.encode_mode = encode_mode
         self.coordinator_address = coordinator_address
         self.num_processes = num_processes
         self.metrics_port = metrics_port
@@ -599,7 +615,8 @@ class TPUBackend(LocalBackend):
             elastic=self.elastic,
             min_devices=self.min_devices,
             pipeline_depth=self.pipeline_depth,
-            encode_threads=self.encode_threads)
+            encode_threads=self.encode_threads,
+            encode_mode=self.encode_mode)
 
     def dump_trace(self, path: str, job_id: Optional[str] = None) -> str:
         """Writes the recorded trace as Chrome/Perfetto trace-event JSON

@@ -234,7 +234,15 @@ def run_pod_engine(mesh) -> Dict[str, np.ndarray]:
     """Engine-level pod aggregation over the multi-host ingest path:
     this process encodes only its shard (encode_local_shard_to_mesh),
     the engine aggregates over the pod mesh, and the budget ledger is
-    returned for the zero-duplicate-registration check."""
+    returned for the zero-duplicate-registration check.
+
+    Runs BOTH encode modes over the same shard and seed: the host
+    vocabulary exchange and the hash-device collective factorize
+    (device vocab all_gather + on-device unique,
+    device_encode.mesh_factorize_codes) must release bit-identical
+    results — asserted here on every controller AND compared bitwise
+    across topologies through the returned hash_* keys, which is what
+    gates the device vocab allgather in tier-1's 2-process pod."""
     import pipelinedp_tpu as pdp
     from pipelinedp_tpu import ingest
     from pipelinedp_tpu.parallel import mesh as mesh_lib
@@ -262,6 +270,28 @@ def run_pod_engine(mesh) -> Dict[str, np.ndarray]:
     acc.compute_budgets()
     result = dict(result)
     pks = sorted(result)
+
+    # Hash-device ingest over the SAME shard and noise seed: the device
+    # collective factorize must place every row on the same codes, so
+    # the release is bit-identical to the host-exchanged one.
+    hash_encoded = ingest.encode_local_shard_to_mesh(
+        chunks, mesh, encode_mode="hash_device")
+    acc_h = pdp.NaiveBudgetAccountant(total_epsilon=1e7,
+                                      total_delta=1e-6)
+    engine_h = pdp.DPEngine(acc_h,
+                            pdp.TPUBackend(mesh=mesh, noise_seed=11))
+    hash_lazy = engine_h.aggregate(hash_encoded, params, ex)
+    acc_h.compute_budgets()
+    hash_result = dict(hash_lazy)
+    assert sorted(hash_result) == pks, (
+        f"hash-device pod ingest kept a different partition set: "
+        f"{len(hash_result)} vs {len(pks)}")
+    for k in pks:
+        assert (hash_result[k].count == result[k].count and
+                hash_result[k].sum == result[k].sum), (
+            f"hash-device pod ingest diverged from the host encode "
+            f"at {k!r}")
+    assert acc_h.mechanism_count == acc.mechanism_count
     # The budget odometer rides the bit-identity contract: every
     # controller (and the single-process reference) derives the SAME
     # audit trail for this ledger — record count == mechanism_count and
@@ -277,6 +307,10 @@ def run_pod_engine(mesh) -> Dict[str, np.ndarray]:
         "engine_pks": np.asarray([str(k) for k in pks]),
         "engine_counts": np.asarray([result[k].count for k in pks]),
         "engine_sums": np.asarray([result[k].sum for k in pks]),
+        "hash_engine_counts": np.asarray(
+            [hash_result[k].count for k in pks]),
+        "hash_engine_sums": np.asarray(
+            [hash_result[k].sum for k in pks]),
         "mechanism_count": np.asarray([acc.mechanism_count]),
         "odometer_mechanisms": np.asarray([odo["mechanisms"]]),
         "odometer_spent_eps": np.asarray([odo["spent_epsilon"]],

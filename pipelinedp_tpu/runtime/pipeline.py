@@ -101,14 +101,26 @@ class ChunkSource:
 
     nonfinite: per-chunk NaN/Inf value policy ("error" | "drop"), the
         same semantics as ``ingest.stream_encode_columns``.
+    encode_mode: "host" | "hash_device" | None. None (the default)
+        defers to the backend's ``encode_mode`` knob; an explicit value
+        here overrides it per source. "hash_device" routes through the
+        on-device hash factorization (``device_encode.py``) — chunk
+        workers only hash, codes are assigned inside jit, partition-key
+        decode is deferred to DP-selected indices.
     """
 
-    def __init__(self, chunks: Iterable, nonfinite: str = "error"):
+    def __init__(self, chunks: Iterable, nonfinite: str = "error",
+                 encode_mode: Optional[str] = None):
         if nonfinite not in ("error", "drop"):
             raise ValueError(
                 f"nonfinite must be error|drop, got {nonfinite!r}")
+        if encode_mode is not None:
+            from pipelinedp_tpu import input_validators
+            input_validators.validate_encode_mode(encode_mode,
+                                                  "ChunkSource")
         self.chunks = chunks
         self.nonfinite = nonfinite
+        self.encode_mode = encode_mode
 
 
 def _validate_window(encode_threads: int, depth: int) -> None:
@@ -300,22 +312,22 @@ def _append_fn(donate: bool):
 
 
 @functools.lru_cache(maxsize=None)
-def _grow_fn(donate: bool):
+def _grow_fn(donate: bool, fills: tuple = (0, -1, 0)):
     """Jitted buffer growth to a larger power-of-two bucket; pad rows
-    carry the executor.pad_rows pad values (pid 0, pk -1, values 0) so
-    the tail is indistinguishable from a fresh pad."""
+    carry the accumulator's pad values (the executor.pad_rows pid 0 /
+    pk -1 / values 0 on the host-encoded route, hash sentinels on the
+    hash-device route) so the tail is indistinguishable from a fresh
+    pad."""
     import jax
     import jax.numpy as jnp
 
     def _grow_impl(bufs, new_cap: int):
-        pid, pk, values = bufs
-
         def grown(buf, fill):
             out = jnp.full((new_cap,) + buf.shape[1:], fill, buf.dtype)
             return jax.lax.dynamic_update_slice(out, buf,
                                                 (0,) * buf.ndim)
 
-        return grown(pid, 0), grown(pk, -1), grown(values, 0)
+        return tuple(grown(b, f) for b, f in zip(bufs, fills))
 
     jitted = jax.jit(_grow_impl, static_argnames=("new_cap",),
                      donate_argnums=(0,) if donate else ())
@@ -350,8 +362,14 @@ class DeviceRowAccumulator:
     retraced, and pipelined noise is the serial noise.
     """
 
-    def __init__(self, donate: Optional[bool] = None):
+    def __init__(self, donate: Optional[bool] = None,
+                 fills: tuple = (0, -1, 0)):
         self.donating = _donation_supported() if donate is None else donate
+        # Per-column pad values. The default is the executor.pad_rows
+        # convention (pid 0, pk -1, values 0); the hash-device encode
+        # route accumulates raw hash rows instead and pads with the
+        # uint32 sentinel so pad rows can never alias a real key hash.
+        self.fills = tuple(fills)
         self._n = 0  # real rows accumulated
         self._bufs = None  # donating mode: (pid, pk, values)
         self._staged = []  # staged mode: (pid, pk, values, n_real)
@@ -409,8 +427,8 @@ class DeviceRowAccumulator:
             cap = self._bufs[0].shape[0]
             need = self._n + pid.shape[0]
             if need > cap:
-                self._bufs = _grow_fn(True)(self._bufs,
-                                            new_cap=_pow2_at_least(need))
+                self._bufs = _grow_fn(True, self.fills)(
+                    self._bufs, new_cap=_pow2_at_least(need))
             self._bufs = _append_fn(True)(self._bufs, chunk_bufs,
                                           self._n)
             self._n += n_real
@@ -448,9 +466,12 @@ class DeviceRowAccumulator:
         pks = [trim(k, n) for _, k, _, n in self._staged]
         vals = [trim(v, n) for _, _, v, n in self._staged]
         if pad:
-            pids.append(jnp.zeros(pad, pids[0].dtype))
-            pks.append(jnp.full(pad, -1, pks[0].dtype))
+            f0, f1, f2 = self.fills
+            pids.append(
+                jnp.full((pad,) + pids[0].shape[1:], f0, pids[0].dtype))
+            pks.append(
+                jnp.full((pad,) + pks[0].shape[1:], f1, pks[0].dtype))
             vals.append(
-                jnp.zeros((pad,) + vals[0].shape[1:], vals[0].dtype))
+                jnp.full((pad,) + vals[0].shape[1:], f2, vals[0].dtype))
         return (jnp.concatenate(pids), jnp.concatenate(pks),
                 jnp.concatenate(vals))

@@ -113,6 +113,14 @@ REGISTRY: Dict[str, Metric] = {
         _counter("pipeline_chunks",
                  "chunks streamed through the ingest staging queue "
                  "(runtime/pipeline.map_overlapped)"),
+        _counter("pipeline_device_encode_chunks",
+                 "chunks accumulated through the hash-device encode "
+                 "route (raw hash columns streamed host->device; codes "
+                 "assigned on device by device_encode.factorize_codes)"),
+        _counter("ingest_hash_collisions",
+                 "64-bit key-hash collisions the hash-device encode "
+                 "detector caught (each one fell back to the exact host "
+                 "encoder or raised HashCollisionError)"),
         _counter("trace_dropped_events",
                  "trace events dropped because the bounded trace buffer "
                  "was full (trace_summary flags the epoch as truncated)"),

@@ -337,6 +337,11 @@ _SANCTIONED_FETCH_FUNCS = frozenset({
 # module exists to create.
 _DEVICE_RESIDENT_FILES = frozenset({
     "pipelinedp_tpu/runtime/pipeline.py",
+    # The hash-device encode module: raw hash columns stream host ->
+    # device once, codes are assigned inside jit, and the ONLY sanctioned
+    # device->host traffic is the unique-count control scalars and the
+    # O(kept) decode prefetch — all through mesh.host_fetch.
+    "pipelinedp_tpu/device_encode.py",
 })
 
 
@@ -764,6 +769,7 @@ KNOB_VALIDATORS: Dict[str, str] = {
     "trace": "validate_trace",
     "pipeline_depth": "validate_pipeline_depth",
     "encode_threads": "validate_encode_threads",
+    "encode_mode": "validate_encode_mode",
     "num_processes": "validate_num_processes",
     "coordinator_address": "validate_coordinator_address",
     "metrics_port": "validate_metrics_port",
