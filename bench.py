@@ -367,7 +367,12 @@ def _bench_service(on_tpu):
         was_traced = rt_trace.enabled()
         rt_trace.enable()  # the jit probe behind the reuse counts
         try:
-            with DPAggregationService(pdp.TPUBackend(),
+            # aot=True: the warm jobs dispatch through the process-wide
+            # executable cache — service_aot_retraces measures the AOT
+            # compiles the identical-spec REUSE jobs added on their own
+            # health records (0 = every tenant after the warm job
+            # executed with zero Python retraces).
+            with DPAggregationService(pdp.TPUBackend(aot=True),
                                       max_concurrent_jobs=4,
                                       queue_timeout_s=600.0) as svc:
                 # Warm job: compiles the shared entry points once.
@@ -383,6 +388,11 @@ def _bench_service(on_tpu):
                 latencies = sorted(h.latency_s for h in handles)
                 reuse_misses = sum(h.jit_cache_misses or 0
                                    for h in handles)
+                from pipelinedp_tpu.runtime import health as rt_health
+                aot_retraces = sum(
+                    rt_health.for_job(h.job_id).snapshot()
+                    ["counters"].get("aot_cache_misses", 0)
+                    for h in handles)
                 reconciled = svc.ledgers_reconciled()
         finally:
             if not was_traced:
@@ -396,6 +406,9 @@ def _bench_service(on_tpu):
                     latencies[min(len(latencies) - 1,
                                   int(len(latencies) * 0.99))], 4),
                 "service_compile_reuse_misses": reuse_misses,
+                # AOT compiles added by the 8 identical-spec reuse jobs
+                # on their own job records (the warm job paid them all).
+                "service_aot_retraces": aot_retraces,
                 "service_ledger_reconciled": reconciled,
                 "service_jobs": len(handles) + 1,
                 "service_tenants": 3,
@@ -539,6 +552,20 @@ def _bench_baseline_configs(jax, jnp, on_tpu):
 # it): the fused-kernel dispatch/drain pair, the streaming accumulator's
 # append/grow, and every probed jit entry point.
 _DEVICE_SPANS = ("dispatch", "drain", "pipeline_append", "pipeline_grow")
+
+
+def _probed_dispatches(summary):
+    """Device-dispatch events in a trace summary: every jit:* (traced
+    dispatch) and aot:* (cached-executable dispatch) entry-point call,
+    plus every pipeline_append (one host->device chunk landing — the
+    staged CPU accumulator dispatches transfers, not jit calls, so the
+    probe alone would under-count the ingest half). THE dispatch bill
+    of a warm run — what the fused release kernels, the batched appends
+    and the AOT cache exist to shrink."""
+    return sum(stats["count"]
+               for name, stats in summary.get("spans", {}).items()
+               if name.startswith(("jit:", "aot:")) or
+               name == "pipeline_append")
 
 
 def _overlap_efficiency(summary, total_s):
@@ -743,6 +770,63 @@ def _bench_end_to_end(on_tpu):
     rt_trace.reset()
     assert n_kept_device == n_kept_host_enc, (
         "device-encode release diverged from the host encode")
+
+    # --- Single-dispatch warm path (PR 14) over the same fine-grained
+    # 4K-chunk stream (the shape where per-dispatch overhead is
+    # visible). Three warm configurations, identical released bytes
+    # (bit-identity asserted in tests/test_aot.py + the dryrun):
+    #   legacy    — unfused release, serial drain, per-chunk appends
+    #               (the pre-PR14 path; the comparison baseline),
+    #   traced    — the default warm path (fused release + overlap +
+    #               batched appends) through jit's Python dispatch,
+    #   aot       — the default warm path through the AOT executable
+    #               cache (.lower().compile(), zero retraces).
+    # e2e_dispatch_count counts probed jit:/aot: entry-point calls per
+    # warm run; e2e_aot_speedup is traced/aot wall on identical work.
+    from pipelinedp_tpu.runtime import pipeline as rt_pipeline_mod
+
+    n_wp = min(n_de, 200_000)
+    wp_chunks = [(de_pid[i:i + de_chunk], de_pk[i:i + de_chunk],
+                  de_vals[i:i + de_chunk]) for i in range(0, n_wp, de_chunk)]
+
+    def run_warm_path(label, batch_rows, **kw):
+        prev_batch = rt_pipeline_mod.APPEND_BATCH_ROWS
+        rt_pipeline_mod.APPEND_BATCH_ROWS = batch_rows
+        try:
+            accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                                   total_delta=1e-6)
+            engine = pdp.DPEngine(
+                accountant,
+                pdp.TPUBackend(noise_seed=13, encode_threads=2, **kw))
+            start = time.perf_counter()
+            result = engine.aggregate(pdp.ChunkSource(iter(wp_chunks)),
+                                      params, extractors)
+            accountant.compute_budgets()
+            n_kept = sum(1 for _ in result)
+            return time.perf_counter() - start, n_kept
+        finally:
+            rt_pipeline_mod.APPEND_BATCH_ROWS = prev_batch
+
+    warm_path = {}
+    kept_counts = set()
+    for label, batch_rows, kw in (
+            ("legacy", 0, dict(fused_release=False)),
+            ("traced", rt_pipeline_mod.APPEND_BATCH_ROWS,
+             dict(overlap_drain=True)),
+            ("aot", rt_pipeline_mod.APPEND_BATCH_ROWS,
+             dict(aot=True, overlap_drain=True))):
+        run_warm_path(label, batch_rows, **kw)  # warm compiles/cache
+        with rt_trace.scoped():
+            with rt_trace.span("e2e_warm_" + label):
+                sec, kept = run_warm_path(label, batch_rows, **kw)
+            warm_path[label] = (sec, _probed_dispatches(
+                rt_trace.trace_summary()))
+        rt_trace.reset()
+        kept_counts.add(kept)
+    assert len(kept_counts) == 1, (
+        f"warm-path configurations diverged: {kept_counts}")
+    dispatch_reduction = (warm_path["legacy"][1] /
+                          max(warm_path["aot"][1], 1))
     os.unlink(path)
     # Note for cross-round comparisons: rounds <= 4 reported a single
     # compile-inclusive "end_to_end_sec"; that old key corresponds to
@@ -781,6 +865,24 @@ def _bench_end_to_end(on_tpu):
         "e2e_device_encode_second_warm_jit_cache_misses":
             device_second_warm_misses,
         "e2e_device_encode_phase_breakdown": device_breakdown,
+        # Single-dispatch warm path: probed jit:/aot: entry-point calls
+        # per warm run over the 4K-chunk stream (legacy = pre-PR14
+        # unfused/serial/per-chunk-append path), and the warm wall-clock
+        # ratio of the traced vs AOT-executable dispatch of the SAME
+        # fused path. Identical released bytes in all three modes.
+        "e2e_dispatch_count": {
+            "legacy": warm_path["legacy"][1],
+            "fused": warm_path["traced"][1],
+            "fused_aot": warm_path["aot"][1],
+            "reduction": round(dispatch_reduction, 2),
+        },
+        "e2e_sec_warm_legacy": round(warm_path["legacy"][0], 3),
+        "e2e_sec_warm_fused": round(warm_path["traced"][0], 3),
+        "e2e_sec_warm_aot": round(warm_path["aot"][0], 3),
+        "e2e_aot_speedup": round(
+            warm_path["traced"][0] / max(warm_path["aot"][0], 1e-9), 3),
+        "e2e_warm_path_speedup": round(
+            warm_path["legacy"][0] / max(warm_path["aot"][0], 1e-9), 3),
         "e2e_phase_breakdown": breakdown,
         "trace_summary": {
             "spans": dict(list(summary["spans"].items())[:12]),

@@ -53,8 +53,10 @@ from pipelinedp_tpu.ops import noise as noise_ops
 from pipelinedp_tpu.ops import secure_noise
 from pipelinedp_tpu.ops import segment_ops
 from pipelinedp_tpu.ops import selection_ops
+from pipelinedp_tpu.runtime import aot as rt_aot
 from pipelinedp_tpu.runtime import observability as rt_observability
 from pipelinedp_tpu.runtime import pipeline as rt_pipeline
+from pipelinedp_tpu.runtime import telemetry as rt_telemetry
 from pipelinedp_tpu.runtime import trace as rt_trace
 from pipelinedp_tpu.runtime import watchdog as rt_watchdog
 
@@ -880,10 +882,13 @@ def quantile_outputs(qrows, min_v, max_v, stds, key: jax.Array,
     }
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def aggregate_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
-                     stds, rng_key, cfg: KernelConfig, secure_tables=None):
-    """Single-device fused program: partial_columns + finalize."""
+def _aggregate_trace(pid, pk, values, valid, min_v, max_v, min_s, max_s,
+                     mid, stds, rng_key, cfg: KernelConfig,
+                     secure_tables=None):
+    """Traceable fused-aggregation body shared by aggregate_kernel and
+    the compacting aggregate_release_kernel — ONE copy of the op order
+    and key derivation, so the two entry points cannot release
+    different noise."""
     rows_key, final_key = jax.random.split(rng_key, 2)
     cols, qrows = partial_columns(pid, pk, values, valid, min_v, max_v, min_s,
                                   max_s, mid, rows_key, cfg)
@@ -897,11 +902,59 @@ def aggregate_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
     return outputs, keep, row_count
 
 
-# Compile/dispatch attribution (runtime/trace.probe_jit): calls that grow
-# the jit cache are counted as compiles with their wall seconds, per
-# entry point — the recompile bill trace summaries and the bench's
-# e2e_phase_breakdown separate from steady-state dispatch.
-aggregate_kernel = rt_trace.probe_jit("aggregate_kernel", aggregate_kernel)
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def aggregate_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+                     stds, rng_key, cfg: KernelConfig, secure_tables=None):
+    """Single-device fused program: partial_columns + finalize."""
+    return _aggregate_trace(pid, pk, values, valid, min_v, max_v, min_s,
+                            max_s, mid, stds, rng_key, cfg, secure_tables)
+
+
+def compact_release(outputs, keep):
+    """Kept-first compaction of the finalize outputs INSIDE the program:
+    stable argsort of ~keep puts kept partitions at the front in
+    ascending id order — exactly np.nonzero(keep) — so the host fetches
+    one scalar gate plus O(kept) values instead of the dense bool[P] +
+    [P] columns. The blocked block body (parallel/large_p._block_trace)
+    has always compacted this way; this is the dense route catching up.
+
+    Returns (n_kept, ids_sorted int32[P], outputs_sorted)."""
+    order = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+    outputs_sorted = {name: col[order] for name, col in outputs.items()}
+    return keep.sum(), order, outputs_sorted
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def aggregate_release_kernel(pid, pk, values, valid, min_v, max_v, min_s,
+                             max_s, mid, stds, rng_key, cfg: KernelConfig,
+                             secure_tables=None):
+    """The fused RELEASE program of the dense route: the whole
+    post-encode chain — contribution bounding, per-partition stats, DP
+    selection, noise, kept-first compaction — as ONE device program
+    (one launch, no intermediate host syncs; XLA reuses the stage
+    buffers in place inside the program, the donation the unfused
+    chain's separate dispatches could never express). Bit-identical to
+    aggregate_kernel + host-side np.nonzero decoding: the body is
+    _aggregate_trace verbatim, and compact_release orders kept
+    partitions exactly as nonzero would."""
+    outputs, keep, row_count = _aggregate_trace(
+        pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, stds,
+        rng_key, cfg, secure_tables)
+    n_kept, order, outputs_sorted = compact_release(outputs, keep)
+    return n_kept, order, outputs_sorted, row_count
+
+
+# Compile/dispatch attribution + AOT executable routing (runtime/aot.py
+# wraps runtime/trace.probe_jit): traced calls that grow the jit cache
+# are counted as compiles with their wall seconds per entry point, and
+# with the backend's aot knob on, warm calls execute the cached
+# .lower().compile() executable instead of re-entering jit's Python
+# dispatch.
+aggregate_kernel = rt_aot.aot_probe("aggregate_kernel", aggregate_kernel,
+                                    static_argnames=("cfg",))
+aggregate_release_kernel = rt_aot.aot_probe("aggregate_release_kernel",
+                                            aggregate_release_kernel,
+                                            static_argnames=("cfg",))
 
 
 def select_partition_counts(pid, pk, valid, key: jax.Array, l0: int,
@@ -979,8 +1032,9 @@ def select_kept_pair_stream(pid, pk, valid, rng_key, l0: int,
     return spk_sorted, kept_pair.sum()
 
 
-select_kept_pair_stream = rt_trace.probe_jit("select_kept_pair_stream",
-                                             select_kept_pair_stream)
+select_kept_pair_stream = rt_aot.aot_probe(
+    "select_kept_pair_stream", select_kept_pair_stream,
+    static_argnames=("l0", "n_partitions"))
 
 
 @functools.partial(jax.jit,
@@ -991,14 +1045,42 @@ def select_partitions_kernel(pid, pk, valid, rng_key, l0: int,
     """Standalone DP partition selection as ONE device program:
     select_partition_counts + the vectorized selection closed forms
     (ops/selection_ops.py). Returns keep: bool[n_partitions]."""
+    return _select_partitions_trace(pid, pk, valid, rng_key, l0,
+                                    n_partitions, selection)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("l0", "n_partitions", "selection"))
+def select_partitions_release_kernel(pid, pk, valid, rng_key, l0: int,
+                                     n_partitions: int,
+                                     selection:
+                                     selection_ops.SelectionParams):
+    """select_partitions_kernel + fused kept-first compaction: the host
+    fetches one scalar and O(kept) ids instead of the dense bool[P]
+    keep vector (compact_release ordering == np.nonzero(keep)).
+    Returns (n_kept, ids_sorted int32[n_partitions])."""
+    keep = _select_partitions_trace(pid, pk, valid, rng_key, l0,
+                                    n_partitions, selection)
+    order = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+    return keep.sum(), order
+
+
+def _select_partitions_trace(pid, pk, valid, rng_key, l0, n_partitions,
+                             selection):
+    """Shared traced body of the two standalone-selection entry points
+    (same split, same counting core — one copy of the release math)."""
     key_l0, key_sel = jax.random.split(rng_key)
     counts = select_partition_counts(pid, pk, valid, key_l0, l0,
                                      n_partitions)
     return selection_ops.sample_keep_decisions(key_sel, counts, selection)
 
 
-select_partitions_kernel = rt_trace.probe_jit("select_partitions_kernel",
-                                              select_partitions_kernel)
+select_partitions_kernel = rt_aot.aot_probe(
+    "select_partitions_kernel", select_partitions_kernel,
+    static_argnames=("l0", "n_partitions", "selection"))
+select_partitions_release_kernel = rt_aot.aot_probe(
+    "select_partitions_release_kernel", select_partitions_release_kernel,
+    static_argnames=("l0", "n_partitions", "selection"))
 
 
 def blocked_job_id(kind: str, static_config, noise_seed) -> str:
@@ -1030,6 +1112,12 @@ def _blocked_runtime_kwargs(backend, kind: str, static_config) -> dict:
     kwargs = dict(retry=getattr(backend, "retry", None),
                   journal=journal,
                   job_id=job_id)
+    # Compute/drain overlap (the drainer-thread mode of
+    # _dispatch_blocks): opt-in via TPUBackend(overlap_drain=True) —
+    # drain deadlines then include dispatch-side compile contention,
+    # so the default stays the serial consume loop.
+    if getattr(backend, "overlap_drain", False):
+        kwargs["overlap"] = True
     block_partitions = getattr(backend, "block_partitions", None)
     if block_partitions is not None:
         kwargs["block_partitions"] = block_partitions
@@ -1188,7 +1276,8 @@ def lazy_select_partitions(backend, col, params, data_extractors,
                 backend, "select",
                 (n_partitions, params.max_partitions_contributed, selection))
             with budget_accountant.no_new_mechanisms(
-                    "blocked partition selection execution"):
+                    "blocked partition selection execution"), \
+                    rt_aot.activate(getattr(backend, "aot", None)):
                 if backend.mesh is not None:
                     kept_ids = large_p.select_partitions_blocked_sharded(
                         backend.mesh, encoded.pid, encoded.pk, encoded.valid,
@@ -1210,17 +1299,21 @@ def lazy_select_partitions(backend, col, params, data_extractors,
                     # staticcheck: disable=release-taint — sanctioned release: partition keys are decoded ONLY at indices the DP selection kernel kept (noise + threshold); the selection mechanism registered with the ledger is the sanitizer
                     yield vocab[idx]
             return
+        fused = bool(getattr(backend, "fused_release", True))
+        aot_flag = getattr(backend, "aot", None)
         if backend.mesh is not None:
             from pipelinedp_tpu.parallel import sharded
             with budget_accountant.no_new_mechanisms(
-                    "sharded partition selection execution"):
-                keep = sharded.sharded_select_partitions(
+                    "sharded partition selection execution"), \
+                    rt_aot.activate(aot_flag):
+                result = sharded.sharded_select_partitions(
                     backend.mesh, encoded.pid, encoded.pk, encoded.valid,
                     key, params.max_partitions_contributed, n_partitions,
-                    selection,
+                    selection, fused=fused,
                     reshard=getattr(backend, "reshard", "auto"),
                     **_dense_runtime_kwargs(backend,
                                             "sharded_select_partitions"))
+                rt_telemetry.record("release_dispatches")
         else:
             # Selection never reads values; a zero-width column keeps
             # pad_rows from copying the real one. A COPY of the container —
@@ -1228,15 +1321,30 @@ def lazy_select_partitions(backend, col, params, data_extractors,
             slim = dataclasses.replace(
                 encoded, values=np.zeros((encoded.n_rows, 0), np.float64))
             pid, pk, _, valid = pad_rows(slim)
-            with rt_trace.span("dispatch"):
-                keep = select_partitions_kernel(
+            with rt_trace.span("dispatch"), rt_aot.activate(aot_flag):
+                kernel = (select_partitions_release_kernel
+                          if fused else select_partitions_kernel)
+                result = kernel(
                     jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(valid),
                     key, params.max_partitions_contributed, n_partitions,
                     selection)
+                rt_telemetry.record("release_dispatches")
         vocab = encoded.partition_vocab
         n_real = len(vocab)
         with rt_trace.span("drain"):
-            kept_idx = np.nonzero(np.asarray(keep))[0]
+            if fused:
+                # Fused compaction: one scalar gate, then exactly
+                # O(kept) ids cross the link (same ascending order as
+                # np.nonzero over the dense keep vector).
+                n_kept, order = result
+                k = int(n_kept)
+                ids = order[:k]
+                rt_pipeline.copy_to_host_async(ids)
+                kept_idx = np.asarray(ids)
+                rt_telemetry.record("release_dispatches", 2)
+            else:
+                kept_idx = np.nonzero(np.asarray(result))[0]
+                rt_telemetry.record("release_dispatches")
         with rt_trace.span("post_process"):
             if hasattr(vocab, "prefetch"):
                 vocab.prefetch(idx for idx in kept_idx if idx < n_real)
@@ -1470,7 +1578,8 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
             # registered at graph-build time above, and a registration
             # here would double-spend the budget.
             with budget_accountant.no_new_mechanisms(
-                    "blocked aggregation execution"):
+                    "blocked aggregation execution"), \
+                    rt_aot.activate(getattr(backend, "aot", None)):
                 if backend.mesh is not None:
                     kept_ids, blocked_outputs = \
                         large_p.aggregate_blocked_sharded(
@@ -1493,27 +1602,42 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
                                                   compound)
             return
         pid, pk, values, valid = pad_rows(encoded)
+        fused = bool(getattr(backend, "fused_release", True))
+        aot_flag = getattr(backend, "aot", None)
         with budget_accountant.no_new_mechanisms(
-                "fused aggregation execution"):
+                "fused aggregation execution"), rt_aot.activate(aot_flag):
             if backend.mesh is not None:
                 from pipelinedp_tpu.parallel import sharded
-                outputs, keep, _ = sharded.sharded_aggregate_arrays(
+                result = sharded.sharded_aggregate_arrays(
                     backend.mesh, pid, pk, values, valid, min_v, max_v,
                     min_s, max_s, mid, stds, key, cfg, secure_tables,
+                    fused=fused,
                     reshard=getattr(backend, "reshard", "auto"),
                     **_dense_runtime_kwargs(backend,
                                             "sharded_aggregate_arrays"))
             else:
                 with rt_trace.span("dispatch"):
-                    outputs, keep, _ = aggregate_kernel(
+                    kernel = (aggregate_release_kernel
+                              if fused else aggregate_kernel)
+                    result = kernel(
                         jnp.asarray(pid), jnp.asarray(pk),
                         jnp.asarray(values), jnp.asarray(valid), min_v,
                         max_v, min_s, max_s, mid, jnp.asarray(stds), key,
                         cfg, secure_tables)
+            rt_telemetry.record("release_dispatches")
         with rt_trace.span("post_process"):
-            # staticcheck: disable=release-taint — sanctioned release: decode_results emits only partitions the fused kernel's DP selection kept, and the output columns carry the kernel's noise
-            yield from decode_results(outputs, keep,
-                                      encoded.partition_vocab, compound)
+            if fused:
+                n_kept, order, outputs, _ = result
+                # staticcheck: disable=release-taint — sanctioned release: the compacted ids/columns are the fused kernel's DP-selected partitions and its noised outputs, reordered kept-first inside the program
+                yield from decode_release_results(n_kept, order, outputs,
+                                                  encoded.partition_vocab,
+                                                  compound)
+            else:
+                outputs, keep, _ = result
+                # staticcheck: disable=release-taint — sanctioned release: decode_results emits only partitions the fused kernel's DP selection kept, and the output columns carry the kernel's noise
+                yield from decode_results(outputs, keep,
+                                          encoded.partition_vocab,
+                                          compound)
 
     return generator()
 
@@ -1537,6 +1661,7 @@ def _decode_rows(outputs, row_idx_pairs, partition_vocab: Sequence[Any],
             if isinstance(col, jax.Array):
                 rt_pipeline.copy_to_host_async(col)
         outputs_np = {name: np.asarray(col) for name, col in outputs.items()}
+        rt_telemetry.record("release_dispatches")
     field_order: List[str] = [
         name for entry in build_plan(compound) for name in entry.outputs
     ]
@@ -1574,4 +1699,24 @@ def decode_results(outputs, keep, partition_vocab: Sequence[Any],
     """Device arrays -> [(partition_key, MetricsTuple)], matching the generic
     path's namedtuple field order (per-child compute_metrics dict order)."""
     kept = np.nonzero(np.asarray(keep))[0]
+    rt_telemetry.record("release_dispatches")
     return _decode_rows(outputs, zip(kept, kept), partition_vocab, compound)
+
+
+def decode_release_results(n_kept, order, outputs,
+                           partition_vocab: Sequence[Any],
+                           compound: dp_combiners.CompoundCombiner):
+    """Compacted fused-release output (aggregate_release_kernel /
+    sharded fused route) -> results. One scalar sync gates the O(kept)
+    slices; every slice's host copy starts before the single barrier in
+    _decode_rows (the same overlapped-drain discipline as the blocked
+    drivers' staged drains). Emits the exact stream decode_results
+    yields for the unfused (outputs, keep) pair."""
+    k = int(n_kept)  # the one sync; gates O(kept) transfers
+    rt_telemetry.record("release_dispatches")
+    ids = order[:k]
+    sliced = {name: col[:k] for name, col in outputs.items()}
+    if isinstance(ids, jax.Array):
+        rt_pipeline.copy_to_host_async(ids)
+    return _decode_rows(sliced, enumerate(np.asarray(ids)),
+                        partition_vocab, compound)

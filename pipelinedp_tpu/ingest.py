@@ -733,7 +733,8 @@ def _stream_encode_pipelined(chunks, partition_vocab, nonfinite,
     from pipelinedp_tpu.runtime import pipeline as rt_pipeline
     from pipelinedp_tpu.runtime import trace as rt_trace
 
-    acc = rt_pipeline.DeviceRowAccumulator()
+    acc = rt_pipeline.DeviceRowAccumulator(
+        batch_rows=rt_pipeline.APPEND_BATCH_ROWS)
     worker = functools.partial(_prepare_chunk,
                                partition_vocab=partition_vocab,
                                nonfinite=nonfinite,
@@ -755,7 +756,7 @@ def _stream_encode_pipelined(chunks, partition_vocab, nonfinite,
             values = prep.values
             if n == 0:
                 continue
-            if acc.donating:
+            if acc.donating and not acc.batch_rows:
                 pid, pk, values = _pad_chunk_rows(
                     pid, pk, values, executor.row_bucket(n))
             acc.append(pid, pk, values, n, chunk=idx)
@@ -818,7 +819,8 @@ def _stream_encode_hash_device(chunks, public_partitions, nonfinite,
     reiterable = iter(chunks) is not chunks
     sent32 = int(device_encode._U32_MAX)
     fills = (sent32, -1 if public else sent32, 0)
-    acc = rt_pipeline.DeviceRowAccumulator(fills=fills)
+    acc = rt_pipeline.DeviceRowAccumulator(
+        fills=fills, batch_rows=rt_pipeline.APPEND_BATCH_ROWS)
     pid_u1, pid_u2, pid_pos = [], [], []
     pk_u1, pk_u2, pk_keys, pk_pos = [], [], [], []
     worker = functools.partial(_prepare_hash_chunk,
@@ -851,7 +853,7 @@ def _stream_encode_hash_device(chunks, public_partitions, nonfinite,
                 continue
             pid_col, pk_col, values = (prep.pid_hash, prep.pk_col,
                                        prep.values)
-            if acc.donating:
+            if acc.donating and not acc.batch_rows:
                 pid_col, pk_col, values = _pad_chunk_rows(
                     pid_col, pk_col, values, executor.row_bucket(n),
                     fills)

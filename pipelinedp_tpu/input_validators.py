@@ -353,6 +353,54 @@ def validate_shed_watermark_fraction(shed_watermark_fraction,
             f"this fraction of the memory limit.")
 
 
+def validate_aot(aot, obj_name: str) -> None:
+    """Validates the ahead-of-time executable-cache switch: a plain bool.
+
+    Raises:
+        ValueError: aot is not a bool (a truthy non-bool — say a cache
+        object or a path passed by mistake — would silently route every
+        warm dispatch through the AOT executable cache).
+    """
+    if not isinstance(aot, bool):
+        raise ValueError(
+            f"{obj_name}: aot must be a bool, but {aot!r} given (True "
+            f"routes warm-path entry points through the process-wide "
+            f".lower().compile() executable cache, runtime/aot.py).")
+
+
+def validate_fused_release(fused_release, obj_name: str) -> None:
+    """Validates the fused-release-kernel switch: a plain bool.
+
+    Raises:
+        ValueError: fused_release is not a bool (a truthy non-bool would
+        silently flip the dense routes between the one-program
+        compacting release and the unfused kernel + host nonzero chain).
+    """
+    if not isinstance(fused_release, bool):
+        raise ValueError(
+            f"{obj_name}: fused_release must be a bool, but "
+            f"{fused_release!r} given (True fuses DP selection, noise "
+            f"and kept-first compaction into one device program with an "
+            f"O(kept) drain; outputs are bit-identical either way).")
+
+
+def validate_overlap_drain(overlap_drain, obj_name: str) -> None:
+    """Validates the compute/drain-overlap switch: a plain bool.
+
+    Raises:
+        ValueError: overlap_drain is not a bool (a truthy non-bool —
+        say a thread count — would silently choose between the
+        drainer-thread and serial consume modes of the blocked
+        drivers).
+    """
+    if not isinstance(overlap_drain, bool):
+        raise ValueError(
+            f"{obj_name}: overlap_drain must be a bool, but "
+            f"{overlap_drain!r} given (True drains block b on a "
+            f"dedicated thread while block b+1 dispatches; results are "
+            f"bit-identical either way).")
+
+
 def validate_journal(journal, obj_name: str) -> None:
     """Validates a BlockJournal-shaped object: get/put record accessors.
 

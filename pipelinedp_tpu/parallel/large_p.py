@@ -65,6 +65,7 @@ TPUBackend.health().
 import dataclasses
 import functools
 import logging
+import threading
 import time
 from typing import Dict, Optional, Tuple
 
@@ -76,6 +77,7 @@ from pipelinedp_tpu import executor
 # Canonical shape arithmetic lives with the mesh helpers; re-exported here
 # because the blocked path made the name public first.
 from pipelinedp_tpu.parallel.mesh import host_fetch, round_capacity
+from pipelinedp_tpu.runtime import aot as rt_aot
 from pipelinedp_tpu.runtime import entry as rt_entry
 from pipelinedp_tpu.runtime import faults as rt_faults
 from pipelinedp_tpu.runtime import journal as rt_journal
@@ -144,8 +146,9 @@ def _bounded_compact_kernel(pid, pk, values, valid, min_v, max_v, min_s,
                                 max_s, mid, key, cfg)
 
 
-_bounded_compact_kernel = rt_trace.probe_jit("blocked_bound_compact",
-                                             _bounded_compact_kernel)
+_bounded_compact_kernel = rt_aot.aot_probe("blocked_bound_compact",
+                                           _bounded_compact_kernel,
+                                           static_argnames=("cfg",))
 
 
 def _block_trace(spk_s, pair_s, cols_s, leaf_s, lo, length, base, min_v,
@@ -210,8 +213,9 @@ def _block_kernel_dev(spk_s, pair_s, cols_s, leaf_s, lo, length, base, min_v,
                         secure_tables)
 
 
-_block_kernel_dev = rt_trace.probe_jit("blocked_block_kernel",
-                                       _block_kernel_dev)
+_block_kernel_dev = rt_aot.aot_probe("blocked_block_kernel",
+                                     _block_kernel_dev,
+                                     static_argnames=("cfg", "cap"))
 
 
 def _chunk_ends(pid_sorted: np.ndarray, row_chunk: int) -> np.ndarray:
@@ -286,8 +290,8 @@ def _sync_scalars(result) -> None:
 
 def _dispatch_blocks(block_iter, consume,
                      max_in_flight: int = PIPELINE_DEPTH,
-                     retry_policy: Optional[rt_retry.RetryPolicy] = None
-                     ) -> int:
+                     retry_policy: Optional[rt_retry.RetryPolicy] = None,
+                     overlap: bool = False) -> int:
     """Bounded-window async block dispatch shared by every blocked driver.
 
     jax execution is async, so the device pipelines upcoming block kernels
@@ -307,6 +311,25 @@ def _dispatch_blocks(block_iter, consume,
     caller can re-plan from exactly the failed block.
     `consume(block_index, result)` syncs and drains one block. Returns
     the number of blocks dispatched (replays excluded).
+
+    overlap=True (TPUBackend(overlap_drain=True); off by default) runs
+    consume() on a dedicated drainer thread: block b's drain sync,
+    journal fsync and staged transfers come OFF the dispatch thread, so
+    block b+1's dispatch is issued while b is still draining (true
+    compute/drain double-buffering — the serial mode only overlapped up
+    to the window boundary, then blocked the dispatch loop on the
+    oldest drain). Opt-in because drain deadlines now measure wall time
+    that includes dispatch-side compile contention: on a shared-core
+    host a watchdog-armed run can spiral (drain starves behind a
+    compile -> deadline expiry -> retry/degrade -> more compiles), so
+    pair overlap with a generous timeout_s or none. The drainer runs
+    under the dispatch thread's watchdog, health scope, fault schedule
+    and AOT activation; blocks are consumed strictly FIFO on the one
+    thread, so journal records, result order and fold_in keys are
+    bit-identical to overlap=False — asserted in tests — and a drain
+    failure surfaces on the dispatch thread with the same
+    classification (BlockOOMError for degradable faults) after the
+    earlier in-flight blocks have drained.
     """
     policy = retry_policy or rt_retry.DEFAULT_POLICY
     pending = []
@@ -317,6 +340,7 @@ def _dispatch_blocks(block_iter, consume,
         # timeline alongside the watchdog's "dispatch" heartbeats/guards.
         with rt_trace.span("dispatch", block=b):
             result = rt_retry.retry_call(make, policy, block=b)
+        rt_telemetry.record("release_dispatches", block=b)
         # Start the host copy of each scalar output (the n_kept gates) at
         # dispatch time: by the time consume() syncs on it, the value has
         # already crossed the link — int(n_kept) would otherwise pay one
@@ -379,6 +403,10 @@ def _dispatch_blocks(block_iter, consume,
             raise
 
     active_wd = rt_watchdog.active()
+    if overlap and max_in_flight > 1:
+        return _dispatch_blocks_overlapped(block_iter, start,
+                                           consume_or_oom, max_in_flight,
+                                           active_wd, _degradable)
     for b, entry in block_iter:
         if active_wd is not None:
             active_wd.beat("dispatch")
@@ -413,9 +441,122 @@ def _dispatch_blocks(block_iter, consume,
     return n_dispatched
 
 
+def _dispatch_blocks_overlapped(block_iter, start, consume_or_oom,
+                                max_in_flight: int, active_wd,
+                                _degradable) -> int:
+    """The drainer-thread mode of _dispatch_blocks (see its docstring).
+
+    The dispatch thread only issues device work and enqueues (b, result,
+    make) triples into a bounded FIFO; one drainer thread syncs,
+    journals and stages every block in order. The queue bound IS the
+    in-flight window (a full queue blocks the enqueue — the same
+    backpressure the serial pending list applied), so HBM residency is
+    unchanged. Thread-scoped runtime context (watchdog activation,
+    health job scope, fault schedule, AOT routing) is captured on the
+    dispatch thread and re-activated on the drainer, so drain guards,
+    counter attribution and injected consume faults behave exactly as
+    in serial mode."""
+    import queue as _queue
+
+    from pipelinedp_tpu.runtime import health as rt_health
+
+    job_health = rt_health.current()
+    fault_schedule = rt_faults.active()
+    aot_on = rt_aot.enabled()
+    drain_q: "_queue.Queue" = _queue.Queue(maxsize=max_in_flight)
+    drain_err: list = []
+    n_dispatched = 0
+
+    def drainer():
+        import contextlib as _ctx
+        fault_scope = (rt_faults.inject(fault_schedule)
+                       if fault_schedule is not None else
+                       _ctx.nullcontext())
+        with rt_health.track(job_health), rt_watchdog.activate(active_wd), \
+                rt_aot.activate(aot_on), fault_scope:
+            while True:
+                item = drain_q.get()
+                if item is None:
+                    return
+                if drain_err:
+                    # A failed block poisons the rest of the window: the
+                    # serial mode would never have consumed them either
+                    # (their journal records would land AFTER the failed
+                    # block's on a resume — out-of-order durability).
+                    continue
+                try:
+                    consume_or_oom(*item)
+                except BaseException as e:  # noqa: BLE001 - transported to the dispatch thread verbatim; consume_or_oom already classified it
+                    drain_err.append(e)
+
+    thread = threading.Thread(target=drainer, name="pdp-block-drain",
+                              daemon=True)
+    thread.start()
+    dispatch_err = None
+    failed_block = None
+    try:
+        for b, entry in block_iter:
+            if active_wd is not None:
+                active_wd.beat("dispatch")
+            if drain_err:
+                break
+            if isinstance(entry, _Replay):
+                drain_q.put((b, entry, None))
+                continue
+            n_dispatched += 1
+            try:
+                result = start(b, entry)
+            except Exception as err:  # noqa: BLE001 - classified after the in-flight drain below, exactly like serial mode
+                dispatch_err, failed_block = err, b
+                break
+            drain_q.put((b, result, entry))
+    finally:
+        # Sentinel AFTER everything queued: the drainer finishes draining
+        # the in-flight window (journal durability for earlier blocks)
+        # before the dispatch thread surfaces any failure.
+        drain_q.put(None)
+        thread.join()
+    if dispatch_err is not None:
+        if drain_err:
+            logging.exception(
+                "draining in-flight blocks after a dispatch failure "
+                "itself failed; earlier results may be incomplete",
+                exc_info=drain_err[0])
+        if _degradable(dispatch_err):
+            raise rt_retry.BlockOOMError(failed_block,
+                                         dispatch_err) from dispatch_err
+        raise dispatch_err
+    if drain_err:
+        raise drain_err[0]
+    return n_dispatched
+
+
 # The async-copy helper moved to runtime/pipeline.py (the dense
 # executor's drain shares it); the historical name stays importable.
 _copy_to_host_async = rt_pipeline.copy_to_host_async
+
+
+def _materialize_block_record(ids_sorted, outputs_sorted, k: int,
+                              b_base: int) -> rt_journal.BlockRecord:
+    """O(kept) journal-record materialization with overlapped copies.
+
+    Every output slice's device->host copy starts BEFORE the first
+    blocking np.asarray — the same discipline as the dense executor's
+    _decode_rows drain. The journaled consume paths used to materialize
+    ids + each column serially (one blocking round trip per array,
+    the async-drain asymmetry); now the transfers overlap each other
+    and the still-running block compute, and the np.asarray barrier
+    waits once for the batch."""
+    ids = ids_sorted[:k]
+    cols = {name: col[:k] for name, col in outputs_sorted.items()}
+    _copy_to_host_async(ids)
+    for col in cols.values():
+        _copy_to_host_async(col)
+    rt_telemetry.record("release_dispatches")
+    return rt_journal.BlockRecord(
+        ids=np.asarray(ids).astype(np.int64) + b_base,  # staticcheck: disable=host-transfer — O(kept) journal materialization gated by the n_kept sync; the copy was started async above
+        outputs={name: np.asarray(col)  # staticcheck: disable=host-transfer — O(kept) journal materialization; all column copies started async above, this barrier waits for the batch
+                 for name, col in cols.items()})
 
 
 class _StagedDrain:
@@ -584,8 +725,9 @@ def _sharded_bound_compact(pid, pk, values, valid, min_v, max_v, min_s,
     return fn(pid, pk, values, valid, rows_key, boundaries)
 
 
-_sharded_bound_compact = rt_trace.probe_jit("sharded_bound_compact",
-                                            _sharded_bound_compact)
+_sharded_bound_compact = rt_aot.aot_probe("sharded_bound_compact",
+                                          _sharded_bound_compact,
+                                          static_argnames=("cfg", "mesh"))
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "cap", "mesh"))
@@ -625,8 +767,9 @@ def _sharded_block_kernel(spk_all, pair_all, cols_all, leaf_all, lo_r, len_r,
               secure_tables)
 
 
-_sharded_block_kernel = rt_trace.probe_jit("sharded_block_kernel",
-                                           _sharded_block_kernel)
+_sharded_block_kernel = rt_aot.aot_probe(
+    "sharded_block_kernel", _sharded_block_kernel,
+    static_argnames=("cfg", "cap", "mesh"))
 
 
 @functools.partial(jax.jit, static_argnames=("mesh",))
@@ -651,8 +794,9 @@ def _sharded_block_offsets(spk_all, boundaries, mesh):
     return fn(spk_all, boundaries)
 
 
-_sharded_block_offsets = rt_trace.probe_jit("sharded_block_offsets",
-                                            _sharded_block_offsets)
+_sharded_block_offsets = rt_aot.aot_probe("sharded_block_offsets",
+                                          _sharded_block_offsets,
+                                          static_argnames=("mesh",))
 
 
 def _block_boundaries(base: int, capacity: int, n_blocks: int) -> np.ndarray:
@@ -684,6 +828,7 @@ def aggregate_blocked_sharded(mesh,
                               block_partitions: int = 1 << 20,
                               secure_tables=None,
                               reshard: str = "auto",
+                              overlap: bool = False,
                               retry: Optional[rt_retry.RetryPolicy] = None,
                               journal: Optional[rt_journal.BlockJournal] = None,
                               job_id: Optional[str] = None
@@ -787,12 +932,9 @@ def aggregate_blocked_sharded(mesh,
             n_kept, ids_sorted, outputs_sorted = result
             k = int(n_kept)  # sync; gates O(kept) transfers
             if journal is not None:
-                record = rt_journal.BlockRecord(
-                    ids=np.asarray(ids_sorted[:k]).astype(np.int64) + b_base,
-                    outputs={
-                        name: np.asarray(col[:k])
-                        for name, col in outputs_sorted.items()
-                    })
+                record = _materialize_block_record(ids_sorted,
+                                                   outputs_sorted, k,
+                                                   b_base)
                 journal.put(job, rt_journal.block_key(b_base, C), record)
                 append_record(record)
             elif k:
@@ -828,7 +970,8 @@ def aggregate_blocked_sharded(mesh,
                     _block_noise_key(final_key, gen, j), cfg_block,
                     round_capacity(int(lens.max())), mesh, secure_tables))
 
-        _dispatch_blocks(block_iter(), consume, retry_policy=retry)
+        _dispatch_blocks(block_iter(), consume, retry_policy=retry,
+                         overlap=overlap)
 
     rt_retry.run_with_degradation(run_range, P, C0, journal=journal,
                                   job_id=job)
@@ -878,8 +1021,9 @@ def _selection_block_kernel(spk_kept, lo, length, base, c_actual, key,
                                   selection, cap)
 
 
-_selection_block_kernel = rt_trace.probe_jit("selection_block_kernel",
-                                             _selection_block_kernel)
+_selection_block_kernel = rt_aot.aot_probe(
+    "selection_block_kernel", _selection_block_kernel,
+    static_argnames=("c_actual", "selection", "cap"))
 
 
 @functools.partial(jax.jit,
@@ -914,8 +1058,9 @@ def _sharded_select_compact(pid, pk, valid, rows_key, boundaries, l0: int,
     return fn(pid, pk, valid, rows_key, boundaries)
 
 
-_sharded_select_compact = rt_trace.probe_jit("sharded_select_compact",
-                                             _sharded_select_compact)
+_sharded_select_compact = rt_aot.aot_probe(
+    "sharded_select_compact", _sharded_select_compact,
+    static_argnames=("l0", "n_partitions", "mesh"))
 
 
 @functools.partial(jax.jit,
@@ -942,8 +1087,9 @@ def _sharded_selection_block(spk_all, lo_r, len_r, base, c_actual, key,
     return fn(spk_all, lo_r, len_r, key)
 
 
-_sharded_selection_block = rt_trace.probe_jit("sharded_selection_block",
-                                              _sharded_selection_block)
+_sharded_selection_block = rt_aot.aot_probe(
+    "sharded_selection_block", _sharded_selection_block,
+    static_argnames=("c_actual", "selection", "cap", "mesh"))
 
 
 @_runtime_entry("select_partitions_blocked_sharded",
@@ -959,6 +1105,7 @@ def select_partitions_blocked_sharded(mesh,
                                       *,
                                       block_partitions: int = 1 << 20,
                                       reshard: str = "auto",
+                                      overlap: bool = False,
                                       retry: Optional[
                                           rt_retry.RetryPolicy] = None,
                                       journal: Optional[
@@ -1034,7 +1181,12 @@ def select_partitions_blocked_sharded(mesh,
             n_kept, order = result
             k = int(n_kept)  # sync; gates the O(kept) transfer
             if journal is not None:
-                ids = np.asarray(order[:k]).astype(np.int64) + b_base
+                kept = order[:k]
+                # Async-copy before the blocking materialization (the
+                # dense _decode_rows discipline, shared via
+                # _materialize_block_record on the aggregate routes).
+                _copy_to_host_async(kept)
+                ids = np.asarray(kept).astype(np.int64) + b_base  # staticcheck: disable=host-transfer — O(kept) journal materialization; the copy was started async on the line above
                 journal.put(job, rt_journal.block_key(b_base, C),
                             rt_journal.BlockRecord(ids=ids, outputs={}))
                 if k:
@@ -1067,7 +1219,8 @@ def select_partitions_blocked_sharded(mesh,
                     _block_noise_key(key_sel, gen, j), selection,
                     round_capacity(int(lens.max())), mesh))
 
-        _dispatch_blocks(block_iter(), consume, retry_policy=retry)
+        _dispatch_blocks(block_iter(), consume, retry_policy=retry,
+                         overlap=overlap)
 
     rt_retry.run_with_degradation(run_range, P, C0, journal=journal,
                                   job_id=job)
@@ -1088,6 +1241,7 @@ def select_partitions_blocked(pid,
                               selection,
                               *,
                               block_partitions: int = 1 << 20,
+                              overlap: bool = False,
                               retry: Optional[rt_retry.RetryPolicy] = None,
                               journal: Optional[
                                   rt_journal.BlockJournal] = None,
@@ -1142,7 +1296,12 @@ def select_partitions_blocked(pid,
             n_kept, order = result
             k = int(n_kept)  # sync; gates the O(kept) transfer
             if journal is not None:
-                ids = np.asarray(order[:k]).astype(np.int64) + b_base
+                kept = order[:k]
+                # Async-copy before the blocking materialization (the
+                # dense _decode_rows discipline, shared via
+                # _materialize_block_record on the aggregate routes).
+                _copy_to_host_async(kept)
+                ids = np.asarray(kept).astype(np.int64) + b_base  # staticcheck: disable=host-transfer — O(kept) journal materialization; the copy was started async on the line above
                 journal.put(job, rt_journal.block_key(b_base, C),
                             rt_journal.BlockRecord(ids=ids, outputs={}))
                 if k:
@@ -1175,7 +1334,8 @@ def select_partitions_blocked(pid,
                     b_base, c_actual, _block_noise_key(key_sel, gen, j),
                     selection, round_capacity(hi - lo)))
 
-        _dispatch_blocks(block_iter(), consume, retry_policy=retry)
+        _dispatch_blocks(block_iter(), consume, retry_policy=retry,
+                         overlap=overlap)
 
     rt_retry.run_with_degradation(run_range, P, C0, journal=journal,
                                   job_id=job)
@@ -1208,6 +1368,7 @@ def aggregate_blocked(pid,
                       row_chunk: int = 1 << 24,
                       secure_tables=None,
                       phase_times: Optional[dict] = None,
+                      overlap: bool = False,
                       retry: Optional[rt_retry.RetryPolicy] = None,
                       journal: Optional[rt_journal.BlockJournal] = None,
                       job_id: Optional[str] = None
@@ -1350,14 +1511,12 @@ def aggregate_blocked(pid,
                 # Journaled runs materialize per block (one sync each) so
                 # the record is durable the moment the block is consumed —
                 # the overlap the staged drain buys is traded for
-                # crash-resumability.
-                record = rt_journal.BlockRecord(
-                    ids=np.asarray(ids_sorted[:k]).astype(np.int64) +
-                    b_base,
-                    outputs={
-                        name: np.asarray(col[:k])
-                        for name, col in outputs_sorted.items()
-                    })
+                # crash-resumability (the overlapped drainer thread takes
+                # that sync off the dispatch path; the copies themselves
+                # still batch through copy_to_host_async).
+                record = _materialize_block_record(ids_sorted,
+                                                   outputs_sorted, k,
+                                                   b_base)
                 journal.put(job, rt_journal.block_key(b_base, C), record)
                 append_record(record)
             elif k:
@@ -1407,7 +1566,8 @@ def aggregate_blocked(pid,
                     round_capacity(hi - lo), secure_tables))
 
         n_dispatched_total += _dispatch_blocks(block_iter(), consume,
-                                               retry_policy=retry)
+                                               retry_policy=retry,
+                                               overlap=overlap)
 
     t2 = time.perf_counter()
     rt_retry.run_with_degradation(run_range, P, C0, journal=journal,

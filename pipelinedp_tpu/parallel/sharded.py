@@ -38,6 +38,7 @@ from pipelinedp_tpu import executor
 from pipelinedp_tpu.ops import selection_ops
 from pipelinedp_tpu.parallel.mesh import SHARD_AXIS, round_capacity, shard_map
 from pipelinedp_tpu.parallel.reshard import stage_rows_to_mesh
+from pipelinedp_tpu.runtime import aot as rt_aot
 from pipelinedp_tpu.runtime import entry as rt_entry
 from pipelinedp_tpu.runtime import retry as rt_retry
 from pipelinedp_tpu.runtime import trace as rt_trace
@@ -135,6 +136,61 @@ def _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
     return fn(pid, pk, values, valid, stds, rng_key, secure_tables)
 
 
+@partial(jax.jit, static_argnames=("cfg", "mesh"))
+def _sharded_release_kernel(pid, pk, values, valid, min_v, max_v, min_s,
+                            max_s, mid, stds, rng_key,
+                            cfg: executor.KernelConfig, mesh: Mesh,
+                            secure_tables=None):
+    """The fused-release form of _sharded_kernel: the same per-shard
+    body, then kept-first compaction (executor.compact_release) fused
+    into the SAME program — selection/noise/compaction run replicated
+    over already-psum'd columns, so every device holds identical
+    O(kept)-transferable results and the driver fetches one scalar gate
+    instead of the dense bool[P] + [P] columns."""
+
+    def per_shard(pid_s, pk_s, values_s, valid_s, stds_r, key_r, tables_r):
+        shard_idx = jax.lax.axis_index(SHARD_AXIS)
+        rows_key, final_key = jax.random.split(key_r, 2)
+        shard_rows_key = jax.random.fold_in(rows_key, shard_idx)
+        cols, qrows = executor.partial_columns(pid_s, pk_s, values_s, valid_s,
+                                               min_v, max_v, min_s, max_s,
+                                               mid, shard_rows_key, cfg)
+        cols = jax.tree.map(lambda x: jax.lax.psum(x, SHARD_AXIS), cols)
+        outputs, keep, row_count = executor.finalize(cols, min_v, mid, stds_r,
+                                                     final_key, cfg, tables_r)
+        if cfg.quantiles:
+            qkey = jax.random.fold_in(key_r, 7919)
+            outputs.update(
+                executor.quantile_outputs(qrows, min_v, max_v, stds_r, qkey,
+                                          cfg, psum_axis=SHARD_AXIS,
+                                          secure_tables=tables_r))
+        n_kept, order, outputs_sorted = executor.compact_release(
+            outputs, keep)
+        return n_kept, order, outputs_sorted, row_count
+
+    fn = shard_map(per_shard,
+                   mesh=mesh,
+                   in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                             P(SHARD_AXIS), P(), P(), P()),
+                   out_specs=P())
+    return fn(pid, pk, values, valid, stds, rng_key, secure_tables)
+
+
+def _select_per_shard_trace(pid_s, pk_s, valid_s, key_r, l0, n_partitions,
+                            selection):
+    """Shared per-shard selection body of the two meshed entry points."""
+    shard_idx = jax.lax.axis_index(SHARD_AXIS)
+    key_l0, key_sel = jax.random.split(key_r)
+    # Distinct pair-sampling randomness per shard (rows of one privacy
+    # id all live on one shard, so L0 sampling stays shard-local);
+    # identical selection key, so every shard holds the same keep mask.
+    counts = executor.select_partition_counts(
+        pid_s, pk_s, valid_s, jax.random.fold_in(key_l0, shard_idx), l0,
+        n_partitions)
+    counts = jax.lax.psum(counts, SHARD_AXIS)
+    return selection_ops.sample_keep_decisions(key_sel, counts, selection)
+
+
 @partial(jax.jit,
          static_argnames=("l0", "n_partitions", "selection", "mesh"))
 def _sharded_select_kernel(pid, pk, valid, rng_key, l0: int,
@@ -143,17 +199,8 @@ def _sharded_select_kernel(pid, pk, valid, rng_key, l0: int,
                            mesh: Mesh):
 
     def per_shard(pid_s, pk_s, valid_s, key_r):
-        shard_idx = jax.lax.axis_index(SHARD_AXIS)
-        key_l0, key_sel = jax.random.split(key_r)
-        # Distinct pair-sampling randomness per shard (rows of one privacy
-        # id all live on one shard, so L0 sampling stays shard-local);
-        # identical selection key, so every shard holds the same keep mask.
-        counts = executor.select_partition_counts(
-            pid_s, pk_s, valid_s, jax.random.fold_in(key_l0, shard_idx), l0,
-            n_partitions)
-        counts = jax.lax.psum(counts, SHARD_AXIS)
-        return selection_ops.sample_keep_decisions(key_sel, counts,
-                                                   selection)
+        return _select_per_shard_trace(pid_s, pk_s, valid_s, key_r, l0,
+                                       n_partitions, selection)
 
     fn = shard_map(per_shard,
                    mesh=mesh,
@@ -163,10 +210,42 @@ def _sharded_select_kernel(pid, pk, valid, rng_key, l0: int,
     return fn(pid, pk, valid, rng_key)
 
 
-# Compile/dispatch attribution for the dense meshed entry points.
-_sharded_kernel = rt_trace.probe_jit("sharded_kernel", _sharded_kernel)
-_sharded_select_kernel = rt_trace.probe_jit("sharded_select_kernel",
-                                            _sharded_select_kernel)
+@partial(jax.jit,
+         static_argnames=("l0", "n_partitions", "selection", "mesh"))
+def _sharded_select_release_kernel(pid, pk, valid, rng_key, l0: int,
+                                   n_partitions: int,
+                                   selection: selection_ops.SelectionParams,
+                                   mesh: Mesh):
+    """_sharded_select_kernel + fused kept-first compaction (replicated;
+    same ordering as np.nonzero over the dense keep vector)."""
+
+    def per_shard(pid_s, pk_s, valid_s, key_r):
+        keep = _select_per_shard_trace(pid_s, pk_s, valid_s, key_r, l0,
+                                       n_partitions, selection)
+        order = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+        return keep.sum(), order
+
+    fn = shard_map(per_shard,
+                   mesh=mesh,
+                   in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                             P()),
+                   out_specs=(P(), P()))
+    return fn(pid, pk, valid, rng_key)
+
+
+# Compile/dispatch attribution + AOT executable routing for the dense
+# meshed entry points (runtime/aot.py wraps runtime/trace.probe_jit).
+_sharded_kernel = rt_aot.aot_probe("sharded_kernel", _sharded_kernel,
+                                   static_argnames=("cfg", "mesh"))
+_sharded_release_kernel = rt_aot.aot_probe(
+    "sharded_release_kernel", _sharded_release_kernel,
+    static_argnames=("cfg", "mesh"))
+_sharded_select_kernel = rt_aot.aot_probe(
+    "sharded_select_kernel", _sharded_select_kernel,
+    static_argnames=("l0", "n_partitions", "selection", "mesh"))
+_sharded_select_release_kernel = rt_aot.aot_probe(
+    "sharded_select_release_kernel", _sharded_select_release_kernel,
+    static_argnames=("l0", "n_partitions", "selection", "mesh"))
 
 
 def _fallback_select_partitions(args, kwargs, job):
@@ -176,12 +255,14 @@ def _fallback_select_partitions(args, kwargs, job):
     single-device decisions are the same release."""
 
     def go(mesh, pid, pk, valid, rng_key, l0, n_partitions, selection,
-           reshard="auto", retry=None, job_id=None):
+           fused=False, reshard="auto", retry=None, job_id=None):
         del mesh, reshard, job_id
         from pipelinedp_tpu.parallel.large_p import _pad_to
         cap = round_capacity(len(pid))
+        kernel = (executor.select_partitions_release_kernel
+                  if fused else executor.select_partitions_kernel)
         return rt_retry.retry_call(
-            lambda: executor.select_partitions_kernel(
+            lambda: kernel(
                 jnp.asarray(_pad_to(pid, cap, 0)),
                 jnp.asarray(_pad_to(pk, cap, 0)),
                 jnp.asarray(_pad_to(valid, cap, False)), rng_key, l0,
@@ -198,8 +279,8 @@ def _fallback_aggregate_arrays(args, kwargs, job):
     same release)."""
 
     def go(mesh, pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
-           stds, rng_key, cfg, secure_tables=None, reshard="auto",
-           retry=None, job_id=None):
+           stds, rng_key, cfg, secure_tables=None, fused=False,
+           reshard="auto", retry=None, job_id=None):
         del mesh, reshard, job_id
         from pipelinedp_tpu.parallel.large_p import _pad_to
         if isinstance(values, jax.Array):
@@ -207,8 +288,10 @@ def _fallback_aggregate_arrays(args, kwargs, job):
         else:
             values = np.asarray(values, dtype=np.dtype(executor._ftype()))
         cap = round_capacity(len(pid))
+        kernel = (executor.aggregate_release_kernel
+                  if fused else executor.aggregate_kernel)
         return rt_retry.retry_call(
-            lambda: executor.aggregate_kernel(
+            lambda: kernel(
                 jnp.asarray(_pad_to(pid, cap, 0)),
                 jnp.asarray(_pad_to(pk, cap, 0)),
                 jnp.asarray(_pad_to(values, cap, 0)),
@@ -225,6 +308,7 @@ def _fallback_aggregate_arrays(args, kwargs, job):
 def sharded_select_partitions(mesh: Mesh, pid, pk, valid, rng_key, l0: int,
                               n_partitions: int,
                               selection: selection_ops.SelectionParams,
+                              fused: bool = False,
                               reshard: str = "auto",
                               retry: rt_retry.RetryPolicy = None,
                               job_id: Optional[str] = None):
@@ -240,7 +324,10 @@ def sharded_select_partitions(mesh: Mesh, pid, pk, valid, rng_key, l0: int,
     selection kernel — the selection key is replicated, so decisions
     are the same release).
 
-    Returns keep: bool[n_partitions], replicated across the mesh.
+    Returns keep: bool[n_partitions], replicated across the mesh — or,
+    with fused=True, (n_kept, ids_sorted) with kept ids compacted to
+    the front inside the same program (the O(kept) fused-release
+    drain).
     """
     # Zero-width values column: selection never reads values, and a real
     # column would cost an O(rows) gather/scatter (or exchange) in the
@@ -253,10 +340,12 @@ def sharded_select_partitions(mesh: Mesh, pid, pk, valid, rng_key, l0: int,
                                            valid, reshard)
     # Retried dispatches reuse the identical rng_key: a retry is a replay
     # of the same selection decisions, never a second draw.
+    kernel = (_sharded_select_release_kernel
+              if fused else _sharded_select_kernel)
     with rt_trace.span("dispatch"):
         return rt_retry.retry_call(
-            lambda: _sharded_select_kernel(pid, pk, valid, rng_key, l0,
-                                           n_partitions, selection, mesh),
+            lambda: kernel(pid, pk, valid, rng_key, l0,
+                           n_partitions, selection, mesh),
             retry, what="sharded select_partitions dispatch")
 
 
@@ -265,6 +354,7 @@ def sharded_select_partitions(mesh: Mesh, pid, pk, valid, rng_key, l0: int,
 def sharded_aggregate_arrays(mesh: Mesh, pid, pk, values, valid, min_v, max_v,
                              min_s, max_s, mid, stds, rng_key,
                              cfg: executor.KernelConfig, secure_tables=None,
+                             fused: bool = False,
                              reshard: str = "auto",
                              retry: rt_retry.RetryPolicy = None,
                              job_id: Optional[str] = None):
@@ -274,7 +364,11 @@ def sharded_aggregate_arrays(mesh: Mesh, pid, pk, values, valid, min_v, max_v,
     device-resident columns reshard over ICI without touching the host
     (stage_rows_to_mesh). Returns the same (outputs, keep, row_count)
     triple as executor.aggregate_kernel, with results replicated across
-    the mesh.
+    the mesh — or, with fused=True, the compacted
+    (n_kept, ids_sorted, outputs_sorted, row_count) release of
+    executor.aggregate_release_kernel (kept-first ordering fused into
+    the one program, so the caller fetches a scalar gate + O(kept)
+    columns).
 
     Runtime knobs (shared entry, runtime/entry.py): timeout_s=/watchdog=
     deadlines, job_id= health attribution, and elastic=/min_devices=
@@ -288,9 +382,10 @@ def sharded_aggregate_arrays(mesh: Mesh, pid, pk, values, valid, min_v, max_v,
         values_dtype=np.dtype(executor._ftype()))
     # Retried dispatches reuse the identical rng_key, so the redrawn noise
     # is bit-identical — a retry replays the same release.
+    kernel = _sharded_release_kernel if fused else _sharded_kernel
     with rt_trace.span("dispatch"):
         return rt_retry.retry_call(
-            lambda: _sharded_kernel(pid, pk, values, valid, min_v, max_v,
-                                    min_s, max_s, mid, jnp.asarray(stds),
-                                    rng_key, cfg, mesh, secure_tables),
+            lambda: kernel(pid, pk, values, valid, min_v, max_v,
+                           min_s, max_s, mid, jnp.asarray(stds),
+                           rng_key, cfg, mesh, secure_tables),
             retry, what="sharded aggregation dispatch")

@@ -437,6 +437,36 @@ class TPUBackend(LocalBackend):
         num_processes: total controller count of the jax.distributed
             job; must be identical on every process. See
             coordinator_address.
+        aot: ahead-of-time executable routing (runtime/aot.py). When
+            True, the warm-path jit entry points (the fused kernels,
+            the sharded kernels, the blocked block bodies) execute
+            cached ``.lower().compile()`` executables keyed by (spec
+            fingerprint, row bucket, mesh geometry, dtype/sharding
+            set) instead of re-entering jax.jit's Python dispatch —
+            the first call per key compiles (aot_cache_misses), every
+            later call across every job and tenant of the process hits
+            (aot_cache_hits), with zero Python retraces. Results are
+            bit-identical; any entry that cannot lower falls back to
+            the traced jit path with one warning. Off by default.
+        fused_release: run the dense routes through the fused RELEASE
+            kernels (default True): contribution bounding, group
+            stats, DP selection, noise and kept-first compaction as
+            ONE device program, so the host fetches a scalar gate plus
+            O(kept) columns instead of the dense bool[P] keep vector
+            and [P] outputs. Bit-identical to False (the unfused
+            kernel + host-side np.nonzero decode — kept as the
+            comparison baseline).
+        overlap_drain: compute/drain overlap on the blocked drivers
+            (opt-in, default False): block b's drain sync, journal
+            fsync and staged transfers run on a dedicated drainer
+            thread while block b+1 dispatches. Blocks are consumed
+            strictly FIFO under the same watchdog/health/fault scopes,
+            so journal records, replay keys and results are
+            bit-identical to the serial consume loop. Opt-in because
+            drain deadlines then measure wall time that includes
+            dispatch-side compile contention — on a shared-core host a
+            tight timeout_s can expire on drains that are merely
+            queued behind a compile; pair with a generous deadline.
         trace: span-based pipeline tracing (runtime/trace.py). When
             True, every run records nested, job-scoped spans (stage
             phases, per-block dispatch/drain, reshard collectives with
@@ -476,6 +506,9 @@ class TPUBackend(LocalBackend):
                  elastic: bool = False,
                  min_devices: int = 1,
                  trace: bool = False,
+                 aot: bool = False,
+                 fused_release: bool = True,
+                 overlap_drain: bool = False,
                  pipeline_depth: Optional[int] = None,
                  encode_threads: Optional[int] = None,
                  encode_mode: str = "host",
@@ -503,6 +536,9 @@ class TPUBackend(LocalBackend):
         input_validators.validate_elastic(elastic, "TPUBackend")
         input_validators.validate_min_devices(min_devices, "TPUBackend")
         input_validators.validate_trace(trace, "TPUBackend")
+        input_validators.validate_aot(aot, "TPUBackend")
+        input_validators.validate_fused_release(fused_release, "TPUBackend")
+        input_validators.validate_overlap_drain(overlap_drain, "TPUBackend")
         if pipeline_depth is not None:
             input_validators.validate_pipeline_depth(
                 pipeline_depth, "TPUBackend")
@@ -550,6 +586,9 @@ class TPUBackend(LocalBackend):
         self.elastic = elastic
         self.min_devices = min_devices
         self.trace = trace
+        self.aot = aot
+        self.fused_release = fused_release
+        self.overlap_drain = overlap_drain
         self.pipeline_depth = pipeline_depth
         self.encode_threads = encode_threads
         self.encode_mode = encode_mode
@@ -614,6 +653,9 @@ class TPUBackend(LocalBackend):
             watchdog=self.watchdog,
             elastic=self.elastic,
             min_devices=self.min_devices,
+            aot=self.aot,
+            fused_release=self.fused_release,
+            overlap_drain=self.overlap_drain,
             pipeline_depth=self.pipeline_depth,
             encode_threads=self.encode_threads,
             encode_mode=self.encode_mode)

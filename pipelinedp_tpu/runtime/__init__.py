@@ -67,6 +67,13 @@ semantics:
     collective-free cross-process rollup that merges every controller's
     counters/health/trace into one pod view with a distinct Perfetto
     track per process.
+  * aot — the ahead-of-time executable cache: a process-wide map of
+    .lower().compile() executables keyed by (entry point, static spec
+    fingerprint, dynamic shape/dtype/sharding fingerprint) behind the
+    aot_probe attribution wrapper, so a warm run — or a second
+    identical-spec tenant of the service — dispatches pre-compiled
+    programs with zero Python retraces (aot_cache_hits/misses
+    attribute per job through the health scope).
   * pipeline — the device-resident streaming executor: a bounded
     staging queue fed by a host encode thread pool (ChunkSource ->
     map_overlapped) and a buffer-donating device accumulator
@@ -83,6 +90,7 @@ keys are pure functions of (final_key, block), so re-execution of a block
 is a replay of the same release, not a second one.
 """
 
+from pipelinedp_tpu.runtime import aot
 from pipelinedp_tpu.runtime import entry
 from pipelinedp_tpu.runtime import faults
 from pipelinedp_tpu.runtime import health
@@ -117,6 +125,7 @@ __all__ = [
     "PIPELINE_DEPTH",
     "RetryPolicy",
     "Watchdog",
+    "aot",
     "entry",
     "faults",
     "health",

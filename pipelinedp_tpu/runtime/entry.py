@@ -85,6 +85,12 @@ def runtime_entry(kind: str, fallback: Optional[Callable] = None):
                 input_validators.validate_journal(kwargs["journal"], kind)
             if watchdog is not None:
                 input_validators.validate_watchdog(watchdog, kind)
+            if "overlap" in kwargs:
+                input_validators.validate_overlap_drain(
+                    kwargs["overlap"], kind)
+            if "fused" in kwargs:
+                input_validators.validate_fused_release(
+                    kwargs["fused"], kind)
             input_validators.validate_elastic(elastic, kind)
             input_validators.validate_min_devices(min_devices, kind)
             if elastic and not meshed:

@@ -75,8 +75,12 @@ _STALLING_COUNTERS = frozenset({"block_timeouts", "watchdog_timeouts"})
 # compile-cache REUSE — a second tenant submitting an identical spec
 # must show 0 misses on its own job record, not on a racy process-wide
 # counter delta.
+# aot_cache_hits/misses are neutral like jit_cache_misses: per-job
+# attribution is what lets the service prove a second identical-spec
+# tenant executed with ZERO AOT retraces on its own record.
 _TRACKED_COUNTERS = (_DEGRADING_COUNTERS | _STALLING_COUNTERS |
-                     frozenset({"journal_replays", "jit_cache_misses"}))
+                     frozenset({"journal_replays", "jit_cache_misses",
+                                "aot_cache_hits", "aot_cache_misses"}))
 
 
 def _process_index() -> int:

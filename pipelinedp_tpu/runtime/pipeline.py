@@ -75,6 +75,16 @@ from pipelinedp_tpu.runtime import watchdog as rt_watchdog
 # all of them from here, never tune one alone.
 PIPELINE_DEPTH = 8
 
+# Device-append batch size (rows) of the streaming ingest: encoded
+# chunks stage host-side until this many rows accumulate, then land on
+# device as ONE jit append instead of one per chunk — a fine-grained
+# 4K-row stream goes from hundreds of pipeline_append dispatches to a
+# handful (the e2e_dispatch_count receipt), with bit-identical final
+# buffers (append order and pad values are unchanged; the accumulator
+# reproduces executor.pad_rows either way). 0 disables batching (the
+# per-chunk comparison baseline).
+APPEND_BATCH_ROWS = 1 << 16
+
 _POLL_S = 0.05
 
 
@@ -363,13 +373,22 @@ class DeviceRowAccumulator:
     """
 
     def __init__(self, donate: Optional[bool] = None,
-                 fills: tuple = (0, -1, 0)):
+                 fills: tuple = (0, -1, 0), batch_rows: int = 0):
         self.donating = _donation_supported() if donate is None else donate
         # Per-column pad values. The default is the executor.pad_rows
         # convention (pid 0, pk -1, values 0); the hash-device encode
         # route accumulates raw hash rows instead and pads with the
         # uint32 sentinel so pad rows can never alias a real key hash.
         self.fills = tuple(fills)
+        # batch_rows > 0: host-numpy chunks stage in a host-side batch
+        # until this many rows accumulate, then land as ONE device
+        # append — dozens of per-chunk jit dispatches collapse to a
+        # handful, with bit-identical final buffers (same row order,
+        # same pad values). The streaming ingest passes
+        # APPEND_BATCH_ROWS; 0 keeps the historical per-chunk appends.
+        self.batch_rows = int(batch_rows)
+        self._batch = []  # host-staged (pid, pk, values) chunk slices
+        self._batch_n = 0
         self._n = 0  # real rows accumulated
         self._bufs = None  # donating mode: (pid, pk, values)
         self._staged = []  # staged mode: (pid, pk, values, n_real)
@@ -377,7 +396,7 @@ class DeviceRowAccumulator:
 
     @property
     def n_rows(self) -> int:
-        return self._n
+        return self._n + self._batch_n
 
     def _refresh_accounting(self) -> None:
         """Folds this accumulator's device footprint into the byte
@@ -402,13 +421,58 @@ class DeviceRowAccumulator:
     def append(self, pid, pk, values, n_real: int, chunk: int = 0) -> None:
         """Appends one encoded chunk (host numpy arrays; in donating mode
         already padded to a row bucket, with ``n_real`` true rows)."""
-        import jax.numpy as jnp
         # Fault-injection hook: an OOM mid-pipeline aborts the stream
         # before any DP release — the failed run registered mechanisms at
         # graph-build time only, so a rerun replays the same release.
         rt_faults.maybe_fail("oom", chunk)
         if n_real == 0 and pid.shape[0] == 0:
             return
+        import numpy as _np
+        if self.batch_rows and isinstance(pid, _np.ndarray):
+            # Host-side batch staging: trim each chunk to its real rows
+            # (batched chunks re-pad once at flush) and land the batch
+            # as one device append when it crosses the row threshold.
+            self._batch.append(
+                (pid[:n_real], pk[:n_real], values[:n_real]))
+            self._batch_n += n_real
+            if self._batch_n >= self.batch_rows:
+                self._flush_batch(chunk)
+            return
+        self._flush_batch(chunk)
+        self._append_now(pid, pk, values, n_real, chunk)
+
+    def _flush_batch(self, chunk: int) -> None:
+        """Lands the host-staged batch as one device append (no-op when
+        nothing is staged)."""
+        if not self._batch:
+            return
+        import numpy as _np
+        n = self._batch_n
+        pid = _np.concatenate([c[0] for c in self._batch])
+        pk = _np.concatenate([c[1] for c in self._batch])
+        values = _np.concatenate([c[2] for c in self._batch])
+        self._batch = []
+        self._batch_n = 0
+        if self.donating:
+            # Re-pad the batch to its row bucket with this
+            # accumulator's pad values — byte-identical to what the
+            # per-chunk path would have left in the buffer tail.
+            from pipelinedp_tpu import executor
+            cap = executor.row_bucket(n)
+            pad = cap - n
+            if pad:
+                f0, f1, f2 = self.fills
+                pid = _np.concatenate(
+                    [pid, _np.full((pad,) + pid.shape[1:], f0, pid.dtype)])
+                pk = _np.concatenate(
+                    [pk, _np.full((pad,) + pk.shape[1:], f1, pk.dtype)])
+                values = _np.concatenate(
+                    [values,
+                     _np.full((pad,) + values.shape[1:], f2, values.dtype)])
+        self._append_now(pid, pk, values, n, chunk)
+
+    def _append_now(self, pid, pk, values, n_real: int, chunk: int) -> None:
+        import jax.numpy as jnp
         with rt_trace.span("pipeline_append", chunk=chunk, rows=n_real):
             if not self.donating:
                 self._staged.append((jnp.asarray(pid), jnp.asarray(pk),
@@ -441,6 +505,8 @@ class DeviceRowAccumulator:
         holds the real row count. Returns None when nothing was
         appended (the caller emits its empty-stream encoding)."""
         import jax.numpy as jnp
+
+        self._flush_batch(0)
 
         # Lazy: the executor imports this module at load; the bucket
         # arithmetic lives with pad_rows so the two can never drift.

@@ -110,6 +110,22 @@ REGISTRY: Dict[str, Metric] = {
         _counter("jit_cache_misses",
                  "probed jit entry-point calls that compiled (grew the "
                  "jit cache) instead of hitting it"),
+        _counter("aot_cache_hits",
+                 "warm-path dispatches served by an ahead-of-time "
+                 "compiled executable from the process-wide "
+                 "ExecutableCache (runtime/aot.py) — zero Python "
+                 "retracing, zero jit cache lookup"),
+        _counter("aot_cache_misses",
+                 "AOT entry-point calls that lowered and compiled a new "
+                 "executable (first call for a (spec, shape, mesh, "
+                 "dtype) key; 0 on a second identical-spec job is the "
+                 "cross-job reuse proof)"),
+        _counter("release_dispatches",
+                 "device program launches plus blocking host "
+                 "materializations on the executor/driver release path "
+                 "(kernel dispatches, per-block drain syncs, decode "
+                 "barriers) — the per-aggregation dispatch bill the "
+                 "fused release kernels exist to shrink"),
         _counter("pipeline_chunks",
                  "chunks streamed through the ingest staging queue "
                  "(runtime/pipeline.map_overlapped)"),

@@ -298,6 +298,21 @@ def probe_jit(name: str, fn):
     return wrapper
 
 
+def note_compile(name: str, seconds: float) -> None:
+    """Records one compile (with its wall seconds) under ``name`` in
+    compile_stats() — the attribution hook ahead-of-time lowering
+    (runtime/aot.py) shares with probe_jit, so a ``.lower().compile()``
+    executable's build cost shows up in the same per-entry-point compile
+    table (and on the timeline) as a traced jit cache miss would."""
+    if not _enabled:
+        return
+    with _lock:
+        entry = _compile.setdefault(name, [0, 0.0])
+        entry[0] += 1
+        entry[1] += seconds
+    instant("jit_compile:" + name, seconds=round(seconds, 6))
+
+
 def compile_stats() -> Dict[str, Dict[str, float]]:
     """{entry point: {"misses": n, "compile_s": seconds}} from probe_jit."""
     with _lock:

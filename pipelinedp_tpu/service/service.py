@@ -617,15 +617,20 @@ class DPAggregationService:
         # grows that trail (and every odometer_report scan) without
         # bound over its lifetime.
         rt_observability.prune_odometer(accountant=accountant)
-        misses = int(
-            rt_health.for_job(job.job_id).snapshot()["counters"].get(
-                "jit_cache_misses", 0))
+        job_counters = rt_health.for_job(
+            job.job_id).snapshot()["counters"]
+        misses = int(job_counters.get("jit_cache_misses", 0))
+        aot_misses = int(job_counters.get("aot_cache_misses", 0))
+        aot_hits = int(job_counters.get("aot_cache_hits", 0))
         key = spec.cache_key
         with self._lock:
             stats = self._spec_stats.setdefault(
-                key, {"jobs": 0, "jit_cache_misses": 0})
+                key, {"jobs": 0, "jit_cache_misses": 0,
+                      "aot_cache_misses": 0, "aot_cache_hits": 0})
             stats["jobs"] += 1
             stats["jit_cache_misses"] += misses
+            stats["aot_cache_misses"] += aot_misses
+            stats["aot_cache_hits"] += aot_hits
         job.handle._complete(result, spent, misses)
 
     # -- introspection ---------------------------------------------------
@@ -639,9 +644,15 @@ class DPAggregationService:
             return list(self._handles)
 
     def compile_reuse(self) -> Dict[str, Dict[str, int]]:
-        """{spec cache_key: {"jobs", "jit_cache_misses"}} — a key whose
-        second..nth jobs added 0 misses shared every compiled entry
-        point with the first (requires tracing for the probe)."""
+        """{spec cache_key: {"jobs", "jit_cache_misses",
+        "aot_cache_misses", "aot_cache_hits"}} — a key whose second..nth
+        jobs added 0 (jit or AOT) misses shared every compiled entry
+        point / executable with the first (jit attribution requires
+        tracing for the probe; AOT attribution counts whenever the
+        backend's aot knob is on). A second identical-spec tenant with
+        aot_cache_misses == 0 on its own job record executed with ZERO
+        Python retraces — the cross-job reuse evidence the bench's
+        service_aot_retraces key asserts."""
         with self._lock:
             return {k: dict(v) for k, v in self._spec_stats.items()}
 

@@ -567,16 +567,56 @@ def _jit_decorator_info(mod: Module, dec: ast.AST
     return names, nums
 
 
+# Attribution wrappers the jit-boundary rule accepts: probe_jit (the
+# traced-dispatch probe) and aot_probe (runtime/aot.py — probe_jit plus
+# the AOT executable cache; it wraps probe_jit internally, so its
+# compiles and dispatches carry the same per-entry-point attribution).
+_PROBE_WRAPPERS = frozenset({"probe_jit", "aot_probe"})
+
+# The one module allowed to call .lower().compile() directly: it IS the
+# attribution wrapper (aot_probe counts the compile into
+# trace.note_compile + aot_cache_misses before executing).
+_AOT_REL = "pipelinedp_tpu/runtime/aot.py"
+
+
 def _probe_wrapped_names(mod: Module) -> Set[str]:
     wrapped: Set[str] = set()
     for node in ast.walk(mod.tree):
         if isinstance(node, ast.Call):
             callee = mod.dotted(node.func) or ""
-            if callee.rsplit(".", 1)[-1] == "probe_jit":
+            if callee.rsplit(".", 1)[-1] in _PROBE_WRAPPERS:
                 for arg in node.args:
                     if isinstance(arg, ast.Name):
                         wrapped.add(arg.id)
     return wrapped
+
+
+def _lowered_compile_findings(mod: Module) -> Iterator[Finding]:
+    """AOT entry points: a ``<jitted>.lower(...).compile()`` chain
+    builds an executable that dispatches OUTSIDE jit's probed path —
+    unless it lives in runtime/aot.py (whose aot_probe is the sanctioned
+    attribution wrapper), its compiles and dispatches are invisible to
+    the compile/dispatch accounting and the aot_cache_hits/misses
+    evidence."""
+    if mod.rel == _AOT_REL:
+        return
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute) and
+                node.func.attr == "compile"):
+            continue
+        inner = node.func.value
+        if (isinstance(inner, ast.Call) and
+                isinstance(inner.func, ast.Attribute) and
+                inner.func.attr == "lower"):
+            yield Finding(
+                "jit-boundary", mod.rel, node.lineno,
+                "bare .lower().compile() builds an AOT executable "
+                "outside runtime/aot.py — its compile seconds and "
+                "dispatches are invisible to the per-entry-point "
+                "attribution and the aot_cache_hits/misses evidence; "
+                "wrap the entry point in rt_aot.aot_probe(name, fn, "
+                "static_argnames=...) instead")
 
 
 def _traced_if_findings(mod: Module, fn: ast.AST, traced: Set[str]
@@ -610,12 +650,16 @@ def _traced_if_findings(mod: Module, fn: ast.AST, traced: Set[str]
 @rule(
     "jit-boundary",
     "Every jax.jit/pjit entry point must be wrapped in trace.probe_jit "
-    "(compile/dispatch attribution — an unwrapped kernel's compiles are "
-    "invisible in the e2e gap accounting), and jitted bodies must not "
-    "branch in Python on traced arguments.")
+    "or runtime/aot.aot_probe (compile/dispatch attribution — an "
+    "unwrapped kernel's compiles are invisible in the e2e gap "
+    "accounting), jitted bodies must not branch in Python on traced "
+    "arguments, and .lower().compile() AOT executables may only be "
+    "built inside runtime/aot.py, whose aot_probe carries the same "
+    "attribution.")
 def jit_boundary(modules: List[Module]) -> Iterator[Finding]:
     for mod in modules:
         wrapped = _probe_wrapped_names(mod)
+        yield from _lowered_compile_findings(mod)
         for node in ast.walk(mod.tree):
             if not isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
@@ -774,6 +818,15 @@ KNOB_VALIDATORS: Dict[str, str] = {
     "coordinator_address": "validate_coordinator_address",
     "metrics_port": "validate_metrics_port",
     "metrics_path": "validate_metrics_path",
+    # Warm-path knobs (PR 14): the AOT executable cache, the fused
+    # release kernels and the compute/drain overlap. The driver-level
+    # `fused`/`overlap` route selectors share the backend validators
+    # (validated in runtime/entry.py's wrapper).
+    "aot": "validate_aot",
+    "fused_release": "validate_fused_release",
+    "overlap_drain": "validate_overlap_drain",
+    "fused": "validate_fused_release",
+    "overlap": "validate_overlap_drain",
     # Multi-tenant service knobs (validated in
     # DPAggregationService.__init__ — the service API boundary).
     "max_concurrent_jobs": "validate_max_concurrent_jobs",

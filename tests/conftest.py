@@ -76,6 +76,12 @@ def pytest_configure(config):
         "ledgers, admission control/load shedding, cross-job "
         "compile-cache reuse (tier-1, NOT slow; select alone with "
         "-m service)")
+    config.addinivalue_line(
+        "markers",
+        "aot: the single-dispatch warm path — AOT executable cache, "
+        "fused release kernels, compute/drain overlap: bit-identity, "
+        "cache-key correctness, per-job retrace attribution (tier-1, "
+        "NOT slow; select alone with -m aot)")
 
 
 @pytest.fixture(autouse=True)

@@ -467,7 +467,13 @@ class TestBackendIntegration:
                 jax.random.PRNGKey(11), cfg)
         large_p.aggregate_blocked(*args, block_partitions=1 << 10)  # warm
         trace.enable()
-        large_p.aggregate_blocked(*args, block_partitions=1 << 10)
+        # Serial consume loop (overlap=False): the one-thread timeline
+        # whose exclusive span times partition the root span by
+        # construction. The overlapped drainer records the SAME spans
+        # on its own thread — they overlap the dispatch timeline, so
+        # only presence (not partition) is asserted for it below.
+        large_p.aggregate_blocked(*args, block_partitions=1 << 10,
+                                  overlap=False)
         spans = trace.trace_summary()["spans"]
         for expected in ("aggregate_blocked", "contribution_bounding",
                          "dispatch", "drain", "consume"):
@@ -477,3 +483,10 @@ class TestBackendIntegration:
         attributed = sum(s["exclusive_s"] for s in spans.values())
         assert abs(attributed - root) <= 0.1 * root + 1e-3, (
             attributed, root)
+        trace.reset()
+        large_p.aggregate_blocked(*args, block_partitions=1 << 10)
+        spans_overlapped = trace.trace_summary()["spans"]
+        for expected in ("aggregate_blocked", "contribution_bounding",
+                         "dispatch", "drain", "consume"):
+            assert expected in spans_overlapped, (
+                expected, sorted(spans_overlapped))
