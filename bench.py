@@ -1164,8 +1164,10 @@ def main():
     try:
         from pipelinedp_tpu import staticcheck as sc
         from pipelinedp_tpu.staticcheck import cli as sc_cli
+        from pipelinedp_tpu.staticcheck import rules as sc_rules
+        from pipelinedp_tpu.staticcheck import threads as sc_threads
         sc_started = time.perf_counter()
-        sc_analysis, sc_active, sc_baselined, sc_stale, _sc_mods = \
+        sc_analysis, sc_active, sc_baselined, sc_stale, sc_mods = \
             sc.run_tree()
         sc_seconds = time.perf_counter() - sc_started
         staticcheck_detail = {
@@ -1174,12 +1176,18 @@ def main():
             "stale_baseline_entries": len(sc_stale),
             "rules_version": sc.RULES_VERSION,
             # Full-tree analysis wall time + per-rule finding counts:
-            # analyzer runtime regressions (the dataflow fixpoint is the
-            # dominant cost) and per-family triage drift are both
-            # visible in the perf trajectory.
+            # analyzer runtime regressions (the dataflow fixpoints are
+            # the dominant cost; budget: <= 10s on the tier-1 runner)
+            # and per-family triage drift are both visible in the perf
+            # trajectory.
             "analysis_seconds": round(sc_seconds, 3),
             "per_rule": sc_cli.per_rule_counts(sc_analysis, sc_active,
                                                sc_baselined),
+            # Structurally discovered thread roots (thread-escape's
+            # quantifier domain): a new threaded subsystem that does
+            # NOT grow this count escaped the race analysis.
+            "thread_roots": len(sc_threads.discover_roots(
+                sc_rules._call_graph(sc_mods))),
         }
     except Exception as e:  # noqa: BLE001 - the receipt must survive analyzer breakage; tests/test_staticcheck.py owns failing on it
         staticcheck_detail = {"error": f"{type(e).__name__}: {e}"}

@@ -16,6 +16,7 @@ state), so budget finalization can happen after graph construction.
 import abc
 import collections
 import copy
+import threading
 from typing import Callable, Iterable, List, Optional, Sized, Tuple, Union
 
 import numpy as np
@@ -25,6 +26,7 @@ from pipelinedp_tpu import budget_accounting
 from pipelinedp_tpu import dp_computations
 from pipelinedp_tpu.aggregate_params import Metrics, NoiseKind
 from pipelinedp_tpu.ops import quantile_tree as quantile_tree_ops
+from pipelinedp_tpu.runtime.concurrency import guarded_by
 
 ArrayLike = Union[np.ndarray, List[float]]
 ExplainComputationReport = Union[Callable, str, List[Union[Callable, str]]]
@@ -546,19 +548,27 @@ class VectorSumCombiner(Combiner):
 
 
 # Cache for namedtuple result types (Beam-style serialization support).
+# Guarded: the service's worker pool builds CompoundCombiners on
+# concurrent threads, and an unlocked get-or-create can install TWO
+# distinct classes for one key — isinstance and pickle identity then
+# differ between jobs that should share the type (thread-escape's
+# first-run catch).
+_named_tuple_cache_lock = threading.Lock()
 _named_tuple_cache = {}
+_GUARDED_BY = guarded_by("_named_tuple_cache_lock", "_named_tuple_cache")
 
 
 def _get_or_create_named_tuple(type_name: str,
                                field_names: tuple) -> 'MetricsTuple':
     cache_key = (type_name, field_names)
-    named_tuple = _named_tuple_cache.get(cache_key)
-    if named_tuple is None:
-        named_tuple = collections.namedtuple(type_name, field_names)
-        named_tuple.__reduce__ = lambda self: (_create_named_tuple_instance,
-                                               (type_name, field_names,
-                                                tuple(self)))
-        _named_tuple_cache[cache_key] = named_tuple
+    with _named_tuple_cache_lock:
+        named_tuple = _named_tuple_cache.get(cache_key)
+        if named_tuple is None:
+            named_tuple = collections.namedtuple(type_name, field_names)
+            named_tuple.__reduce__ = lambda self: (
+                _create_named_tuple_instance,
+                (type_name, field_names, tuple(self)))
+            _named_tuple_cache[cache_key] = named_tuple
     return named_tuple
 
 

@@ -96,12 +96,12 @@ def _load() -> Optional[ctypes.CDLL]:
         path = os.path.join(_dir, _LIB_NAME)
         # staticcheck: disable=lock-order — intentional build serialization: exactly one thread compiles the library while every other caller waits for it; the double-checked fast path above never takes the lock, so steady state is lock-free
         if not os.path.exists(path) and not _try_build():
-            _load_failed = True
+            _load_failed = True  # staticcheck: disable=thread-escape — double-checked lazy init: this write-once publish happens under _lock; the unlocked fast-path read either sees the final value or falls through to the locked re-check
             return None
         try:
             lib = ctypes.CDLL(path)
             _bind(lib)
-            _lib = lib
+            _lib = lib  # staticcheck: disable=thread-escape — double-checked lazy init: write-once publish under _lock; the unlocked fast-path read sees None (and takes the locked slow path, which re-checks) or the final library, never a torn value
         except OSError as e:
             logging.warning("native DP primitives load failed: %s", e)
             _load_failed = True

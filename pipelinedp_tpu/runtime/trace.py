@@ -95,7 +95,7 @@ def enable(buffer_limit: int = 1_000_000) -> None:
         _buffer_limit = int(buffer_limit)
         if not _events:
             _t0 = time.perf_counter()
-    _enabled = True
+    _enabled = True  # staticcheck: disable=thread-escape — deliberately lock-free single-writer monotonic bool publish (see runtime/concurrency.py): a reader that observes the stale False merely skips one event, it never tears state
 
 
 def disable() -> None:

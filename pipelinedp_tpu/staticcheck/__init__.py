@@ -10,12 +10,18 @@ project call graph — and runs pluggable rules (:mod:`rules`) over it,
 producing ``Finding(rule_id, file, line, message)`` records, with
 inline suppressions, a committed baseline for grandfathered findings
 (:mod:`baseline`), a content-hash model cache (:mod:`cache`) and a CLI
-(:mod:`cli`). Three rule families are interprocedural dataflow over the
-call graph (:mod:`dataflow`): privacy-release taint (raw row data must
-be noised before any export sink, findings carry the source->sink call
-path), lock-order deadlock proofs (acyclic acquisition graph, no
-blocking while locked), and budget-flow verification (every mechanism
-spec provably reaches the ledger). The tier-1 gate
+(:mod:`cli`). Five rule families are interprocedural over the call
+graph: privacy-release taint (raw row data must be noised before any
+export sink, findings carry the source->sink call path), lock-order
+deadlock proofs (acyclic acquisition graph, no blocking while locked),
+budget-flow verification (every mechanism spec provably reaches the
+ledger) — both engines in :mod:`dataflow` — plus the v3 families:
+thread-escape race detection over structurally discovered thread roots
+(:mod:`threads`, RacerD-style: no annotations, ownership and
+immutable-after-init declassify, findings carry both root->access
+paths) and determinism proofs (set/listdir/id iteration order must
+never reach a release, journal key, fold_in derivation or odometer
+record; sorted() sanitizes). The tier-1 gate
 (tests/test_staticcheck.py) fails on any non-baselined finding.
 
 See README "Static analysis" for the rule table, the suppression syntax

@@ -22,7 +22,7 @@ import os
 import pickle
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-from pipelinedp_tpu.staticcheck import model
+from pipelinedp_tpu.staticcheck import core, model
 
 CACHE_VERSION = 1
 
@@ -40,7 +40,14 @@ class ModelCache:
             try:
                 with open(path, "rb") as f:
                     payload = pickle.load(f)
-                if payload.get("cache_version") == CACHE_VERSION:
+                # Keyed on BOTH versions: a rules bump (new rule
+                # families, changed suppression semantics) must never
+                # serve analysis state written under the old rule set —
+                # --changed-only trusts entries without re-hashing, so
+                # a stale-versioned entry would go entirely unchecked.
+                if payload.get("cache_version") == CACHE_VERSION and \
+                        payload.get("rules_version") == \
+                        core.RULES_VERSION:
                     self._entries = payload.get("entries", {})
             except Exception:  # noqa: BLE001 - a corrupt/stale cache file must degrade to a cold parse, never fail the analysis
                 self._entries = {}
@@ -75,6 +82,7 @@ class ModelCache:
         tmp = f"{self.path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             pickle.dump({"cache_version": CACHE_VERSION,
+                         "rules_version": core.RULES_VERSION,
                          "entries": self._entries}, f)
         os.replace(tmp, self.path)
 

@@ -182,8 +182,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         prog="python -m pipelinedp_tpu.staticcheck",
         description="AST + interprocedural-dataflow DP-invariant "
                     "analyzer (key hygiene, release taint, lock order, "
-                    "budget flow, ledger discipline, host-transfer & "
-                    "lock lints).")
+                    "budget flow, thread-escape race detection, "
+                    "determinism proofs, ledger discipline, "
+                    "host-transfer & lock lints).",
+        epilog="exit codes: 0 = clean (after suppressions and "
+               "baseline), 1 = active findings, 2 = usage error")
     parser.add_argument("paths", nargs="*",
                         help="files/directories to analyze "
                              "(default: the pipelinedp_tpu package, "
@@ -204,6 +207,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--rules", default=None,
                         help="comma-separated rule ids to run "
                              "(default: all)")
+    parser.add_argument("--rule", action="append", default=None,
+                        metavar="RULE",
+                        help="run a single rule family (repeatable; "
+                             "combines with --rules) — the local "
+                             "triage loop for one family")
     parser.add_argument("--cache", default=None, metavar="PATH",
                         help="content-hash pickle of parsed module "
                              "models; hash hits skip re-parsing "
@@ -230,6 +238,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     only = ([r.strip() for r in args.rules.split(",") if r.strip()]
             if args.rules else None)
+    if args.rule:
+        only = (only or []) + [r for r in args.rule if r]
     cache = cache_mod.ModelCache(args.cache) if args.cache else None
     started = time.perf_counter()
     try:

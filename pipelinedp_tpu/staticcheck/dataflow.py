@@ -114,6 +114,15 @@ class TaintConfig:
     # sink detector: (graph, mod, scope, call) -> list of
     # (sink_label, [tainted arg expressions]) — see rules.py.
     sink_args: Callable
+    # Unresolved callees whose RESULT is a source, matched by exact
+    # canonical dotted name ({"set": ..., "os.listdir": ...}) — the
+    # determinism rule's iteration-order sources. Exact-match only:
+    # `ev.set()` canonicalizes to "ev.set", never bare "set", so a
+    # threading.Event publish can't masquerade as a set constructor.
+    source_calls: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # When set, `{a, b}` literals and set comprehensions are sources
+    # carrying this label (None keeps value-taint configs unchanged).
+    literal_set_label: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -171,6 +180,19 @@ class _FunctionPass:
     def taint_of(self, node: Optional[ast.AST]) -> Set:
         if node is None or isinstance(node, ast.Constant):
             return set()
+        if self.cfg.literal_set_label is not None and \
+                isinstance(node, (ast.Set, ast.SetComp)):
+            out = {Origin(label=self.cfg.literal_set_label,
+                          rel=self.info.rel, line=node.lineno)}
+            for child in ast.walk(node):
+                if child is node:
+                    continue
+                if isinstance(child, ast.Call):
+                    out |= self.taint_of_call(child)
+                elif isinstance(child, ast.Name) and \
+                        isinstance(child.ctx, ast.Load):
+                    out |= set(self.env.get(child.id, ()))
+            return out
         if isinstance(node, ast.Name):
             return set(self.env.get(node.id, ()))
         if isinstance(node, ast.Attribute):
@@ -231,6 +253,10 @@ class _FunctionPass:
             return set()
         if dotted in cfg.declass_calls or leaf in cfg.declass_calls:
             return set()
+        src_label = cfg.source_calls.get(dotted)
+        if src_label is not None:
+            return {Origin(label=src_label, rel=self.info.rel,
+                           line=call.lineno)}
         # Conservative pass-through: taint in, taint out.
         out: Set = set()
         for _, taint in self._arg_taints(call):

@@ -43,6 +43,10 @@ REASON_REQUIRED = frozenset({
     "release-taint",
     "lock-order",
     "budget-flow",
+    # The v3 families guard bit-identity itself (a silent race or a
+    # set-iteration release breaks it); waivers must say why not.
+    "thread-escape",
+    "determinism",
 })
 
 _SUPPRESS_RE = re.compile(

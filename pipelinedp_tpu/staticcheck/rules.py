@@ -15,6 +15,7 @@ import re
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
 from pipelinedp_tpu.staticcheck import dataflow
+from pipelinedp_tpu.staticcheck import threads as threads_mod
 from pipelinedp_tpu.staticcheck.model import CallGraph, Finding, Module
 
 Rule = collections.namedtuple("Rule", ["rule_id", "help", "fn"])
@@ -1267,6 +1268,28 @@ def _declared_locks(modules: List[Module]
     return declared
 
 
+def _declared_guarded_attrs(modules: List[Module]
+                            ) -> Set[Tuple[str, str, str]]:
+    """{(rel, cls-or-"", attr)} of every attribute a ``_GUARDED_BY``
+    declaration covers — lock-discipline territory the thread-escape
+    rule must not duplicate."""
+    out: Set[Tuple[str, str, str]] = set()
+    for mod in modules:
+        for stmt in mod.tree.body:
+            decl = _guarded_decl(mod, stmt)
+            if decl is not None:
+                out.update((mod.rel, "", attr) for attr in decl[1])
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for stmt in cls.body:
+                decl = _guarded_decl(mod, stmt)
+                if decl is not None:
+                    out.update((mod.rel, cls.name, attr)
+                               for attr in decl[1])
+    return out
+
+
 def _lock_name(lock: "dataflow.LockId") -> str:
     rel, cls, name = lock
     owner = f"{cls}." if cls else ""
@@ -1545,3 +1568,151 @@ def _check_spec_registration(mod: Module,
                     f"skips the ledger is noise outside the privacy "
                     f"proof; register it (or construct it inside the "
                     f"_register_mechanism call)")
+
+
+# ---------------------------------------------------------------------------
+# (11) thread-escape
+# ---------------------------------------------------------------------------
+
+
+def _loc_desc(loc: Tuple[str, str, str]) -> str:
+    rel, cls, name = loc
+    return f"self.{name} ({rel}:{cls})" if cls else \
+        f"module global {name!r} ({rel})"
+
+
+@rule(
+    "thread-escape",
+    "No shared mutable state between thread roots without a common "
+    "lock. Thread roots are discovered structurally "
+    "(threading.Thread(target=)/Timer, ThreadPoolExecutor.submit/map, "
+    "BaseHTTPRequestHandler subclasses, __main__ subprocess entries); "
+    "module globals and self.-attributes written from two roots — or "
+    "written from one and read from another — where some cross-root "
+    "access pair holds no common lock are races, reported with both "
+    "root->access call paths. queue/Event/Lock/local state, "
+    "immutable-after-__init__ attributes and _GUARDED_BY-declared "
+    "attributes (lock-discipline's territory) are declassified "
+    "structurally. Consistently-locked-but-undeclared locations get a "
+    "fix-it naming the _GUARDED_BY declaration to add.")
+def thread_escape(modules: List[Module]) -> Iterator[Finding]:
+    graph = _call_graph(modules)
+    report = threads_mod.run_threads(graph, _declared_locks(modules),
+                                     _declared_guarded_attrs(modules))
+    for race in report.races:
+        desc = _loc_desc(race.loc)
+        if race.kind == "guard-candidate":
+            yield Finding(
+                "thread-escape", race.rel, race.line,
+                f"{desc} is shared across thread roots and every access "
+                f"holds {race.candidate_lock!r}, but the attribute is "
+                f"not declared — add _GUARDED_BY = guarded_by("
+                f"{race.candidate_lock!r}, {race.loc[2]!r}) so the "
+                f"lock-discipline rule enforces it from now on. "
+                f"Roots: {race.a.root.describe()} and "
+                f"{race.b.root.describe()}")
+            continue
+        fixit = ""
+        if race.candidate_lock is not None:
+            fixit = (f"; other accesses hold {race.candidate_lock!r} — "
+                     f"declare _GUARDED_BY = guarded_by("
+                     f"{race.candidate_lock!r}, {race.loc[2]!r}) and "
+                     f"take it here")
+        yield Finding(
+            "thread-escape", race.rel, race.line,
+            f"{race.kind} race: {desc} is accessed from two thread "
+            f"roots with no common lock{fixit}. "
+            f"Path A: {race.a.render()}. Path B: {race.b.render()}")
+
+
+# ---------------------------------------------------------------------------
+# (12) determinism
+# ---------------------------------------------------------------------------
+
+# Iteration-order sources: their result's ORDER is not stable across
+# processes/runs (set/frozenset iteration under hash randomization,
+# directory listings, object identity). Matched by exact canonical
+# dotted name (an `ev.set()` never matches bare "set").
+DETERMINISM_SOURCES: Dict[str, str] = {
+    "set": "set() iteration order",
+    "frozenset": "frozenset() iteration order",
+    "os.listdir": "os.listdir() order",
+    "os.scandir": "os.scandir() order",
+    "glob.glob": "glob.glob() order",
+    "glob.iglob": "glob.iglob() order",
+    "id": "id() value",
+}
+
+# Order-insensitive reductions and explicit-ordering constructs clear
+# order taint: sorted() IS the sanctioned fix.
+DETERMINISM_DECLASS_CALLS = frozenset({
+    "sorted", "len", "min", "max", "sum", "any", "all", "bool",
+    "isinstance", "hasattr", "range",
+    # Sorted-output uniques (numpy/jax sort; pandas.unique does NOT and
+    # deliberately has no entry here).
+    "numpy.unique", "jax.numpy.unique",
+})
+DETERMINISM_SANITIZER_ATTRS = frozenset({"sort"})
+
+
+def _determinism_sink_args(graph, mod, scope, call, callee):
+    """Sink detector for the determinism rule: flows whose ORDER is the
+    released/persisted/derived artifact."""
+    hits = []
+    dotted = mod.dotted(call.func) or ""
+    leaf = dotted.rsplit(".", 1)[-1]
+    attr = call.func.attr if isinstance(call.func, ast.Attribute) else None
+    kw_exprs = [kw.value for kw in call.keywords]
+    if leaf == "fold_in" and call.args:
+        # jax.random.fold_in(key, data): `data` selects the noise
+        # stream — an order-dependent value here forks the release.
+        hits.append(("fold_in noise-key derivation",
+                     list(call.args[1:]) + kw_exprs))
+    elif leaf == "make_noise_key":
+        hits.append(("noise-key derivation", list(call.args) + kw_exprs))
+    elif attr == "put" and len(call.args) == 3:
+        # BlockJournal.put(job_id, key, record): the journal KEY —
+        # resume-time addressing must be reproducible.
+        hits.append(("journal key", [call.args[1]]))
+    elif leaf == "record_mechanism":
+        # Odometer records must append in a reproducible order, or the
+        # ledger's bit-exact left-to-right eps fold diverges on replay.
+        hits.append(("odometer record", list(call.args) + kw_exprs))
+    return hits
+
+
+@rule(
+    "determinism",
+    "Bit-identical releases require order-deterministic flows: values "
+    "whose ORDER comes from set()/frozenset iteration, os.listdir/glob "
+    "listings or id() must not reach a release sink (the drivers' "
+    "released values), a journal key, a fold_in/noise-key derivation "
+    "or an odometer record. sorted(...) (and order-insensitive "
+    "reductions: len/min/max/sum/any/all) sanitize. Interprocedural: "
+    "findings carry the full source->sink call path.")
+def determinism(modules: List[Module]) -> Iterator[Finding]:
+    graph = _call_graph(modules)
+    cfg = dataflow.TaintConfig(
+        sources={},
+        sanitizers=set(),
+        sanitizer_attrs=DETERMINISM_SANITIZER_ATTRS,
+        sanitizer_dotted=frozenset(),
+        declass_calls=DETERMINISM_DECLASS_CALLS,
+        declass_attrs=frozenset({"shape", "ndim", "size", "nbytes",
+                                 "dtype", "itemsize"}),
+        release_funcs=TAINT_RELEASE_FUNCS,
+        sink_args=_determinism_sink_args,
+        source_calls=DETERMINISM_SOURCES,
+        literal_set_label="set-literal iteration order",
+    )
+    for f in sorted(dataflow.run_taint(graph, cfg),
+                    key=lambda f: (f.rel, f.line, f.sink,
+                                   f.origin.label)):
+        yield Finding(
+            "determinism", f.rel, f.line,
+            f"iteration-order-dependent value reaches {f.sink} — the "
+            f"order is not stable across processes/restarts, so a "
+            f"resumed or retried job would replay a DIFFERENT release; "
+            f"sort the flow (sorted(...)) or suppress with a reason "
+            f"proving the order cannot vary. Path: "
+            f"{f.origin.render_path()} -> {f.sink} ({f.rel}:{f.line})")

@@ -1,0 +1,699 @@
+"""Thread-escape race detection over the project call graph.
+
+The repo now runs at least seven threaded subsystems (the service
+worker pool, the blocked drivers' drainer thread, ``map_overlapped``'s
+feeder + encode pool, the watchdog monitor, the metrics HTTP exporter,
+multihost children, the ledger's persist loop). PR 7's lock-discipline
+rule protects only attributes someone remembered to declare
+``_GUARDED_BY``; this module closes the gap the way RacerD does —
+*structurally*, with no annotations required:
+
+  1. **Thread roots** are discovered from the spawn sites themselves:
+     ``threading.Thread(target=f)``, ``threading.Timer(t, f)``,
+     ``ThreadPoolExecutor.submit(f, ...)`` / ``.map(f, ...)``, methods
+     of ``BaseHTTPRequestHandler`` subclasses (each request runs on a
+     server thread), and project functions invoked from an
+     ``if __name__ == "__main__":`` block (subprocess entry points —
+     ``multihost._child_main``). The watchdog monitor is a plain
+     ``Thread(target=self._run_monitor)`` and needs no special case.
+  2. **Per-root reachability** walks the shared :class:`model.CallGraph`
+     from each root, propagating the set of locks *guaranteed held at
+     entry* (intersection over all discovered call chains, union'd with
+     the locks held at each call site — so a helper only ever called
+     under ``self._lock`` is analyzed as holding it).
+  3. **Shared-state accesses** (module-global reads/writes, ``self.``
+     attribute reads/writes, container mutations through either) are
+     collected per function under the same held-lock scoping the
+     lock-order engine uses.
+  4. A location written from two different roots — or written from one
+     and read from another — where some cross-root access pair holds
+     **no common lock** is a race. Findings carry both full
+     root→access call paths (same hop format and 10-hop cap as taint
+     paths).
+
+Declassified structurally, never by baseline:
+
+  * state reached only through **concurrency primitives**
+    (``queue.Queue``, ``threading.Event``/``Lock``/``Semaphore``/
+    ``local``, ``collections.deque``) — synchronized by construction;
+  * **immutable-after-init** attributes: every write sits in the
+    owner's ``__init__``/``__new__`` (construction happens-before
+    thread start / publication);
+  * attributes already **declared** ``_GUARDED_BY``: the lock-discipline
+    rule proves every access locked — re-reporting them here would
+    duplicate that family, so this one only covers what it missed.
+
+When the accesses of an undeclared location *are* consistently guarded
+by one lock, the report carries a fix-it naming the ``_GUARDED_BY``
+declaration to add — racy-but-partially-locked locations name the same
+candidate, so the fix is one declaration plus taking the lock at the
+flagged site.
+"""
+
+import ast
+import dataclasses
+import re
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+from pipelinedp_tpu.staticcheck import dataflow
+from pipelinedp_tpu.staticcheck.model import CallGraph, FunctionInfo, Module
+
+_MAX_PATH = 10
+
+# A shared location: (rel, owner-class-or-"", name). Same identity
+# convention as dataflow.LockId, so lock/attr ownership lines up.
+Loc = Tuple[str, str, str]
+
+# Constructors whose product is synchronized (or thread-local) by
+# construction: state reached only through one of these is declassified.
+_PRIMITIVE_CTORS = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Event",
+    "threading.Condition", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Barrier", "threading.local",
+    "threading.Thread", "queue.Queue", "queue.PriorityQueue",
+    "queue.LifoQueue", "queue.SimpleQueue", "collections.deque",
+})
+
+# Method calls that mutate their receiver in place: `g.append(x)` is a
+# WRITE to g even though g's name appears in Load context.
+_MUTATOR_ATTRS = frozenset({
+    "append", "extend", "add", "update", "insert", "remove", "discard",
+    "pop", "popitem", "clear", "setdefault", "sort", "reverse",
+    "appendleft", "popleft",
+})
+
+# `.submit(f, ...)` / `.map(f, ...)` receivers that are thread pools:
+# either provably constructed from ThreadPoolExecutor in the module, or
+# named like one. (`backend.map(col, fn)` never matches — the receiver
+# heuristic is what keeps the pipeline-backend API out.)
+_EXECUTOR_RECV_RE = re.compile(r"pool|executor", re.IGNORECASE)
+
+
+# ---------------------------------------------------------------------------
+# Thread-root discovery
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ThreadRoot:
+    kind: str                # the structural spawn pattern matched
+    func: Tuple[str, str]    # (rel, qualname) of the root function
+    rel: str                 # spawn site
+    line: int
+
+    def describe(self) -> str:
+        return f"{self.func[1]} [{self.kind} @ {self.rel}:{self.line}]"
+
+
+def _walk_scope(root: ast.AST) -> Iterator[ast.AST]:
+    """Nodes of one function scope: nested defs/lambdas/classes are
+    separate FunctionInfos and are walked on their own."""
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _resolve_ref(graph: CallGraph, mod: Module,
+                 scope: Optional[FunctionInfo],
+                 expr: ast.AST) -> Optional[FunctionInfo]:
+    """Resolves a callable REFERENCE (``target=f``, ``submit(f, ..)``)
+    exactly the way a call to it would resolve."""
+    if not isinstance(expr, (ast.Name, ast.Attribute)):
+        return None
+    call = ast.Call(func=expr, args=[], keywords=[])
+    # Uncached resolve: the synthetic Call's id is not stable, so it
+    # must never enter the graph's id-keyed memo.
+    return graph._resolve_call_uncached(mod, call, scope)
+
+
+def _executor_vars(mod: Module) -> Set[str]:
+    """Names assigned from a ThreadPoolExecutor constructor anywhere in
+    the module (closure use included — the collection is deliberately
+    scope-insensitive)."""
+    out: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Assign) and
+                isinstance(node.value, ast.Call)):
+            continue
+        dotted = mod.dotted(node.value.func) or ""
+        if dotted.rsplit(".", 1)[-1] != "ThreadPoolExecutor":
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+    return out
+
+
+def _is_main_guard(test: ast.AST) -> bool:
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1 and
+            isinstance(test.ops[0], ast.Eq)):
+        return False
+    sides = [test.left] + list(test.comparators)
+    names = {n.id for n in sides if isinstance(n, ast.Name)}
+    consts = {c.value for c in sides if isinstance(c, ast.Constant)}
+    return "__name__" in names and "__main__" in consts
+
+
+def discover_roots(graph: CallGraph) -> List[ThreadRoot]:
+    """Every structurally-discovered thread root, sorted for stable
+    reporting. See the module docstring for the pattern list."""
+    roots: Dict[Tuple[str, str], ThreadRoot] = {}
+
+    def note(fn: Optional[FunctionInfo], kind: str, rel: str,
+             line: int) -> None:
+        if fn is not None:
+            roots.setdefault(fn.key,
+                             ThreadRoot(kind=kind, func=fn.key, rel=rel,
+                                        line=line))
+
+    scopes: List[Tuple[Module, Optional[FunctionInfo], ast.AST]] = []
+    for info in graph.iter_functions():
+        scopes.append((graph.modules[info.rel], info, info.node))
+    for mod in graph.modules.values():
+        scopes.append((mod, None, mod.tree))
+
+    pool_cache: Dict[str, Set[str]] = {}
+    for mod, scope, tree in scopes:
+        pool_names = pool_cache.get(mod.rel)
+        if pool_names is None:
+            pool_names = pool_cache[mod.rel] = _executor_vars(mod)
+        for node in _walk_scope(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = mod.dotted(node.func) or ""
+            leaf = dotted.rsplit(".", 1)[-1]
+            if leaf == "Thread" and dotted.endswith("threading.Thread"):
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        note(_resolve_ref(graph, mod, scope, kw.value),
+                             "Thread(target=)", mod.rel, node.lineno)
+            elif leaf == "Timer" and dotted.endswith("threading.Timer") \
+                    and len(node.args) >= 2:
+                note(_resolve_ref(graph, mod, scope, node.args[1]),
+                     "Timer", mod.rel, node.lineno)
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in ("submit", "map") and node.args:
+                recv = mod.dotted(node.func.value) or ""
+                recv_leaf = recv.rsplit(".", 1)[-1]
+                if recv_leaf in pool_names or \
+                        _EXECUTOR_RECV_RE.search(recv_leaf):
+                    note(_resolve_ref(graph, mod, scope, node.args[0]),
+                         f"executor.{node.func.attr}", mod.rel,
+                         node.lineno)
+
+    # HTTP handler classes: every request runs each handler method on a
+    # server thread.
+    handler_classes = {
+        key for key, cls in graph.classes.items()
+        if any("BaseHTTPRequestHandler" in b for b in cls.bases)
+    }
+    for info in graph.iter_functions():
+        if info.cls is not None and (info.rel, info.cls) in handler_classes:
+            note(info, "http-handler", info.rel, info.node.lineno)
+
+    # `if __name__ == "__main__":` project calls: subprocess/CLI entry
+    # points (multihost's spawned controllers run _child_main this way).
+    for mod in graph.modules.values():
+        for stmt in mod.tree.body:
+            if not (isinstance(stmt, ast.If) and _is_main_guard(stmt.test)):
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    note(graph.resolve_call(mod, node, None),
+                         "__main__ entry", mod.rel, node.lineno)
+
+    return sorted(roots.values(), key=lambda r: (r.func, r.rel, r.line))
+
+
+# ---------------------------------------------------------------------------
+# Per-root reachability with guaranteed-held entry locks
+# ---------------------------------------------------------------------------
+
+
+def _ctor_types(graph: CallGraph, mod: Module,
+                info: FunctionInfo) -> Dict[str, Tuple[str, str]]:
+    """Local names assigned from a project-class constructor in this
+    function: {name: (rel, class)}. The one step of type inference the
+    syntactic graph lacks — `engine = DPEngine(...)` followed by
+    `engine.aggregate(...)` resolves through it, which is what carries
+    the service worker root into the engine's cone."""
+    out: Dict[str, Tuple[str, str]] = {}
+    for node in _walk_scope(info.node):
+        if not (isinstance(node, ast.Assign) and
+                isinstance(node.value, ast.Call)):
+            continue
+        callee = graph.resolve_call(mod, node.value, info)
+        if callee is None or callee.cls is None or \
+                callee.name != "__init__":
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = (callee.rel, callee.cls)
+    return out
+
+
+def _reachable(graph: CallGraph, engine: "dataflow._LockEngine",
+               ctor_cache: Dict[Tuple[str, str],
+                                Dict[str, Tuple[str, str]]],
+               root: Tuple[str, str]
+               ) -> Tuple[Dict[Tuple[str, str], FrozenSet],
+                          Dict[Tuple[str, str], Tuple[str, ...]]]:
+    """(entry_locks, path) per function reachable from ``root``.
+
+    entry_locks[f] is the set of locks held on EVERY discovered call
+    chain root→f (intersection — only guaranteed locks count toward a
+    common-lock proof). path[f] is the first-discovered chain, hop
+    format identical to taint paths, capped at _MAX_PATH. Converges on
+    recursive (even self-spawning) code: entries only shrink and the
+    visited set is keyed by function."""
+    entry: Dict[Tuple[str, str], FrozenSet] = {root: frozenset()}
+    paths: Dict[Tuple[str, str], Tuple[str, ...]] = {root: ()}
+    work = [root]
+    while work:
+        fkey = work.pop()
+        info = graph.functions.get(fkey)
+        if info is None:
+            continue
+        mod = graph.modules[fkey[0]]
+        base = entry[fkey]
+        ctors = ctor_cache.get(fkey)
+        if ctors is None:
+            ctors = ctor_cache[fkey] = _ctor_types(graph, mod, info)
+        for event in engine._function_events(info):
+            if event[0] != "call":
+                continue
+            call, held = event[1], event[2]
+            callee = graph.resolve_call(mod, call, info)
+            if callee is None and \
+                    isinstance(call.func, ast.Attribute) and \
+                    isinstance(call.func.value, ast.Name):
+                typ = ctors.get(call.func.value.id)
+                if typ is not None:
+                    callee = graph.resolve_method(typ[0], typ[1],
+                                                  call.func.attr)
+            if callee is None:
+                continue
+            new_entry = frozenset(base | set(held))
+            old = entry.get(callee.key)
+            if old is None:
+                entry[callee.key] = new_entry
+                hop = f"{callee.qualname} ({info.rel}:{call.lineno})"
+                paths[callee.key] = (paths[fkey] + (hop,))[:_MAX_PATH]
+                work.append(callee.key)
+            elif not old <= new_entry:
+                entry[callee.key] = old & new_entry
+                work.append(callee.key)
+    return entry, paths
+
+
+# ---------------------------------------------------------------------------
+# Shared-state access collection
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Access:
+    loc: Loc
+    write: bool
+    rel: str
+    line: int
+    locks: FrozenSet    # locks held at the access (local `with` scoping)
+
+
+def _module_globals(mod: Module) -> Set[str]:
+    """Names bound by module-scope statements (assignment targets, not
+    defs/classes/imports)."""
+    out: Set[str] = set()
+    for stmt in mod.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Import, ast.ImportFrom)):
+            continue
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Store):
+                out.add(node.id)
+    return out
+
+
+def _primitive_locs(graph: CallGraph) -> Set[Loc]:
+    """Locations whose (every observed) initializer is a concurrency
+    primitive: module globals assigned one at module scope, and
+    ``self.x = threading.Event()``-style attributes anywhere in the
+    owner class."""
+    out: Set[Loc] = set()
+
+    def ctor_of(value: ast.AST, mod: Module) -> bool:
+        return isinstance(value, ast.Call) and \
+            (mod.dotted(value.func) or "") in _PRIMITIVE_CTORS
+
+    for mod in graph.modules.values():
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and ctor_of(stmt.value, mod):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        out.add((mod.rel, "", t.id))
+    for info in graph.iter_functions():
+        if info.cls is None:
+            continue
+        mod = graph.modules[info.rel]
+        for node in _walk_scope(info.node):
+            if not (isinstance(node, ast.Assign) and
+                    ctor_of(node.value, mod)):
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    out.add((info.rel, info.cls, t.attr))
+    return out
+
+
+def _owner_class(graph: CallGraph, info: FunctionInfo) -> Optional[str]:
+    """The class owning ``self`` inside ``info`` (methods directly;
+    nested defs through their enclosing method)."""
+    if info.cls is not None:
+        return info.cls
+    if info.enclosing:
+        outer = graph.functions.get((info.rel, info.enclosing[0]))
+        if outer is not None:
+            return outer.cls
+    return None
+
+
+def _local_names(info: FunctionInfo) -> Set[str]:
+    """Names that are function-local in ``info`` (params + stores),
+    minus explicit ``global`` declarations."""
+    args = info.node.args
+    names = {a.arg for a in (args.posonlyargs + args.args +
+                             args.kwonlyargs)}
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    declared_global: Set[str] = set()
+    for node in _walk_scope(info.node):
+        if isinstance(node, ast.Global):
+            declared_global.update(node.names)
+        elif isinstance(node, ast.Name) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                names.add(alias.asname or alias.name.split(".")[0])
+    return names - declared_global
+
+
+class _AccessCollector:
+    """Per-function shared-state access walk under held-lock scoping."""
+
+    def __init__(self, graph: CallGraph, cfg: "dataflow.LockConfig",
+                 skip: Set[Loc]):
+        self.graph = graph
+        self.cfg = cfg
+        self.skip = skip      # primitives + declared-guarded locations
+        self._mod_globals: Dict[str, Set[str]] = {}
+
+    def module_globals(self, mod: Module) -> Set[str]:
+        hit = self._mod_globals.get(mod.rel)
+        if hit is None:
+            hit = self._mod_globals[mod.rel] = _module_globals(mod)
+        return hit
+
+    def collect(self, info: FunctionInfo) -> List[Access]:
+        mod = self.graph.modules[info.rel]
+        mod_globals = self.module_globals(mod)
+        local = _local_names(info)
+        owner = _owner_class(self.graph, info)
+        out: List[Access] = []
+
+        def loc_of_name(name: str) -> Optional[Loc]:
+            if name in local or name not in mod_globals:
+                return None
+            loc = (info.rel, "", name)
+            return None if loc in self.skip else loc
+
+        def loc_of_expr(node: ast.AST) -> Optional[Loc]:
+            if isinstance(node, ast.Name):
+                return loc_of_name(node.id)
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self" and owner is not None:
+                loc = (info.rel, owner, node.attr)
+                return None if loc in self.skip else loc
+            return None
+
+        def emit(loc: Optional[Loc], write: bool, line: int,
+                 held: Tuple) -> None:
+            if loc is not None:
+                out.append(Access(loc=loc, write=write, rel=info.rel,
+                                  line=line, locks=frozenset(held)))
+
+        def visit(node: ast.AST, held: Tuple) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return  # separate scope, runs outside these locks
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in node.items:
+                    visit(item.context_expr, held)
+                    lock = dataflow._lock_of_with_item(
+                        mod, self.cfg, item, info)
+                    if lock is not None:
+                        acquired.append(lock)
+                    if item.optional_vars is not None:
+                        visit(item.optional_vars, held)
+                inner = held + tuple(acquired)
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATOR_ATTRS:
+                emit(loc_of_expr(node.func.value), True, node.lineno,
+                     held)
+            elif isinstance(node, ast.Subscript) and \
+                    isinstance(node.ctx, (ast.Store, ast.Del)):
+                emit(loc_of_expr(node.value), True, node.lineno, held)
+            elif isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self":
+                emit(loc_of_expr(node),
+                     isinstance(node.ctx, (ast.Store, ast.Del)),
+                     node.lineno, held)
+                return  # the `self` Name below it is not an access
+            elif isinstance(node, ast.Name):
+                emit(loc_of_name(node.id),
+                     isinstance(node.ctx, (ast.Store, ast.Del)),
+                     node.lineno, held)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in info.node.body:
+            visit(stmt, ())
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Race computation
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RaceAccess:
+    root: ThreadRoot
+    access: Access
+    # Guaranteed entry locks of the function containing the access.
+    entry_locks: FrozenSet
+    path: Tuple[str, ...]
+    # Class-level ownership (RacerD's idea at our per-class identity):
+    # True when the owner class's __init__ is in this root's cone — the
+    # root manufactures its own instances, so its accesses land on
+    # thread-confined state unless the instance is published. A pair of
+    # OWNED accesses from two roots is two instances, not a race. (The
+    # known miss: a root that both constructs and receives shared
+    # instances of the same class.)
+    owned: bool = False
+
+    @property
+    def locks(self) -> FrozenSet:
+        return self.access.locks | self.entry_locks
+
+    def render(self) -> str:
+        verb = "write" if self.access.write else "read"
+        chain = (f"root {self.root.describe()}",) + self.path + (
+            f"{verb} at {self.access.rel}:{self.access.line}",)
+        return " -> ".join(chain)
+
+
+@dataclasses.dataclass(frozen=True)
+class RaceFinding:
+    loc: Loc
+    kind: str            # "write-write" | "write-read" | "guard-candidate"
+    rel: str
+    line: int
+    a: RaceAccess
+    b: RaceAccess
+    candidate_lock: Optional[str]   # lock attr name for the fix-it
+
+
+@dataclasses.dataclass
+class ThreadReport:
+    roots: List[ThreadRoot]
+    races: List[RaceFinding]
+
+
+def _is_init_qualname(qualname: str) -> bool:
+    leaf = qualname.rsplit(".", 1)[-1]
+    return leaf in ("__init__", "__new__")
+
+
+def _immutable_after_init(graph: CallGraph,
+                          all_accesses: Dict[Tuple[str, str],
+                                             List[Access]]) -> Set[Loc]:
+    """Locations whose every function-level write happens in an
+    ``__init__``/``__new__`` (construction happens-before thread start
+    and publication)."""
+    writes: Dict[Loc, List[str]] = {}
+    for fkey, accesses in all_accesses.items():
+        for access in accesses:
+            if access.write:
+                writes.setdefault(access.loc, []).append(fkey[1])
+    return {
+        loc for loc, quals in writes.items()
+        if all(_is_init_qualname(q) for q in quals)
+    }
+
+
+def _lock_attr(lock) -> str:
+    return lock[2]
+
+
+def run_threads(graph: CallGraph,
+                declared_locks: Dict[Tuple[str, str], Set[str]],
+                declared_attrs: Set[Loc]) -> ThreadReport:
+    """The full pass: roots, per-root reachability, accesses, races.
+
+    declared_locks / declared_attrs come from the ``_GUARDED_BY``
+    declarations (rules.py parses them): declared attributes are the
+    lock-discipline rule's territory and are skipped here.
+    """
+    lock_cfg = dataflow.LockConfig(
+        declared=declared_locks, blocking_attrs=frozenset(),
+        blocking_dotted=frozenset(), blocking_funcs=set())
+    engine = dataflow._LockEngine(graph, lock_cfg)
+    roots = discover_roots(graph)
+
+    skip = _primitive_locs(graph) | set(declared_attrs)
+    collector = _AccessCollector(graph, lock_cfg, skip)
+    all_accesses: Dict[Tuple[str, str], List[Access]] = {
+        info.key: collector.collect(info)
+        for info in graph.iter_functions()
+    }
+    immutable = _immutable_after_init(graph, all_accesses)
+
+    # loc -> [RaceAccess] across every root's cone.
+    by_loc: Dict[Loc, List[RaceAccess]] = {}
+    ctor_cache: Dict[Tuple[str, str], Dict[str, Tuple[str, str]]] = {}
+    for root in roots:
+        entry, paths = _reachable(graph, engine, ctor_cache, root.func)
+        for fkey, entry_locks in entry.items():
+            for access in all_accesses.get(fkey, ()):
+                if access.loc in immutable:
+                    continue
+                rel, cls, _ = access.loc
+                if cls and fkey[1] in (f"{cls}.__init__",
+                                       f"{cls}.__new__"):
+                    # Own-attr accesses inside the constructor:
+                    # construction happens-before thread start and
+                    # publication — the same exemption lock-discipline
+                    # grants __init__.
+                    continue
+                owned = bool(cls) and (rel, f"{cls}.__init__") in entry
+                by_loc.setdefault(access.loc, []).append(
+                    RaceAccess(root=root, access=access,
+                               entry_locks=entry_locks,
+                               path=paths[fkey], owned=owned))
+
+    races: List[RaceFinding] = []
+    for loc, accesses in sorted(by_loc.items()):
+        race = _judge_location(loc, accesses)
+        if race is not None:
+            races.append(race)
+    races.sort(key=lambda r: (r.rel, r.line, r.loc))
+    return ThreadReport(roots=roots, races=races)
+
+
+def _judge_location(loc: Loc,
+                    accesses: List[RaceAccess]) -> Optional[RaceFinding]:
+    n_roots = len({a.root.func for a in accesses})
+    has_write = any(a.access.write for a in accesses)
+    if n_roots < 2 or not has_write:
+        return None
+
+    # The candidate guard: a lock some access already holds (most
+    # common first) — the _GUARDED_BY declaration the fix-it names.
+    lock_counts: Dict[Tuple, int] = {}
+    for a in accesses:
+        for lock in a.locks:
+            lock_counts[lock] = lock_counts.get(lock, 0) + 1
+    candidate = None
+    if lock_counts:
+        candidate = _lock_attr(sorted(lock_counts.items(),
+                                      key=lambda kv: (-kv[1],
+                                                      kv[0]))[0][0])
+
+    # Worst unsynchronized cross-root pair: write-write beats
+    # write-read; earliest lines win for stable reporting. A pair of
+    # OWNED accesses is two roots touching their own instances — never
+    # a race at our per-class identity.
+    best: Optional[Tuple[int, RaceAccess, RaceAccess]] = None
+    saw_shared_pair = False
+    order = sorted(accesses,
+                   key=lambda a: (not a.access.write, a.access.rel,
+                                  a.access.line))
+    for i, a in enumerate(order):
+        for b in order[i + 1:]:
+            if a.root.func == b.root.func:
+                continue
+            if not (a.access.write or b.access.write):
+                continue
+            if a.owned and b.owned:
+                continue
+            saw_shared_pair = True
+            if a.locks & b.locks:
+                continue
+            rank = 0 if (a.access.write and b.access.write) else 1
+            if best is None or rank < best[0]:
+                best = (rank, a, b)
+        if best is not None and best[0] == 0:
+            break
+    if best is not None:
+        rank, a, b = best
+        writer = a if a.access.write else b
+        return RaceFinding(
+            loc=loc, kind="write-write" if rank == 0 else "write-read",
+            rel=writer.access.rel, line=writer.access.line, a=a, b=b,
+            candidate_lock=candidate)
+    if not saw_shared_pair:
+        return None
+
+    # Every shared cross-root pair holds a common lock, but the
+    # attribute is not declared _GUARDED_BY: emit the fix-it so the
+    # lock-discipline rule takes over enforcement (and future unlocked
+    # accesses fail there).
+    common = frozenset.intersection(*(a.locks for a in accesses
+                                      if not a.owned))
+    if common:
+        writer = next(a for a in accesses if a.access.write)
+        other = next((a for a in accesses
+                      if a.root.func != writer.root.func), accesses[0])
+        return RaceFinding(
+            loc=loc, kind="guard-candidate", rel=writer.access.rel,
+            line=writer.access.line, a=writer, b=other,
+            candidate_lock=_lock_attr(sorted(common)[0]))
+    return None

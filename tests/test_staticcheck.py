@@ -43,6 +43,16 @@ def _analyze(sources, rule):
     return staticcheck.analyze(mods, only_rules=[rule]).active
 
 
+@pytest.fixture(scope="session")
+def tree_result():
+    """ONE full-tree pass shared by the tree gate, the lock-graph
+    proof and the full-tree SARIF exercise — the analysis (parse +
+    interprocedural fixpoints over ~150 modules) is the suite's
+    dominant fixed cost, so it runs once per session, not once per
+    test class."""
+    return staticcheck.run_tree()
+
+
 # ---------------------------------------------------------------------------
 # Per-rule fixtures. POSITIVE[rule] snippets each yield >= 1 finding of
 # that rule; SUPPRESSED[rule] snippets are positives with a valid inline
@@ -188,6 +198,27 @@ POSITIVE = {
             "def rogue(mech_type):\n"
             "    return MechanismSpec(mechanism_type=mech_type)\n"),
     },
+    "thread-escape": {
+        # A module global written by one thread root and read by
+        # another with no lock anywhere.
+        "pipelinedp_tpu/fix_threads.py": (
+            "import threading\n"
+            "_shared = {}\n"
+            "def _worker():\n"
+            "    _shared['k'] = 1\n"
+            "def _monitor():\n"
+            "    return _shared.get('k')\n"
+            "def start():\n"
+            "    threading.Thread(target=_worker).start()\n"
+            "    threading.Thread(target=_monitor).start()\n"),
+    },
+    "determinism": {
+        # set() iteration order flowing into a driver release.
+        "pipelinedp_tpu/executor.py": (
+            "def lazy_aggregate(backend, col):\n"
+            "    keys = set(col)\n"
+            "    return [(k, 1) for k in keys]\n"),
+    },
 }
 
 SUPPRESSED = {
@@ -305,6 +336,30 @@ SUPPRESSED = {
             "    return MechanismSpec(mechanism_type=mech_type)  "
             "# staticcheck: disable=budget-flow — fixture: test-only "
             "spec probe, never released\n"),
+    },
+    "thread-escape": {
+        # Findings anchor at the racing WRITE; the suppression sits
+        # there.
+        "pipelinedp_tpu/fix_threads.py": (
+            "import threading\n"
+            "_shared = {}\n"
+            "def _worker():\n"
+            "    _shared['k'] = 1  "
+            "# staticcheck: disable=thread-escape — fixture: "
+            "single-writer latch, reader tolerates staleness\n"
+            "def _monitor():\n"
+            "    return _shared.get('k')\n"
+            "def start():\n"
+            "    threading.Thread(target=_worker).start()\n"
+            "    threading.Thread(target=_monitor).start()\n"),
+    },
+    "determinism": {
+        "pipelinedp_tpu/executor.py": (
+            "def lazy_aggregate(backend, col):\n"
+            "    keys = set(col)\n"
+            "    return [(k, 1) for k in keys]  "
+            "# staticcheck: disable=determinism — fixture: sanctioned "
+            "unordered debug release, gated off in production\n"),
     },
 }
 
@@ -463,6 +518,28 @@ CLEAN = {
             "        return spec\n"
             "    def _register_mechanism(self, mechanism):\n"
             "        pass\n"),
+    },
+    "thread-escape": {
+        # Queue-mediated handoff: concurrency-primitive state is
+        # synchronized by construction.
+        "pipelinedp_tpu/fix_threads.py": (
+            "import queue\n"
+            "import threading\n"
+            "_q = queue.Queue()\n"
+            "def _producer():\n"
+            "    _q.put(1)\n"
+            "def _consumer():\n"
+            "    return _q.get()\n"
+            "def start():\n"
+            "    threading.Thread(target=_producer).start()\n"
+            "    threading.Thread(target=_consumer).start()\n"),
+    },
+    "determinism": {
+        # sorted() is the sanctioned sanitizer.
+        "pipelinedp_tpu/executor.py": (
+            "def lazy_aggregate(backend, col):\n"
+            "    keys = sorted(set(col))\n"
+            "    return [(k, 1) for k in keys]\n"),
     },
 }
 
@@ -727,6 +804,38 @@ class TestCli:
         for rid in staticcheck.rule_ids():
             assert rid in out
 
+    def test_rule_flag_filters_to_one_family(self, tmp_path, capsys):
+        """--rule (repeatable) runs exactly the named families."""
+        pkg = tmp_path / "parallel"
+        pkg.mkdir()
+        # One host-transfer finding AND one broad-except finding.
+        (pkg / "fix.py").write_text(
+            "import numpy as np\n"
+            "def f(x):\n"
+            "    try:\n"
+            "        return np.asarray(x)\n"
+            "    except Exception:\n"
+            "        return None\n")
+        rc = staticcheck.main([str(tmp_path), "--no-baseline",
+                               "--format=json", "--rule",
+                               "host-transfer"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert {f["rule_id"] for f in payload["findings"]} == \
+            {"host-transfer"}
+        rc = staticcheck.main([str(tmp_path), "--no-baseline",
+                               "--format=json", "--rule",
+                               "host-transfer", "--rule", "broad-except"])
+        payload = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert {f["rule_id"] for f in payload["findings"]} == \
+            {"host-transfer", "broad-except"}
+
+    def test_help_documents_exit_codes(self, capsys):
+        with pytest.raises(SystemExit):
+            staticcheck.main(["--help"])
+        assert "exit codes" in capsys.readouterr().out
+
     def test_module_entry_point_runs(self):
         proc = subprocess.run(
             [sys.executable, "-m", "pipelinedp_tpu.staticcheck",
@@ -738,10 +847,6 @@ class TestCli:
 
 class TestTreeGate:
     """The tier-1 gate: the committed tree is clean."""
-
-    @pytest.fixture(scope="class")
-    def tree_result(self):
-        return staticcheck.run_tree()
 
     def test_full_tree_has_no_unbaselined_findings(self, tree_result):
         _analysis, active, _baselined, _stale, _mods = tree_result
@@ -770,7 +875,8 @@ class TestTreeGate:
             assert e["file"].split("/")[0] in ("benchmarks",
                                                "examples"), e
         interprocedural = [e for e in entries if e["rule"] in
-                           ("release-taint", "lock-order", "budget-flow")]
+                           ("release-taint", "lock-order", "budget-flow",
+                            "thread-escape", "determinism")]
         assert interprocedural == [], interprocedural
 
     def test_every_reasoned_suppression_is_used(self, tree_result):
@@ -780,14 +886,16 @@ class TestTreeGate:
         # actually match findings, or they are dead comments.
         assert analysis.suppressed, "expected in-tree suppressions"
 
-    def test_lock_graph_over_runtime_is_acyclic(self):
+    def test_lock_graph_over_runtime_is_acyclic(self, tree_result):
         """Acceptance: the lock-acquisition graph over runtime/ (and the
         rest of the package) is PROVEN acyclic — any cycle would be an
-        active lock-order finding, and the committed tree has none."""
+        active lock-order finding, and the committed tree has none.
+        Reuses the session tree fixture's parsed modules instead of
+        re-loading the tree."""
         from pipelinedp_tpu.staticcheck import dataflow, rules
-        from pipelinedp_tpu.staticcheck.model import CallGraph
-        modules = staticcheck.load_tree(staticcheck.default_paths())
-        graph = CallGraph(modules)
+        modules = [m for m in tree_result[4]
+                   if m.rel.startswith("pipelinedp_tpu/")]
+        graph = rules._call_graph(modules)
         report = dataflow.run_locks(graph, dataflow.LockConfig(
             declared=rules._declared_locks(modules),
             blocking_attrs=rules.LOCK_BLOCKING_ATTRS,
@@ -801,6 +909,41 @@ class TestTreeGate:
         modules = tree_result[4]
         rels = {m.rel.split("/")[0] for m in modules}
         assert "benchmarks" in rels and "examples" in rels
+
+    def test_all_seven_threaded_subsystems_are_roots(self, tree_result):
+        """Acceptance: every threaded subsystem the repo actually runs
+        is DISCOVERED as a thread-escape root — a subsystem missing
+        here is invisible to the race analysis (the bench receipt's
+        thread_roots count quantifies the same domain)."""
+        from pipelinedp_tpu.staticcheck import rules, threads
+        modules = [m for m in tree_result[4]
+                   if m.rel.startswith("pipelinedp_tpu/")]
+        roots = threads.discover_roots(rules._call_graph(modules))
+        by_func = {r.func for r in roots}
+        expected = {
+            # service worker pool
+            ("pipelinedp_tpu/service/service.py",
+             "DPAggregationService._worker_loop"),
+            # blocked drivers' drainer thread
+            ("pipelinedp_tpu/parallel/large_p.py",
+             "_dispatch_blocks_overlapped.drainer"),
+            # map_overlapped feeder + encode pool
+            ("pipelinedp_tpu/runtime/pipeline.py", "map_overlapped.feed"),
+            ("pipelinedp_tpu/runtime/pipeline.py",
+             "map_overlapped.encode"),
+            # watchdog monitor
+            ("pipelinedp_tpu/runtime/watchdog.py",
+             "Watchdog._run_monitor"),
+            # metrics exporters (file loop + HTTP handler)
+            ("pipelinedp_tpu/runtime/observability.py",
+             "MetricsExporter._file_loop"),
+            ("pipelinedp_tpu/runtime/observability.py",
+             "_ScrapeHandler.do_GET"),
+            # multihost children (subprocess entry)
+            ("pipelinedp_tpu/runtime/multihost.py", "_child_main"),
+        }
+        missing = expected - by_func
+        assert not missing, missing
 
 
 class TestInterproceduralRules:
@@ -983,6 +1126,25 @@ class TestSarif:
         assert rc == 0
         assert payload["runs"][0]["results"] == []
 
+    def test_sarif_covers_new_rule_families(self):
+        """The driver rule table carries the v3 families (CI viewers
+        resolve ruleId against it)."""
+        from pipelinedp_tpu.staticcheck.cli import to_sarif
+        driver = to_sarif([], [])["runs"][0]["tool"]["driver"]
+        ids = {r["id"] for r in driver["rules"]}
+        assert {"thread-escape", "determinism"} <= ids
+        assert driver["version"] == staticcheck.RULES_VERSION
+
+    def test_sarif_over_full_tree_renders(self, tree_result):
+        """Full-tree SARIF export (on the shared session analysis —
+        no re-analysis) is schema-shaped and result-free on the clean
+        committed tree."""
+        from pipelinedp_tpu.staticcheck.cli import to_sarif
+        _analysis, active, _baselined, stale, _mods = tree_result
+        payload = to_sarif(active, stale)
+        assert payload["version"] == "2.1.0"
+        assert payload["runs"][0]["results"] == []
+
 
 class TestIncremental:
     """--cache / --changed-only: byte-identical findings to a cold run."""
@@ -1061,3 +1223,33 @@ class TestIncremental:
 
     def test_changed_only_requires_cache(self, capsys):
         assert staticcheck.main(["--changed-only"]) == 2
+
+    def test_rules_version_bump_invalidates_cache(self, tmp_path,
+                                                  capsys, monkeypatch):
+        """A RULES_VERSION bump must cold-parse: --changed-only trusts
+        cache entries without re-hashing, so an entry written under the
+        old rule set would otherwise be served to a NEW rule set
+        entirely unchecked."""
+        from pipelinedp_tpu.staticcheck import cache as sc_cache
+        from pipelinedp_tpu.staticcheck import core as sc_core
+        root = self._tree(tmp_path)
+        cache = str(tmp_path / "model.pkl")
+        staticcheck.main([root, "--no-baseline", "--format=json",
+                          "--cache", cache])
+        capsys.readouterr()
+        # Same version: warm hits.
+        warm = sc_cache.ModelCache(cache)
+        warm.get(str(tmp_path / "other.py"))
+        assert warm.hits == 1
+        # Bumped version: the whole cache is discarded, every file
+        # re-parses.
+        monkeypatch.setattr(sc_core, "RULES_VERSION",
+                            sc_core.RULES_VERSION + "-bumped")
+        cold = sc_cache.ModelCache(cache)
+        cold.get(str(tmp_path / "other.py"))
+        assert cold.hits == 0 and cold.misses == 1
+        # And the bumped-version save round-trips under its own key.
+        cold.save()
+        again = sc_cache.ModelCache(cache)
+        again.get(str(tmp_path / "other.py"))
+        assert again.hits == 1

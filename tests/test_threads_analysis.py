@@ -1,0 +1,484 @@
+"""The thread-escape race engine + the static determinism rule.
+
+Covers, per the v3 issue: structural root discovery for all seven
+spawn patterns, write-write and write-read races with the full
+root→access path in the message, common-lock and immutable-after-init
+declassification, the guard-candidate fix-it, set-iteration release
+flows, the sorted() sanitizer, and convergence on recursive
+thread-spawning code — plus the regression test for the real race the
+first full-tree run caught (combiners' namedtuple-type cache).
+"""
+
+import pytest
+
+from pipelinedp_tpu import staticcheck
+from pipelinedp_tpu.staticcheck import rules as sc_rules
+from pipelinedp_tpu.staticcheck import threads as sc_threads
+from pipelinedp_tpu.staticcheck.model import CallGraph
+
+pytestmark = pytest.mark.staticcheck
+
+
+def _analyze(sources, rule):
+    mods = [staticcheck.parse_source(rel, src)
+            for rel, src in sources.items()]
+    return staticcheck.analyze(mods, only_rules=[rule]).active
+
+
+def _roots(sources):
+    mods = [staticcheck.parse_source(rel, src)
+            for rel, src in sources.items()]
+    return sc_threads.discover_roots(CallGraph(mods))
+
+
+# ---------------------------------------------------------------------------
+# Root discovery: the seven structural spawn patterns
+# ---------------------------------------------------------------------------
+
+
+class TestRootDiscovery:
+
+    def test_thread_target_function(self):
+        roots = _roots({"pipelinedp_tpu/fix.py": (
+            "import threading\n"
+            "def work():\n"
+            "    pass\n"
+            "def start():\n"
+            "    threading.Thread(target=work, daemon=True).start()\n")})
+        assert [(r.func[1], r.kind) for r in roots] == \
+            [("work", "Thread(target=)")]
+
+    def test_thread_target_self_method(self):
+        """The watchdog-monitor form: Thread(target=self._m)."""
+        roots = _roots({"pipelinedp_tpu/fix.py": (
+            "import threading\n"
+            "class Monitor:\n"
+            "    def _run(self):\n"
+            "        pass\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._run).start()\n")})
+        assert [r.func[1] for r in roots] == ["Monitor._run"]
+
+    def test_timer(self):
+        roots = _roots({"pipelinedp_tpu/fix.py": (
+            "import threading\n"
+            "def fire():\n"
+            "    pass\n"
+            "def arm():\n"
+            "    threading.Timer(5.0, fire).start()\n")})
+        assert [(r.func[1], r.kind) for r in roots] == [("fire", "Timer")]
+
+    def test_executor_submit(self):
+        roots = _roots({"pipelinedp_tpu/fix.py": (
+            "from concurrent import futures\n"
+            "def encode(x):\n"
+            "    return x\n"
+            "def run(items):\n"
+            "    pool = futures.ThreadPoolExecutor(2)\n"
+            "    return [pool.submit(encode, i) for i in items]\n")})
+        assert [(r.func[1], r.kind) for r in roots] == \
+            [("encode", "executor.submit")]
+
+    def test_executor_map(self):
+        roots = _roots({"pipelinedp_tpu/fix.py": (
+            "from concurrent import futures\n"
+            "def encode(x):\n"
+            "    return x\n"
+            "def run(items):\n"
+            "    pool = futures.ThreadPoolExecutor(2)\n"
+            "    return list(pool.map(encode, items))\n")})
+        assert [(r.func[1], r.kind) for r in roots] == \
+            [("encode", "executor.map")]
+
+    def test_backend_map_is_not_an_executor(self):
+        """The pipeline-backend `.map(col, fn)` API never matches: the
+        receiver is not executor-like and would mis-root the whole
+        engine."""
+        roots = _roots({"pipelinedp_tpu/fix.py": (
+            "def build(backend, col):\n"
+            "    return backend.map(col, lambda x: x, 'stage')\n")})
+        assert roots == []
+
+    def test_http_handler_class(self):
+        roots = _roots({"pipelinedp_tpu/fix.py": (
+            "import http.server\n"
+            "class Handler(http.server.BaseHTTPRequestHandler):\n"
+            "    def do_GET(self):\n"
+            "        pass\n")})
+        assert [(r.func[1], r.kind) for r in roots] == \
+            [("Handler.do_GET", "http-handler")]
+
+    def test_main_guard_subprocess_entry(self):
+        roots = _roots({"pipelinedp_tpu/fix.py": (
+            "import sys\n"
+            "def child_main(arg):\n"
+            "    return 0\n"
+            "if __name__ == '__main__':\n"
+            "    sys.exit(child_main(sys.argv[1]))\n")})
+        assert [(r.func[1], r.kind) for r in roots] == \
+            [("child_main", "__main__ entry")]
+
+    def test_nested_feeder_and_pool_workers(self):
+        """The map_overlapped shape: a nested feeder thread plus pool
+        submits of a sibling nested function — both are roots."""
+        roots = _roots({"pipelinedp_tpu/fix.py": (
+            "import threading\n"
+            "from concurrent import futures\n"
+            "def run(items, fn):\n"
+            "    pool = futures.ThreadPoolExecutor(2)\n"
+            "    def encode(item):\n"
+            "        return fn(item)\n"
+            "    def feed():\n"
+            "        for item in items:\n"
+            "            pool.submit(encode, item)\n"
+            "    threading.Thread(target=feed).start()\n")})
+        assert {r.func[1] for r in roots} == {"run.encode", "run.feed"}
+
+
+# ---------------------------------------------------------------------------
+# Races, paths, declassification
+# ---------------------------------------------------------------------------
+
+_TWO_ROOT_PREAMBLE = (
+    "import threading\n"
+    "def start():\n"
+    "    threading.Thread(target=_worker).start()\n"
+    "    threading.Thread(target=_monitor).start()\n")
+
+
+class TestRaces:
+
+    def test_write_read_race_with_paths(self):
+        (f,) = _analyze({"pipelinedp_tpu/fix.py": (
+            "import threading\n"
+            "_state = {}\n"
+            "def _worker():\n"
+            "    _state['k'] = 1\n"
+            "def _monitor():\n"
+            "    return _state.get('k')\n" + _TWO_ROOT_PREAMBLE[17:])},
+            "thread-escape")
+        assert "write-read race" in f.message
+        assert f.line == 4  # anchored at the racing write
+        assert "root _worker" in f.message and \
+            "root _monitor" in f.message
+        assert "write at pipelinedp_tpu/fix.py:4" in f.message
+        assert "read at pipelinedp_tpu/fix.py:6" in f.message
+
+    def test_write_write_race_through_helper_carries_hops(self):
+        """Interprocedural: the racing write sits two hops from the
+        root and the path names every hop."""
+        (f,) = _analyze({"pipelinedp_tpu/fix.py": (
+            "import threading\n"
+            "_counts = {}\n"
+            "def _bump(name):\n"
+            "    _counts[name] = _counts.get(name, 0) + 1\n"
+            "def _worker():\n"
+            "    _bump('a')\n"
+            "def _monitor():\n"
+            "    _bump('b')\n" + _TWO_ROOT_PREAMBLE[17:])},
+            "thread-escape")
+        assert "write-write race" in f.message
+        assert "_bump (pipelinedp_tpu/fix.py:6)" in f.message
+        assert "_bump (pipelinedp_tpu/fix.py:8)" in f.message
+
+    def test_common_lock_declassifies_and_fixit_names_declaration(self):
+        """Consistently-locked-but-undeclared shared state is not a
+        race — it is a guard-candidate fix-it naming the _GUARDED_BY
+        declaration to add (unification with lock-discipline)."""
+        (f,) = _analyze({"pipelinedp_tpu/fix.py": (
+            "import threading\n"
+            "_lock = threading.Lock()\n"
+            "_state = {}\n"
+            "def _worker():\n"
+            "    with _lock:\n"
+            "        _state['k'] = 1\n"
+            "def _monitor():\n"
+            "    with _lock:\n"
+            "        return _state.get('k')\n" +
+            _TWO_ROOT_PREAMBLE[17:])}, "thread-escape")
+        assert "guarded_by('_lock', '_state')" in f.message
+        assert "race" not in f.message.split(":")[0]
+
+    def test_declared_guarded_attr_is_lock_disciplines_territory(self):
+        """A _GUARDED_BY-declared attribute is skipped entirely —
+        lock-discipline owns its enforcement."""
+        src = (
+            "import threading\n"
+            "from pipelinedp_tpu.runtime.concurrency import guarded_by\n"
+            "_lock = threading.Lock()\n"
+            "_state = {}\n"
+            "_GUARDED_BY = guarded_by('_lock', '_state')\n"
+            "def _worker():\n"
+            "    with _lock:\n"
+            "        _state['k'] = 1\n"
+            "def _monitor():\n"
+            "    with _lock:\n"
+            "        return _state.get('k')\n" + _TWO_ROOT_PREAMBLE[17:])
+        assert _analyze({"pipelinedp_tpu/fix.py": src},
+                        "thread-escape") == []
+
+    def test_partial_lock_race_names_candidate_guard(self):
+        """One root locks, the other does not: a race whose fix-it
+        names the lock the guarded access already holds."""
+        (f,) = _analyze({"pipelinedp_tpu/fix.py": (
+            "import threading\n"
+            "_lock = threading.Lock()\n"
+            "_state = {}\n"
+            "def _worker():\n"
+            "    with _lock:\n"
+            "        _state['k'] = 1\n"
+            "def _monitor():\n"
+            "    return _state.get('k')\n" + _TWO_ROOT_PREAMBLE[17:])},
+            "thread-escape")
+        assert "race" in f.message
+        assert "guarded_by('_lock', '_state')" in f.message
+
+    def test_interprocedural_entry_locks_declassify_helpers(self):
+        """A helper ONLY ever called under the lock analyzes as holding
+        it (entry-lock intersection), so caller-locked discipline needs
+        no annotation."""
+        src = (
+            "import threading\n"
+            "_lock = threading.Lock()\n"
+            "_state = {}\n"
+            "def _touch(k):\n"
+            "    _state[k] = 1\n"
+            "def _worker():\n"
+            "    with _lock:\n"
+            "        _touch('a')\n"
+            "def _monitor():\n"
+            "    with _lock:\n"
+            "        _touch('b')\n" + _TWO_ROOT_PREAMBLE[17:])
+        found = _analyze({"pipelinedp_tpu/fix.py": src}, "thread-escape")
+        assert all("race" not in f.message.split(":")[0] for f in found)
+
+    def test_queue_event_state_is_declassified(self):
+        src = (
+            "import queue\n"
+            "import threading\n"
+            "_q = queue.Queue()\n"
+            "_done = threading.Event()\n"
+            "def _worker():\n"
+            "    _q.put(1)\n"
+            "    _done.set()\n"
+            "def _monitor():\n"
+            "    _done.wait()\n"
+            "    return _q.get()\n" + _TWO_ROOT_PREAMBLE[17:])
+        assert _analyze({"pipelinedp_tpu/fix.py": src},
+                        "thread-escape") == []
+
+    def test_immutable_after_init_is_declassified(self):
+        """Attributes written only in __init__ are published before any
+        thread starts — reads from two roots are not a race."""
+        src = (
+            "import threading\n"
+            "class Job:\n"
+            "    def __init__(self, path):\n"
+            "        self.path = path\n"
+            "    def _worker(self):\n"
+            "        return self.path\n"
+            "    def _monitor(self):\n"
+            "        return self.path\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._worker).start()\n"
+            "        threading.Thread(target=self._monitor).start()\n")
+        assert _analyze({"pipelinedp_tpu/fix.py": src},
+                        "thread-escape") == []
+
+    def test_mutable_attr_on_shared_instance_is_a_race(self):
+        """The contrast case: the same attribute written outside
+        __init__ from one root and read from another IS a race."""
+        src = (
+            "import threading\n"
+            "class Job:\n"
+            "    def __init__(self):\n"
+            "        self.state = None\n"
+            "    def _worker(self):\n"
+            "        self.state = 'running'\n"
+            "    def _monitor(self):\n"
+            "        return self.state\n"
+            "    def start(self):\n"
+            "        threading.Thread(target=self._worker).start()\n"
+            "        threading.Thread(target=self._monitor).start()\n")
+        (f,) = _analyze({"pipelinedp_tpu/fix.py": src}, "thread-escape")
+        assert "self.state" in f.message and "race" in f.message
+
+    def test_per_root_constructed_instances_are_owned(self):
+        """Two roots each constructing their OWN instance of a class
+        touch different objects — ownership declassifies the pair."""
+        src = (
+            "import threading\n"
+            "class Span:\n"
+            "    def __init__(self):\n"
+            "        self.attrs = {}\n"
+            "    def set(self, **kw):\n"
+            "        self.attrs.update(kw)\n"
+            "def _worker():\n"
+            "    Span().set(a=1)\n"
+            "def _monitor():\n"
+            "    Span().set(b=2)\n" + _TWO_ROOT_PREAMBLE[17:])
+        assert _analyze({"pipelinedp_tpu/fix.py": src},
+                        "thread-escape") == []
+
+    def test_converges_on_recursive_thread_spawning(self):
+        """A root that re-spawns itself (and recurses) must terminate
+        and still report its races."""
+        (f,) = _analyze({"pipelinedp_tpu/fix.py": (
+            "import threading\n"
+            "_state = {}\n"
+            "def _worker(depth):\n"
+            "    _state['d'] = depth\n"
+            "    if depth:\n"
+            "        _worker(depth - 1)\n"
+            "    threading.Thread(target=_worker, args=(depth,)).start()\n"
+            "def _monitor():\n"
+            "    return _state.get('d')\n"
+            "def start():\n"
+            "    threading.Thread(target=_monitor).start()\n")},
+            "thread-escape")
+        assert "_state" in f.message
+
+    def test_suppression_requires_reason(self):
+        src = {"pipelinedp_tpu/fix.py": (
+            "import threading\n"
+            "_state = {}\n"
+            "def _worker():\n"
+            "    _state['k'] = 1  # staticcheck: disable=thread-escape\n"
+            "def _monitor():\n"
+            "    return _state.get('k')\n" + _TWO_ROOT_PREAMBLE[17:])}
+        (f,) = _analyze(src, "thread-escape")
+        assert "suppression ignored" in f.message
+
+
+# ---------------------------------------------------------------------------
+# Determinism rule
+# ---------------------------------------------------------------------------
+
+
+class TestDeterminism:
+
+    def test_set_iteration_into_release_flow(self):
+        (f,) = _analyze({"pipelinedp_tpu/executor.py": (
+            "def lazy_aggregate(backend, col):\n"
+            "    keys = set(col)\n"
+            "    return [(k, 1) for k in keys]\n")}, "determinism")
+        assert "driver release value" in f.message
+        assert "set() iteration order" in f.message
+
+    def test_sorted_sanitizes(self):
+        assert _analyze({"pipelinedp_tpu/executor.py": (
+            "def lazy_aggregate(backend, col):\n"
+            "    keys = sorted(set(col))\n"
+            "    return [(k, 1) for k in keys]\n")}, "determinism") == []
+
+    def test_order_insensitive_reductions_sanitize(self):
+        assert _analyze({"pipelinedp_tpu/executor.py": (
+            "def lazy_aggregate(backend, col):\n"
+            "    keys = set(col)\n"
+            "    return len(keys), max(keys), sum(keys)\n")},
+            "determinism") == []
+
+    def test_multi_hop_path_in_message(self):
+        (f,) = _analyze({"pipelinedp_tpu/executor.py": (
+            "def _uniq(col):\n"
+            "    return set(col)\n"
+            "def lazy_aggregate(backend, col):\n"
+            "    for k in _uniq(col):\n"
+            "        yield k, 1\n")}, "determinism")
+        assert "_uniq (pipelinedp_tpu/executor.py:4)" in f.message
+
+    def test_listdir_into_journal_key(self):
+        (f,) = _analyze({"pipelinedp_tpu/fix.py": (
+            "import os\n"
+            "def persist(journal, job):\n"
+            "    for name in os.listdir('.'):\n"
+            "        journal.put(job, name, {'v': 1})\n")}, "determinism")
+        assert "journal key" in f.message
+        assert "os.listdir() order" in f.message
+
+    def test_set_into_fold_in_derivation(self):
+        (f,) = _analyze({"pipelinedp_tpu/fix.py": (
+            "import jax\n"
+            "def derive(key, items):\n"
+            "    for b in set(items):\n"
+            "        yield jax.random.fold_in(key, b)\n")}, "determinism")
+        assert "fold_in noise-key derivation" in f.message
+
+    def test_set_literal_is_a_source(self):
+        (f,) = _analyze({"pipelinedp_tpu/executor.py": (
+            "def lazy_aggregate(backend, a, b):\n"
+            "    return list({a, b})\n")}, "determinism")
+        assert "set-literal iteration order" in f.message
+
+    def test_event_set_is_not_a_source(self):
+        """`ev.set()` must never match the set() constructor — exact
+        canonical-name matching."""
+        assert _analyze({"pipelinedp_tpu/executor.py": (
+            "def lazy_aggregate(backend, ev, col):\n"
+            "    done = ev.set()\n"
+            "    return [done, list(col)]\n")}, "determinism") == []
+
+    def test_dict_from_set_keeps_order_taint(self):
+        (f,) = _analyze({"pipelinedp_tpu/executor.py": (
+            "def lazy_aggregate(backend, col):\n"
+            "    d = dict.fromkeys(set(col))\n"
+            "    return [k for k in d]\n")}, "determinism")
+        assert "set() iteration order" in f.message
+
+
+# ---------------------------------------------------------------------------
+# Regression: the real race the first full-tree run caught
+# ---------------------------------------------------------------------------
+
+
+class TestFirstRunRegression:
+
+    def _combiners_sources(self, strip_lock: bool):
+        import pipelinedp_tpu.combiners as combiners
+        with open(combiners.__file__) as f:
+            src = f.read()
+        guarded = "    with _named_tuple_cache_lock:\n"
+        decl = ('_GUARDED_BY = guarded_by("_named_tuple_cache_lock", '
+                '"_named_tuple_cache")\n')
+        assert guarded in src and decl in src, \
+            "combiners namedtuple-cache layout changed"
+        if strip_lock:
+            # The pre-fix state: no lock around the get-or-create AND
+            # no _GUARDED_BY declaration (a declared attr is
+            # lock-discipline's territory, not thread-escape's).
+            src = src.replace(decl, "")
+            lines = src.splitlines(keepends=True)
+            i = lines.index(guarded)
+            j = i + 1
+            while j < len(lines) and (lines[j].startswith("        ") or
+                                      lines[j].strip() == ""):
+                lines[j] = lines[j][4:] if lines[j].strip() else lines[j]
+                j += 1
+            del lines[i]
+            src = "".join(lines)
+        # Two service-worker-shaped roots constructing compound
+        # combiners concurrently (the service pool's first-run shape).
+        driver = (
+            "import threading\n"
+            "from pipelinedp_tpu.combiners import CompoundCombiner\n"
+            "def _job_a():\n"
+            "    return CompoundCombiner([], True)\n"
+            "def _job_b():\n"
+            "    return CompoundCombiner([], True)\n"
+            "def start():\n"
+            "    threading.Thread(target=_job_a).start()\n"
+            "    threading.Thread(target=_job_b).start()\n")
+        return {"pipelinedp_tpu/combiners.py": src,
+                "pipelinedp_tpu/fix_driver.py": driver}
+
+    def test_unlocked_namedtuple_cache_is_a_race(self):
+        """Stripping the lock the first-run triage added re-surfaces
+        the write-write race on _named_tuple_cache."""
+        found = _analyze(self._combiners_sources(strip_lock=True),
+                         "thread-escape")
+        assert any("_named_tuple_cache" in f.message and
+                   "race" in f.message for f in found), found
+
+    def test_committed_combiners_cache_is_clean(self):
+        assert _analyze(self._combiners_sources(strip_lock=False),
+                        "thread-escape") == []
