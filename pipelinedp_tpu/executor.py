@@ -32,11 +32,13 @@ scalars, so BudgetAccountant.compute_budgets() may run after compilation;
 the engine wraps execution in a lazy generator that runs on first iteration.
 """
 
+import contextlib
 import dataclasses
 import functools
 import hashlib
 import logging
 import math
+import threading
 from dataclasses import dataclass
 from typing import Any, List, Optional, Sequence, Tuple
 
@@ -957,6 +959,36 @@ aggregate_release_kernel = rt_aot.aot_probe("aggregate_release_kernel",
                                             static_argnames=("cfg",))
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def batched_aggregate_release_kernel(pid, pk, values, valid, min_v, max_v,
+                                     min_s, max_s, mid, stds, rng_keys,
+                                     cfg: KernelConfig, secure_tables=None):
+    """Lane-stacked aggregate_release_kernel: ONE launch releases L jobs.
+
+    Row arrays carry a leading job-lane axis ([L, n] / [L, n, V]) and
+    rng_keys is the [L, 2] stack of each job's own base key; scalars,
+    stds and cfg are shared (lanes coalesce only on an identical launch
+    fingerprint — see service/batching.py). The body is _aggregate_trace
+    + compact_release vmapped over the lane axis, and threefry keys are
+    counter-based and elementwise, so lane l's outputs are bit-identical
+    to aggregate_release_kernel on that lane's arrays and key alone —
+    the megabatching guarantee the batching tier asserts per lane."""
+
+    def lane(pid_l, pk_l, values_l, valid_l, key_l):
+        outputs, keep, row_count = _aggregate_trace(
+            pid_l, pk_l, values_l, valid_l, min_v, max_v, min_s, max_s,
+            mid, stds, key_l, cfg, secure_tables)
+        n_kept, order, outputs_sorted = compact_release(outputs, keep)
+        return n_kept, order, outputs_sorted, row_count
+
+    return jax.vmap(lane)(pid, pk, values, valid, rng_keys)
+
+
+batched_aggregate_release_kernel = rt_aot.aot_probe(
+    "batched_aggregate_release_kernel", batched_aggregate_release_kernel,
+    static_argnames=("cfg",))
+
+
 def select_partition_counts(pid, pk, valid, key: jax.Array, l0: int,
                             n_partitions: int) -> jnp.ndarray:
     """Per-partition privacy-id counts after pair dedupe + L0 sampling.
@@ -1080,6 +1112,31 @@ select_partitions_kernel = rt_aot.aot_probe(
     static_argnames=("l0", "n_partitions", "selection"))
 select_partitions_release_kernel = rt_aot.aot_probe(
     "select_partitions_release_kernel", select_partitions_release_kernel,
+    static_argnames=("l0", "n_partitions", "selection"))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("l0", "n_partitions", "selection"))
+def batched_select_partitions_release_kernel(
+        pid, pk, valid, rng_keys, l0: int, n_partitions: int,
+        selection: selection_ops.SelectionParams):
+    """Lane-stacked select_partitions_release_kernel: row arrays carry a
+    leading job-lane axis and rng_keys is [L, 2]; lane l's (n_kept,
+    ids_sorted) is bit-identical to the solo kernel on that lane alone
+    (same vmap/threefry argument as batched_aggregate_release_kernel)."""
+
+    def lane(pid_l, pk_l, valid_l, key_l):
+        keep = _select_partitions_trace(pid_l, pk_l, valid_l, key_l, l0,
+                                        n_partitions, selection)
+        order = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+        return keep.sum(), order
+
+    return jax.vmap(lane)(pid, pk, valid, rng_keys)
+
+
+batched_select_partitions_release_kernel = rt_aot.aot_probe(
+    "batched_select_partitions_release_kernel",
+    batched_select_partitions_release_kernel,
     static_argnames=("l0", "n_partitions", "selection"))
 
 
@@ -1234,6 +1291,77 @@ def _encode_input(backend, rows, data_extractors, public_list=None):
         return columnar.encode(rows, data_extractors, public_list)
 
 
+@dataclass
+class ReleaseLaunch:
+    """One job's dense fused release launch, offered to the active
+    launch interceptor (the service's megabatching tier) instead of
+    dispatching solo.
+
+    Carries exactly the arrays/statics the solo kernel call would get:
+    for kind="aggregate" the pad_rows-padded row arrays plus the traced
+    scalars/stds and the static cfg; for kind="select" the selection
+    arrays (padded for a single-device launch, unpadded for a meshed
+    one — the meshed dispatcher stages lanes itself, exactly like
+    stage_rows_to_mesh's host path) plus the static (l0, n_partitions,
+    selection) triple. `key` is the job's own base noise key — lanes
+    keep their solo keys, which is what makes a batched lane's release
+    bit-identical to its solo run."""
+    kind: str  # "aggregate" | "select"
+    mesh: Any
+    reshard: str
+    pid: Any
+    pk: Any
+    valid: Any
+    key: Any
+    values: Any = None
+    scalars: Optional[Tuple[float, ...]] = None
+    stds: Any = None
+    cfg: Optional[KernelConfig] = None
+    secure_tables: Any = None
+    l0: int = 0
+    n_partitions: int = 0
+    selection: Any = None
+
+
+# Per-thread launch interceptor: the service's batching tier installs a
+# callable here around a job's execution; the dense fused launch sites
+# below offer their ReleaseLaunch to it before dispatching solo. The
+# interceptor returns the lane's kernel-shaped result (the job ran as
+# one lane of a megabatched launch) or None (run solo — lone lane at
+# window expiry, mixed specs, or a batched dispatch falling back).
+_LAUNCH_INTERCEPTOR = threading.local()
+
+
+def _active_launch_interceptor():
+    return getattr(_LAUNCH_INTERCEPTOR, "fn", None)
+
+
+@contextlib.contextmanager
+def launch_interceptor(fn):
+    """Installs `fn` as this thread's release-launch interceptor (None
+    reinstalls nothing). Scoped: the previous interceptor is restored
+    on exit, so nested jobs cannot leak a coalescer across threads."""
+    prev = getattr(_LAUNCH_INTERCEPTOR, "fn", None)
+    _LAUNCH_INTERCEPTOR.fn = fn
+    try:
+        yield
+    finally:
+        _LAUNCH_INTERCEPTOR.fn = prev
+
+
+def _offerable(interceptor, fused: bool, arr, backend) -> bool:
+    """A launch can join a batch only when an interceptor is active,
+    the fused release is on, rows are host numpy (streamed/device-
+    resident encodings keep their solo device path), and a meshed
+    backend is not forced onto the collective reshard (the batched
+    meshed dispatcher stages lanes through the host LPT permutation —
+    the same path solo host-numpy staging takes)."""
+    return (interceptor is not None and fused
+            and isinstance(arr, np.ndarray)
+            and (backend.mesh is None
+                 or getattr(backend, "reshard", "auto") != "device"))
+
+
 def lazy_select_partitions(backend, col, params, data_extractors,
                            budget_accountant, report_generator):
     """Graph-time setup + lazily executed device partition selection.
@@ -1301,18 +1429,30 @@ def lazy_select_partitions(backend, col, params, data_extractors,
             return
         fused = bool(getattr(backend, "fused_release", True))
         aot_flag = getattr(backend, "aot", None)
+        interceptor = _active_launch_interceptor()
         if backend.mesh is not None:
             from pipelinedp_tpu.parallel import sharded
             with budget_accountant.no_new_mechanisms(
                     "sharded partition selection execution"), \
                     rt_aot.activate(aot_flag):
-                result = sharded.sharded_select_partitions(
-                    backend.mesh, encoded.pid, encoded.pk, encoded.valid,
-                    key, params.max_partitions_contributed, n_partitions,
-                    selection, fused=fused,
-                    reshard=getattr(backend, "reshard", "auto"),
-                    **_dense_runtime_kwargs(backend,
-                                            "sharded_select_partitions"))
+                result = None
+                if _offerable(interceptor, fused, encoded.pid, backend):
+                    result = interceptor(ReleaseLaunch(
+                        kind="select", mesh=backend.mesh,
+                        reshard=getattr(backend, "reshard", "auto"),
+                        pid=encoded.pid, pk=encoded.pk,
+                        valid=encoded.valid, key=key,
+                        l0=params.max_partitions_contributed,
+                        n_partitions=n_partitions, selection=selection))
+                if result is None:
+                    result = sharded.sharded_select_partitions(
+                        backend.mesh, encoded.pid, encoded.pk,
+                        encoded.valid, key,
+                        params.max_partitions_contributed, n_partitions,
+                        selection, fused=fused,
+                        reshard=getattr(backend, "reshard", "auto"),
+                        **_dense_runtime_kwargs(
+                            backend, "sharded_select_partitions"))
                 rt_telemetry.record("release_dispatches")
         else:
             # Selection never reads values; a zero-width column keeps
@@ -1322,12 +1462,21 @@ def lazy_select_partitions(backend, col, params, data_extractors,
                 encoded, values=np.zeros((encoded.n_rows, 0), np.float64))
             pid, pk, _, valid = pad_rows(slim)
             with rt_trace.span("dispatch"), rt_aot.activate(aot_flag):
-                kernel = (select_partitions_release_kernel
-                          if fused else select_partitions_kernel)
-                result = kernel(
-                    jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(valid),
-                    key, params.max_partitions_contributed, n_partitions,
-                    selection)
+                result = None
+                if _offerable(interceptor, fused, pid, backend):
+                    result = interceptor(ReleaseLaunch(
+                        kind="select", mesh=None, reshard="auto",
+                        pid=pid, pk=pk, valid=valid, key=key,
+                        l0=params.max_partitions_contributed,
+                        n_partitions=n_partitions, selection=selection))
+                if result is None:
+                    kernel = (select_partitions_release_kernel
+                              if fused else select_partitions_kernel)
+                    result = kernel(
+                        jnp.asarray(pid), jnp.asarray(pk),
+                        jnp.asarray(valid), key,
+                        params.max_partitions_contributed, n_partitions,
+                        selection)
                 rt_telemetry.record("release_dispatches")
         vocab = encoded.partition_vocab
         n_real = len(vocab)
@@ -1606,7 +1755,19 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
         aot_flag = getattr(backend, "aot", None)
         with budget_accountant.no_new_mechanisms(
                 "fused aggregation execution"), rt_aot.activate(aot_flag):
-            if backend.mesh is not None:
+            batched = None
+            interceptor = _active_launch_interceptor()
+            if _offerable(interceptor, fused, pid, backend):
+                batched = interceptor(ReleaseLaunch(
+                    kind="aggregate", mesh=backend.mesh,
+                    reshard=getattr(backend, "reshard", "auto"),
+                    pid=pid, pk=pk, values=values, valid=valid, key=key,
+                    scalars=(min_v, max_v, min_s, max_s, mid),
+                    stds=np.asarray(stds), cfg=cfg,
+                    secure_tables=secure_tables))
+            if batched is not None:
+                result = batched
+            elif backend.mesh is not None:
                 from pipelinedp_tpu.parallel import sharded
                 result = sharded.sharded_aggregate_arrays(
                     backend.mesh, pid, pk, values, valid, min_v, max_v,
@@ -1703,6 +1864,12 @@ def decode_results(outputs, keep, partition_vocab: Sequence[Any],
     return _decode_rows(outputs, zip(kept, kept), partition_vocab, compound)
 
 
+# Partition buckets at or under this row count decode through the
+# whole-column host-slice fast path in decode_release_results; larger
+# releases keep the O(kept) device-side slicing.
+_HOST_SLICE_MAX_ROWS = 4096
+
+
 def decode_release_results(n_kept, order, outputs,
                            partition_vocab: Sequence[Any],
                            compound: dp_combiners.CompoundCombiner):
@@ -1714,6 +1881,17 @@ def decode_release_results(n_kept, order, outputs,
     yields for the unfused (outputs, keep) pair."""
     k = int(n_kept)  # the one sync; gates O(kept) transfers
     rt_telemetry.record("release_dispatches")
+    if np.shape(order)[0] <= _HOST_SLICE_MAX_ROWS:
+        # Micro-release fast path: at small partition buckets the
+        # device-side slice programs (one per column plus the ids) cost
+        # more dispatch overhead than the padding bytes they avoid
+        # transferring — fetch each column whole and slice on the host.
+        # Pure indexing either way: the emitted stream is bit-identical.
+        ids = np.asarray(order)[:k]
+        sliced = {name: np.asarray(col)[:k]
+                  for name, col in outputs.items()}
+        return _decode_rows(sliced, enumerate(ids), partition_vocab,
+                            compound)
     ids = order[:k]
     sliced = {name: col[:k] for name, col in outputs.items()}
     if isinstance(ids, jax.Array):

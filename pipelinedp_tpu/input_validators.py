@@ -353,6 +353,65 @@ def validate_shed_watermark_fraction(shed_watermark_fraction,
             f"this fraction of the memory limit.")
 
 
+def validate_batching(batching, obj_name: str) -> None:
+    """Validates the megabatched-serving switch: a plain bool.
+
+    Raises:
+        ValueError: batching is not a bool (a truthy non-bool — say a
+        window or a lane count passed by mistake — would silently route
+        every job's release through the coalescing tier).
+    """
+    if not isinstance(batching, bool):
+        raise ValueError(
+            f"{obj_name}: batching must be a bool, but {batching!r} "
+            f"given (True coalesces identical-spec concurrent jobs into "
+            f"one vmapped release launch; per-job results are "
+            f"bit-identical either way).")
+
+
+def validate_batch_window_ms(batch_window_ms, obj_name: str) -> None:
+    """Validates the coalescing window: a positive finite number of
+    milliseconds.
+
+    Raises:
+        ValueError: batch_window_ms is not a positive finite number (a
+        non-positive window would close every batch before a second
+        lane could join; an infinite one would park the first job of
+        every spec forever).
+    """
+    if (not isinstance(batch_window_ms, numbers.Number) or
+            isinstance(batch_window_ms, bool) or
+            math.isnan(batch_window_ms)):
+        raise ValueError(f"{obj_name}: batch_window_ms must be a number "
+                         f"of milliseconds, but {batch_window_ms!r} "
+                         f"given.")
+    if batch_window_ms <= 0 or math.isinf(batch_window_ms):
+        raise ValueError(
+            f"{obj_name}: batch_window_ms must be positive and finite, "
+            f"but batch_window_ms={batch_window_ms} given — it is how "
+            f"long the first identical-spec job waits for others to "
+            f"coalesce before launching (latency floor vs. batch "
+            f"occupancy).")
+
+
+def validate_max_batch_jobs(max_batch_jobs, obj_name: str) -> None:
+    """Validates the batch lane cap: an integer >= 2.
+
+    Raises:
+        ValueError: max_batch_jobs is not an integer >= 2 (a 1-lane
+        "batch" IS the solo path — the coalescer dispatches early once
+        this many lanes joined, without waiting out the window).
+    """
+    if (not isinstance(max_batch_jobs, numbers.Number) or
+            isinstance(max_batch_jobs, bool) or
+            max_batch_jobs != int(max_batch_jobs) or max_batch_jobs < 2):
+        raise ValueError(
+            f"{obj_name}: max_batch_jobs must be an integer >= 2, but "
+            f"{max_batch_jobs!r} given — it caps the lanes of one "
+            f"megabatched launch; a full window dispatches immediately "
+            f"(1 lane would just be the solo path with extra waiting).")
+
+
 def validate_aot(aot, obj_name: str) -> None:
     """Validates the ahead-of-time executable-cache switch: a plain bool.
 

@@ -25,6 +25,7 @@ inputs only) plus one psum of (~6 x P) floats per aggregation, riding ICI —
 compared to the reference's full data shuffle over the network.
 """
 
+import threading
 from functools import partial
 from typing import Optional, Tuple
 
@@ -42,6 +43,59 @@ from pipelinedp_tpu.runtime import aot as rt_aot
 from pipelinedp_tpu.runtime import entry as rt_entry
 from pipelinedp_tpu.runtime import retry as rt_retry
 from pipelinedp_tpu.runtime import trace as rt_trace
+
+# Concurrent multi-device program launches are NOT safe on every
+# platform: XLA's CPU collectives rendezvous by arrival order, so two
+# shard_map programs dispatched from different host threads can
+# interleave their per-device AllReduce participants — each program
+# captures some of the device threads and both wait forever for the
+# rest (observed as `collective_ops_utils.h ... may be stuck`). Real
+# TPU runtimes serialize program launches on the device stream, so the
+# hazard is exclusively multi-THREADED hosts: the service worker pool
+# (and its megabatch coalescer) is the only place this tree launches
+# collectives from more than one thread, so the service brackets its
+# lifetime with enable/disable below and every meshed release dispatch
+# — solo or megabatched — then runs under the lock and BLOCKS on its
+# outputs before releasing it, so one program's collectives fully
+# drain before the next program's begin. Outside a service the guard
+# stands down entirely: single-threaded callers keep XLA's async
+# dispatch pipelining (forcing a drain per launch costs ~20% on
+# dispatch-heavy meshed paths like percentile descent). An RLock, so
+# an elastic re-entry (device-loss fallback re-dispatching inside the
+# guarded region) cannot self-deadlock.
+_COLLECTIVE_LAUNCH_LOCK = threading.RLock()
+_COLLECTIVE_SERIALIZE_LOCK = threading.Lock()
+_collective_serialize_depth = 0  # guarded by _COLLECTIVE_SERIALIZE_LOCK
+
+
+def enable_collective_serialization() -> None:
+    """Turns on collective-launch serialization (refcounted). Called by
+    every component that launches meshed programs from worker threads
+    — the service worker pool — BEFORE its first worker starts."""
+    global _collective_serialize_depth
+    with _COLLECTIVE_SERIALIZE_LOCK:
+        _collective_serialize_depth += 1
+
+
+def disable_collective_serialization() -> None:
+    """Drops one serialization hold, after the holder's workers have
+    all joined."""
+    global _collective_serialize_depth
+    with _COLLECTIVE_SERIALIZE_LOCK:
+        _collective_serialize_depth = max(0, _collective_serialize_depth - 1)
+
+
+def _collective_launch(dispatch):
+    """Runs `dispatch` (a thunk returning jax outputs); while any
+    multi-threaded launcher holds a serialization enable, the dispatch
+    runs under the collective-launch lock and blocks until the program
+    has drained."""
+    with _COLLECTIVE_SERIALIZE_LOCK:
+        serialize = _collective_serialize_depth > 0
+    if not serialize:
+        return dispatch()
+    with _COLLECTIVE_LAUNCH_LOCK:
+        return jax.block_until_ready(dispatch())
 
 
 def shard_rows_by_pid(pid: np.ndarray, pk: np.ndarray, values: np.ndarray,
@@ -233,6 +287,83 @@ def _sharded_select_release_kernel(pid, pk, valid, rng_key, l0: int,
     return fn(pid, pk, valid, rng_key)
 
 
+@partial(jax.jit, static_argnames=("cfg", "mesh"))
+def _sharded_batched_release_kernel(pid, pk, values, valid, min_v, max_v,
+                                    min_s, max_s, mid, stds, rng_keys,
+                                    cfg: executor.KernelConfig, mesh: Mesh,
+                                    secure_tables=None):
+    """Lane-stacked _sharded_release_kernel: ONE launch releases L jobs
+    over the mesh. Row arrays carry a leading job-lane axis over the
+    per-shard blocked layout ([L, D*cap] / [L, D*cap, V], every lane
+    staged by the SAME host LPT permutation its solo run would take) and
+    rng_keys is the [L, 2] stack of the jobs' own base keys. The
+    per-shard body is _sharded_release_kernel's verbatim, vmapped over
+    the lane axis — fold_in(shard_idx), the psum of partial columns and
+    the replicated finalize/compaction all batch elementwise, so lane
+    l's release is bit-identical to its solo meshed run."""
+
+    def per_shard(pid_s, pk_s, values_s, valid_s, stds_r, keys_r,
+                  tables_r):
+
+        def lane(pid_l, pk_l, values_l, valid_l, key_l):
+            shard_idx = jax.lax.axis_index(SHARD_AXIS)
+            rows_key, final_key = jax.random.split(key_l, 2)
+            shard_rows_key = jax.random.fold_in(rows_key, shard_idx)
+            cols, qrows = executor.partial_columns(
+                pid_l, pk_l, values_l, valid_l, min_v, max_v, min_s,
+                max_s, mid, shard_rows_key, cfg)
+            cols = jax.tree.map(lambda x: jax.lax.psum(x, SHARD_AXIS),
+                                cols)
+            outputs, keep, row_count = executor.finalize(
+                cols, min_v, mid, stds_r, final_key, cfg, tables_r)
+            if cfg.quantiles:
+                qkey = jax.random.fold_in(key_l, 7919)
+                outputs.update(
+                    executor.quantile_outputs(qrows, min_v, max_v, stds_r,
+                                              qkey, cfg,
+                                              psum_axis=SHARD_AXIS,
+                                              secure_tables=tables_r))
+            n_kept, order, outputs_sorted = executor.compact_release(
+                outputs, keep)
+            return n_kept, order, outputs_sorted, row_count
+
+        return jax.vmap(lane)(pid_s, pk_s, values_s, valid_s, keys_r)
+
+    fn = shard_map(per_shard,
+                   mesh=mesh,
+                   in_specs=(P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+                             P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+                             P(), P(), P()),
+                   out_specs=P())
+    return fn(pid, pk, values, valid, stds, rng_keys, secure_tables)
+
+
+@partial(jax.jit,
+         static_argnames=("l0", "n_partitions", "selection", "mesh"))
+def _sharded_batched_select_release_kernel(
+        pid, pk, valid, rng_keys, l0: int, n_partitions: int,
+        selection: selection_ops.SelectionParams, mesh: Mesh):
+    """Lane-stacked _sharded_select_release_kernel (same lane-axis and
+    bit-identity contract as _sharded_batched_release_kernel)."""
+
+    def per_shard(pid_s, pk_s, valid_s, keys_r):
+
+        def lane(pid_l, pk_l, valid_l, key_l):
+            keep = _select_per_shard_trace(pid_l, pk_l, valid_l, key_l,
+                                           l0, n_partitions, selection)
+            order = jnp.argsort(~keep, stable=True).astype(jnp.int32)
+            return keep.sum(), order
+
+        return jax.vmap(lane)(pid_s, pk_s, valid_s, keys_r)
+
+    fn = shard_map(per_shard,
+                   mesh=mesh,
+                   in_specs=(P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+                             P(None, SHARD_AXIS), P()),
+                   out_specs=(P(), P()))
+    return fn(pid, pk, valid, rng_keys)
+
+
 # Compile/dispatch attribution + AOT executable routing for the dense
 # meshed entry points (runtime/aot.py wraps runtime/trace.probe_jit).
 _sharded_kernel = rt_aot.aot_probe("sharded_kernel", _sharded_kernel,
@@ -240,6 +371,13 @@ _sharded_kernel = rt_aot.aot_probe("sharded_kernel", _sharded_kernel,
 _sharded_release_kernel = rt_aot.aot_probe(
     "sharded_release_kernel", _sharded_release_kernel,
     static_argnames=("cfg", "mesh"))
+_sharded_batched_release_kernel = rt_aot.aot_probe(
+    "sharded_batched_release_kernel", _sharded_batched_release_kernel,
+    static_argnames=("cfg", "mesh"))
+_sharded_batched_select_release_kernel = rt_aot.aot_probe(
+    "sharded_batched_select_release_kernel",
+    _sharded_batched_select_release_kernel,
+    static_argnames=("l0", "n_partitions", "selection", "mesh"))
 _sharded_select_kernel = rt_aot.aot_probe(
     "sharded_select_kernel", _sharded_select_kernel,
     static_argnames=("l0", "n_partitions", "selection", "mesh"))
@@ -343,10 +481,10 @@ def sharded_select_partitions(mesh: Mesh, pid, pk, valid, rng_key, l0: int,
     kernel = (_sharded_select_release_kernel
               if fused else _sharded_select_kernel)
     with rt_trace.span("dispatch"):
-        return rt_retry.retry_call(
+        return _collective_launch(lambda: rt_retry.retry_call(
             lambda: kernel(pid, pk, valid, rng_key, l0,
                            n_partitions, selection, mesh),
-            retry, what="sharded select_partitions dispatch")
+            retry, what="sharded select_partitions dispatch"))
 
 
 @rt_entry.runtime_entry("sharded_aggregate_arrays",
@@ -384,8 +522,8 @@ def sharded_aggregate_arrays(mesh: Mesh, pid, pk, values, valid, min_v, max_v,
     # is bit-identical — a retry replays the same release.
     kernel = _sharded_release_kernel if fused else _sharded_kernel
     with rt_trace.span("dispatch"):
-        return rt_retry.retry_call(
+        return _collective_launch(lambda: rt_retry.retry_call(
             lambda: kernel(pid, pk, values, valid, min_v, max_v,
                            min_s, max_s, mid, jnp.asarray(stds),
                            rng_key, cfg, mesh, secure_tables),
-            retry, what="sharded aggregation dispatch")
+            retry, what="sharded aggregation dispatch"))

@@ -147,6 +147,16 @@ REGISTRY: Dict[str, Metric] = {
                  "jobs accepted by DPAggregationService.submit into the "
                  "admission queue (every admitted job passes through it; "
                  "admitted + shed + still-queued partitions this count)"),
+        _counter("service_batch_launches",
+                 "megabatched release launches dispatched by the "
+                 "service's coalescing tier (one vmapped device program "
+                 "per >= 2-lane batch; the N-jobs-per-launch collapse "
+                 "the bench's dispatch-count receipt measures)"),
+        _counter("service_jobs_batched",
+                 "jobs whose release executed as one lane of a "
+                 "megabatched launch (increments by the lane count per "
+                 "batch; jobs_admitted minus this is the solo-path "
+                 "traffic)"),
         _counter("service_jobs_shed",
                  "service submissions refused by load shedding: the "
                  "device-memory watermark crossed the shed fraction at "
@@ -181,6 +191,10 @@ REGISTRY: Dict[str, Metric] = {
         _gauge("service_queue_depth",
                "jobs waiting in the service admission queue (admitted "
                "but not yet picked up by a worker)"),
+        _gauge("service_batch_occupancy",
+               "lane count of the most recent megabatched launch (how "
+               "full the batch window ran; 1-lane windows fall through "
+               "to the solo path and never set this)"),
     )
 }
 

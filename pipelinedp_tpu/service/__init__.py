@@ -14,10 +14,18 @@ concurrent tenants over one device set:
   * Admission control — priority FIFO up to max_concurrent_jobs,
     queueing beyond, load shedding by the device-memory watermark and
     the queue wait bound (typed AdmissionRejectedError + retry-after).
+  * Megabatched serving (batching=True) — BatchCoalescer groups
+    concurrently executing identical-spec jobs within a short window
+    and runs ONE vmapped release launch over all lanes, each lane
+    keyed by its own job's noise seed: per-job results, odometer
+    records and ledger charges are bit-identical to solo runs, while N
+    identical micro-jobs cost ~O(1) kernel launches instead of N.
 
-See README "Service mode" and examples/service_demo.py.
+See README "Service mode" / "Megabatched serving" and
+examples/service_demo.py.
 """
 
+from pipelinedp_tpu.service.batching import BatchCoalescer
 from pipelinedp_tpu.service.errors import (
     AdmissionRejectedError,
     TenantBudgetExceededError,
@@ -32,6 +40,7 @@ from pipelinedp_tpu.service.service import (
 
 __all__ = [
     "AdmissionRejectedError",
+    "BatchCoalescer",
     "DPAggregationService",
     "JobHandle",
     "JobSpec",

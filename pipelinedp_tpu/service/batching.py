@@ -1,0 +1,366 @@
+"""Megabatched serving: coalesce identical-spec micro-jobs into one
+vmapped launch.
+
+The north star is millions of users — many small jobs per second, not
+one 3M-row batch. PR 12 proved identical-spec tenants share every
+compiled program and PR 14 proved they share every AOT executable, but
+each job still paid its own kernel LAUNCH. This module shares the
+launch too: a coalescing rendezvous that, within a short batch window,
+groups concurrently executing jobs by their exact launch fingerprint
+(static kernel config, traced scalars, noise stds, padded row
+shape-class, mesh) and runs ONE lane-stacked vmapped release kernel
+(executor.batched_aggregate_release_kernel /
+parallel/sharded._sharded_batched_release_kernel) over all of them.
+
+Bit-identity is the hard contract, and it is structural, not best
+effort:
+
+  * Lanes coalesce ONLY on an identical launch fingerprint — anything
+    that could change a lane's compiled program or its traced scalars
+    (different spec, different stds, different row bucket, different
+    staged mesh layout) splits the group. There is no cross-lane
+    padding of partition counts or row buckets: prefix-stability of
+    sorts and PRNG draws under padding is not guaranteed, so unequal
+    lanes run solo instead.
+  * Each lane keeps its OWN base noise key (the job's noise_seed via
+    noise_ops.make_noise_key — exactly the solo path's key), stacked
+    [L, 2]. Threefry is counter-based and elementwise, so a vmapped
+    lane draws the same bits its solo run draws.
+  * The lane axis is padded to a power-of-two lane bucket with
+    all-invalid dummy lanes (valid=False rows release nothing), so the
+    AOT cache holds one executable per (spec fingerprint, row
+    shape-class, lane-count bucket) instead of one per exact lane
+    count. Dummy lanes are dropped before results split back.
+
+The rendezvous is cooperative: workers already executing a job offer
+their launch (executor.ReleaseLaunch, via the per-thread
+executor.launch_interceptor hook) and the FIRST arrival becomes the
+group's leader. The leader waits out ``batch_window_ms`` (or until
+``max_batch_jobs`` lanes joined, or the coalescer is closing), then
+dispatches the whole group as one launch and hands each joiner its
+lane's kernel-shaped result; every lane's decode, odometer records,
+TenantLedger charge and JobHandle completion then proceed on its own
+worker exactly as a solo run's would. A window that expires with one
+lane returns None — the lone job falls through to the unchanged
+per-job path — and any batched dispatch failure falls back the same
+way (solo is always correct; batching is only ever an optimization).
+"""
+
+import logging
+import threading
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pipelinedp_tpu import executor
+from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+from pipelinedp_tpu.runtime import trace as rt_trace
+
+# A joiner whose leader never dispatches (a crashed leader thread) must
+# not block its worker forever: after this bound it falls back to its
+# solo launch (double execution of an identical deterministic program —
+# same bits, so the release is unchanged; the ledger charges once
+# either way).
+_JOINER_TIMEOUT_S = 600.0
+
+
+def _lane_bucket(n: int) -> int:
+    """Power-of-two lane-count bucket (floor 2): bounds the AOT cache to
+    one executable per (spec, shape-class, lane bucket)."""
+    return max(2, 1 << max(0, (n - 1).bit_length()))
+
+
+def _group_key(launch: "executor.ReleaseLaunch"):
+    """The coalescing fingerprint: two launches may share one vmapped
+    program iff their keys are equal. Everything static or traced-but-
+    shared goes in (cfg / selection statics, scalars, stds bytes, row
+    shapes, secure flag, mesh, reshard); the per-lane base noise key
+    and the row VALUES stay out — those are exactly what the lane axis
+    carries."""
+    if launch.kind == "aggregate":
+        return ("aggregate", launch.cfg, launch.scalars,
+                np.asarray(launch.stds).tobytes(),
+                launch.pid.shape, launch.pk.shape, launch.values.shape,
+                launch.valid.shape, launch.secure_tables is not None,
+                launch.mesh, launch.reshard)
+    return ("select", launch.l0, launch.n_partitions, launch.selection,
+            launch.pid.shape, launch.pk.shape, launch.valid.shape,
+            launch.mesh, launch.reshard)
+
+
+class _Lane:
+    """One job's seat in a batch group."""
+
+    __slots__ = ("launch", "event", "result")
+
+    def __init__(self, launch):
+        self.launch = launch
+        self.event = threading.Event()
+        self.result = None  # None = run solo (fallthrough/fallback)
+
+
+class _Group:
+    """One open batch window: the lanes that joined so far, plus the
+    'full' event the leader sleeps on."""
+
+    __slots__ = ("lanes", "full", "closed")
+
+    def __init__(self):
+        self.lanes: List[_Lane] = []
+        self.full = threading.Event()
+        self.closed = False
+
+
+class BatchCoalescer:
+    """The rendezvous + dispatcher. One per DPAggregationService."""
+
+    def __init__(self, window_s: float, max_lanes: int):
+        self._window_s = float(window_s)
+        self._max_lanes = int(max_lanes)
+        self._lock = threading.Lock()
+        self._groups: Dict[Any, _Group] = {}
+        self._closing = False
+
+    def close(self) -> None:
+        """Wakes every open window immediately (service stop): pending
+        groups dispatch with whatever lanes they have, new offers run
+        solo."""
+        with self._lock:
+            self._closing = True
+            groups = list(self._groups.values())
+            self._groups.clear()
+        for group in groups:
+            group.full.set()
+
+    # -- the rendezvous --------------------------------------------------
+
+    def offer(self, launch) -> Optional[Any]:
+        """Called from executor's launch site on the job's own worker
+        thread. Returns the lane's kernel-shaped result, or None to run
+        the solo launch."""
+        key = _group_key(launch)
+        lane = _Lane(launch)
+        with self._lock:
+            if self._closing:
+                return None
+            group = self._groups.get(key)
+            leader = group is None or group.closed
+            if leader:
+                group = _Group()
+                self._groups[key] = group
+            group.lanes.append(lane)
+            if len(group.lanes) >= self._max_lanes:
+                group.closed = True
+                if self._groups.get(key) is group:
+                    del self._groups[key]
+                group.full.set()
+        if not leader:
+            # The leader owns the window and the dispatch; this worker
+            # parks until its lane's result (or fallback) is posted.
+            lane.event.wait(_JOINER_TIMEOUT_S)
+            return lane.result
+        group.full.wait(self._window_s)
+        with self._lock:
+            group.closed = True
+            if self._groups.get(key) is group:
+                del self._groups[key]
+            lanes = list(group.lanes)
+        if len(lanes) == 1:
+            # Window expired with a lone job: the per-job path is
+            # unchanged (no batch launch, no batch counters).
+            return None
+        self._dispatch(lanes)
+        return lane.result
+
+    # -- the dispatch ----------------------------------------------------
+
+    def _dispatch(self, lanes: List[_Lane]) -> None:
+        """Runs the whole group as one (or, on a mesh with divergent
+        staged layouts, a few) vmapped launches on the leader's thread
+        and posts each lane's sliced result. Any failure posts None on
+        every unset lane — they fall back to their solo launches."""
+        try:
+            launches = [lane.launch for lane in lanes]
+            if launches[0].mesh is not None:
+                results = _dispatch_meshed(launches)
+            elif launches[0].kind == "aggregate":
+                results = _dispatch_aggregate(launches)
+            else:
+                results = _dispatch_select(launches)
+            for lane, result in zip(lanes, results):
+                lane.result = result
+        except Exception:  # noqa: BLE001 - batching is an optimization, never a correctness dependency: whatever broke the stacked dispatch, every lane still holds its solo launch path, and falling back there releases the identical bits
+            logging.exception(
+                "megabatched dispatch failed (%d lanes); every lane "
+                "falls back to its solo launch", len(lanes))
+            for lane in lanes:
+                lane.result = None
+        finally:
+            for lane in lanes:
+                lane.event.set()
+
+
+def _record_batch(n_lanes: int) -> None:
+    rt_telemetry.record("service_batch_launches")
+    rt_telemetry.record("service_jobs_batched", n_lanes)
+    rt_telemetry.set_gauge("service_batch_occupancy", n_lanes,
+                           job_id=None)
+
+
+def _stack_keys(launches, n_dummy: int):
+    """[L_bucket, 2] lane-key stack: each job's own base key, then
+    arbitrary keys for the all-invalid dummy lanes (their rows release
+    nothing and their outputs are dropped)."""
+    keys = [launch.key for launch in launches]
+    # staticcheck: disable=key-hygiene — dummy-lane filler, never released: these keys draw noise only for the all-invalid padding lanes whose outputs are sliced off before results split back; every REAL lane's key above is the job's own seed-plumbed base key
+    keys += [jax.random.PRNGKey(0)] * n_dummy
+    return jnp.stack(keys)
+
+
+def _split_lanes(n_lanes: int, n_kept, order, outputs=None,
+                 row_count=None) -> List[Any]:
+    """Fetches the stacked kernel outputs to the host ONCE and splits
+    them into per-lane numpy views. Splitting on the device instead
+    would dispatch one slice program per lane per output — at 16 lanes
+    that is more launches than megabatching saved. Indexing only: each
+    lane's values are bit-identical either way."""
+    n_kept = np.asarray(n_kept)
+    order = np.asarray(order)
+    if outputs is None:
+        return [(n_kept[i], order[i]) for i in range(n_lanes)]
+    outputs = {name: np.asarray(col) for name, col in outputs.items()}
+    row_count = np.asarray(row_count)
+    return [(n_kept[i], order[i],
+             {name: col[i] for name, col in outputs.items()},
+             row_count[i]) for i in range(n_lanes)]
+
+
+def _dispatch_aggregate(launches) -> List[Any]:
+    """Single-device lane-stacked aggregation launch."""
+    n_lanes = len(launches)
+    bucket = _lane_bucket(n_lanes)
+    pad = bucket - n_lanes
+    first = launches[0]
+    pid = np.stack([l.pid for l in launches] +
+                   [np.zeros_like(first.pid)] * pad)
+    pk = np.stack([l.pk for l in launches] +
+                  [np.full_like(first.pk, -1)] * pad)
+    values = np.stack([l.values for l in launches] +
+                      [np.zeros_like(first.values)] * pad)
+    valid = np.stack([l.valid for l in launches] +
+                     [np.zeros_like(first.valid)] * pad)
+    keys = _stack_keys(launches, pad)
+    min_v, max_v, min_s, max_s, mid = first.scalars
+    with rt_trace.span("batch_dispatch", lanes=n_lanes,
+                       lane_bucket=bucket, kind="aggregate"):
+        n_kept, order, outputs, row_count = \
+            executor.batched_aggregate_release_kernel(
+                jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(values),
+                jnp.asarray(valid), min_v, max_v, min_s, max_s, mid,
+                jnp.asarray(first.stds), keys, first.cfg,
+                first.secure_tables)
+        _record_batch(n_lanes)
+    return _split_lanes(n_lanes, n_kept, order, outputs, row_count)
+
+
+def _dispatch_select(launches) -> List[Any]:
+    """Single-device lane-stacked standalone-selection launch."""
+    n_lanes = len(launches)
+    bucket = _lane_bucket(n_lanes)
+    pad = bucket - n_lanes
+    first = launches[0]
+    pid = np.stack([l.pid for l in launches] +
+                   [np.zeros_like(first.pid)] * pad)
+    pk = np.stack([l.pk for l in launches] +
+                  [np.full_like(first.pk, -1)] * pad)
+    valid = np.stack([l.valid for l in launches] +
+                     [np.zeros_like(first.valid)] * pad)
+    keys = _stack_keys(launches, pad)
+    with rt_trace.span("batch_dispatch", lanes=n_lanes,
+                       lane_bucket=bucket, kind="select"):
+        n_kept, order = executor.batched_select_partitions_release_kernel(
+            jnp.asarray(pid), jnp.asarray(pk), jnp.asarray(valid), keys,
+            first.l0, first.n_partitions, first.selection)
+        _record_batch(n_lanes)
+    return _split_lanes(n_lanes, n_kept, order)
+
+
+def _dispatch_meshed(launches) -> List[Any]:
+    """Meshed lane-stacked launch: stage every lane through the SAME
+    host LPT permutation its solo run would take (shard_rows_by_pid —
+    the group key already pinned host-numpy inputs and a non-collective
+    reshard), then coalesce the lanes whose staged per-shard layouts
+    agree. The staged capacity is data-dependent (round_capacity of the
+    max shard load), so a group that fingerprint-matched on the padded
+    row bucket can still split here; layout-singleton lanes return None
+    and run solo — never a differently-padded lane in a shared program."""
+    from pipelinedp_tpu.parallel import sharded
+
+    mesh = launches[0].mesh
+    n_shards = mesh.devices.size
+    staged = []
+    for launch in launches:
+        if launch.kind == "aggregate":
+            values = np.asarray(launch.values,
+                                dtype=np.dtype(executor._ftype()))
+        else:
+            # Selection never reads values (the solo meshed path stages
+            # a zero-width column for the same reason).
+            values = np.zeros((len(launch.pid), 0), np.float32)
+        staged.append(
+            sharded.shard_rows_by_pid(np.asarray(launch.pid),
+                                      np.asarray(launch.pk), values,
+                                      np.asarray(launch.valid), n_shards))
+    by_layout: Dict[Any, List[int]] = {}
+    for i, (spid, _, svalues, _) in enumerate(staged):
+        by_layout.setdefault((spid.shape, svalues.shape), []).append(i)
+    results: List[Any] = [None] * len(launches)
+    for indices in by_layout.values():
+        if len(indices) < 2:
+            continue
+        n_lanes = len(indices)
+        bucket = _lane_bucket(n_lanes)
+        pad = bucket - n_lanes
+        first = launches[indices[0]]
+        spid0, spk0, svalues0, svalid0 = staged[indices[0]]
+        pid = np.stack([staged[i][0] for i in indices] +
+                       [np.zeros_like(spid0)] * pad)
+        pk = np.stack([staged[i][1] for i in indices] +
+                      [np.full_like(spk0, -1)] * pad)
+        values = np.stack([staged[i][2] for i in indices] +
+                          [np.zeros_like(svalues0)] * pad)
+        valid = np.stack([staged[i][3] for i in indices] +
+                         [np.zeros_like(svalid0)] * pad)
+        keys = _stack_keys([launches[i] for i in indices], pad)
+        with rt_trace.span("batch_dispatch", lanes=n_lanes,
+                           lane_bucket=bucket, kind=first.kind,
+                           meshed=True):
+            # _collective_launch: one batched meshed program's
+            # collectives must fully drain before any other meshed
+            # launch (a layout-singleton lane of this very group
+            # falling back solo, say) reaches its rendezvous.
+            if first.kind == "aggregate":
+                min_v, max_v, min_s, max_s, mid = first.scalars
+                n_kept, order, outputs, row_count = \
+                    sharded._collective_launch(
+                        lambda: sharded._sharded_batched_release_kernel(
+                            jnp.asarray(pid), jnp.asarray(pk),
+                            jnp.asarray(values), jnp.asarray(valid),
+                            min_v, max_v, min_s, max_s, mid,
+                            jnp.asarray(first.stds), keys, first.cfg,
+                            mesh, first.secure_tables))
+                lane_results = _split_lanes(n_lanes, n_kept, order,
+                                            outputs, row_count)
+            else:
+                n_kept, order = sharded._collective_launch(
+                    lambda: sharded._sharded_batched_select_release_kernel(
+                        jnp.asarray(pid), jnp.asarray(pk),
+                        jnp.asarray(valid), keys, first.l0,
+                        first.n_partitions, first.selection, mesh))
+                lane_results = _split_lanes(n_lanes, n_kept, order)
+            _record_batch(n_lanes)
+        for lane_pos, i in enumerate(indices):
+            results[i] = lane_results[lane_pos]
+    return results

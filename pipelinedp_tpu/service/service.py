@@ -42,6 +42,7 @@ live through the backend's Prometheus exporters like every other
 declared metric.
 """
 
+import contextlib
 import dataclasses
 import hashlib
 import logging
@@ -53,14 +54,17 @@ from typing import Any, Dict, List, Optional
 from pipelinedp_tpu import aggregate_params as agg_params
 from pipelinedp_tpu import budget_accounting
 from pipelinedp_tpu import dp_engine
+from pipelinedp_tpu import executor
 from pipelinedp_tpu import input_validators
 from pipelinedp_tpu import pipeline_backend
 from pipelinedp_tpu.data_extractors import DataExtractors
+from pipelinedp_tpu.parallel import sharded
 from pipelinedp_tpu.runtime import health as rt_health
 from pipelinedp_tpu.runtime import observability as rt_observability
 from pipelinedp_tpu.runtime import telemetry as rt_telemetry
 from pipelinedp_tpu.runtime.concurrency import guarded_by
 from pipelinedp_tpu.runtime.journal import BlockJournal
+from pipelinedp_tpu.service.batching import BatchCoalescer
 from pipelinedp_tpu.service.errors import AdmissionRejectedError
 from pipelinedp_tpu.service.ledger import TenantLedger
 
@@ -284,6 +288,21 @@ class DPAggregationService:
             the platform's per-device ``bytes_limit`` where available
             (TPU/GPU) and disables the check where not (CPU without an
             explicit limit).
+        batching: True enables megabatched serving — concurrently
+            executing jobs whose release launches share an exact
+            fingerprint (static kernel config, traced scalars, noise
+            stds, padded row shape-class, mesh layout) coalesce into
+            ONE vmapped launch, each lane keyed by its own job's noise
+            seed so per-job results stay bit-identical to solo runs.
+            Single-job windows and mixed-spec traffic fall through to
+            the per-job path unchanged.
+        batch_window_ms: how long the first job of a coalescing group
+            holds its launch open for identical-spec company before
+            dispatching — the latency the batching tier is willing to
+            pay for occupancy.
+        max_batch_jobs: lane cap per megabatched launch; a group that
+            fills dispatches immediately, without waiting out the
+            window.
     """
 
     _GUARDED_BY = guarded_by("_lock", "_ledgers", "_handles", "_seq",
@@ -297,7 +316,10 @@ class DPAggregationService:
                  tenant_budget_epsilon: float = float("inf"),
                  queue_timeout_s: float = 30.0,
                  shed_watermark_fraction: float = 0.9,
-                 memory_limit_bytes: Optional[int] = None):
+                 memory_limit_bytes: Optional[int] = None,
+                 batching: bool = False,
+                 batch_window_ms: float = 25.0,
+                 max_batch_jobs: int = 16):
         if not isinstance(backend, pipeline_backend.TPUBackend):
             raise ValueError(
                 f"DPAggregationService: backend must be a TPUBackend "
@@ -311,6 +333,12 @@ class DPAggregationService:
             queue_timeout_s, "DPAggregationService")
         input_validators.validate_shed_watermark_fraction(
             shed_watermark_fraction, "DPAggregationService")
+        input_validators.validate_batching(batching,
+                                           "DPAggregationService")
+        input_validators.validate_batch_window_ms(
+            batch_window_ms, "DPAggregationService")
+        input_validators.validate_max_batch_jobs(
+            max_batch_jobs, "DPAggregationService")
         self._backend = backend
         self._ledger_journal = BlockJournal(ledger_dir)
         self._ledger_dir = ledger_dir
@@ -320,6 +348,14 @@ class DPAggregationService:
         self._shed_watermark_fraction = float(shed_watermark_fraction)
         self._memory_limit_bytes = (None if memory_limit_bytes is None
                                     else int(memory_limit_bytes))
+        # Megabatching only ever coalesces launches whose lanes
+        # fingerprint-match exactly; a lone-lane window, a mixed spec,
+        # or any dispatch failure returns every lane to its unchanged
+        # (and bit-identical) solo path — so a disabled coalescer is
+        # just "every lane solo".
+        self._coalescer = (BatchCoalescer(batch_window_ms / 1000.0,
+                                          max_batch_jobs)
+                           if batching else None)
         self._lock = threading.Lock()
         self._ledgers: Dict[str, TenantLedger] = {}
         self._handles: List[JobHandle] = []
@@ -330,6 +366,11 @@ class DPAggregationService:
         # cross-tenant compile-reuse evidence (bench receipt key).
         self._spec_stats: Dict[str, Dict[str, int]] = {}
         self._queue: "queue.PriorityQueue" = queue.PriorityQueue()
+        # Worker threads launch meshed programs concurrently — the one
+        # place in the tree that needs collective-launch serialization
+        # (see parallel/sharded.py); enabled BEFORE the first worker
+        # starts, dropped in stop() after every worker has joined.
+        sharded.enable_collective_serialization()
         self._workers = [
             threading.Thread(target=self._worker_loop,
                              name=f"dp-service-worker-{i}", daemon=True)
@@ -354,6 +395,11 @@ class DPAggregationService:
             if self._stopped:
                 return
             self._stopped = True
+        if self._coalescer is not None:
+            # Wake every open batch window NOW: pending groups dispatch
+            # with the lanes they have (still bit-identical per lane)
+            # instead of waiting out windows during shutdown.
+            self._coalescer.close()
         for _ in self._workers:
             with self._lock:
                 self._seq += 1
@@ -361,6 +407,7 @@ class DPAggregationService:
             self._queue.put((_STOP_PRIORITY, seq, None))
         for worker in self._workers:
             worker.join(timeout=timeout_s)
+        sharded.disable_collective_serialization()
         # Workers exited on the preempting sentinels; drain what queued
         # behind them.
         while True:
@@ -565,8 +612,18 @@ class DPAggregationService:
                                         noise_seed=spec.noise_seed)
         engine = dp_engine.DPEngine(accountant, backend)
         extractors = spec.data_extractors or _tuple_extractors()
+        # With batching on, this worker's dense fused release launches
+        # are offered to the coalescer: an identical-fingerprint group
+        # runs as one vmapped launch (this job as one lane, keyed by its
+        # own noise seed — bit-identical to solo), anything else returns
+        # None and the solo launch below it runs unchanged. Everything
+        # around the launch — decode, odometer, ledger charge, handle —
+        # is this job's own code path either way.
+        intercept = (executor.launch_interceptor(self._coalescer.offer)
+                     if self._coalescer is not None
+                     else contextlib.nullcontext())
         try:
-            with rt_health.job_scope(job.job_id):
+            with rt_health.job_scope(job.job_id), intercept:
                 if spec.is_select_partitions:
                     lazy = engine.select_partitions(job.source, spec.params,
                                                     extractors)

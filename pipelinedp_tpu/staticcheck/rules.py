@@ -834,6 +834,12 @@ KNOB_VALIDATORS: Dict[str, str] = {
     "tenant_budget_epsilon": "validate_tenant_budget_epsilon",
     "queue_timeout_s": "validate_queue_timeout_s",
     "shed_watermark_fraction": "validate_shed_watermark_fraction",
+    # Megabatched-serving knobs (PR 16): the coalescing tier's switch,
+    # window and lane cap — a bad window or lane cap would silently
+    # stall every identical-spec job in an unfillable batch window.
+    "batching": "validate_batching",
+    "batch_window_ms": "validate_batch_window_ms",
+    "max_batch_jobs": "validate_max_batch_jobs",
 }
 
 # Data-plane parameters: configuration, not failure semantics — adding

@@ -82,6 +82,13 @@ def pytest_configure(config):
         "fused release kernels, compute/drain overlap: bit-identity, "
         "cache-key correctness, per-job retrace attribution (tier-1, "
         "NOT slow; select alone with -m aot)")
+    config.addinivalue_line(
+        "markers",
+        "batching: megabatched serving — the coalescing tier that runs "
+        "identical-spec concurrent jobs as lanes of one vmapped release "
+        "launch: per-lane bit-identity vs solo, fallthrough/fallback "
+        "paths, ledger reconciliation, launch-count collapse (tier-1, "
+        "NOT slow; select alone with -m batching)")
 
 
 @pytest.fixture(autouse=True)

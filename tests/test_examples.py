@@ -90,8 +90,14 @@ def _accelerator_platform():
     return platform if platform != "cpu" else None
 
 
+@pytest.mark.slow
 def test_movie_example_on_device():
-    """The real-file-format example on the actual device path (TPU smoke)."""
+    """The real-file-format example on the actual device path (TPU smoke).
+
+    `slow`: on an accelerator-less tier-1 box the probe subprocess
+    burns its full 90s timeout just to decide to skip; the example
+    itself is covered on CPU by the `--local` parametrization above.
+    """
     platform = _accelerator_platform()
     if platform is None:
         pytest.skip("no healthy accelerator reachable")

@@ -149,6 +149,12 @@ class TestKnobRejection:
             DPAggregationService(backend, queue_timeout_s=float("inf"))
         with pytest.raises(ValueError, match="shed_watermark_fraction"):
             DPAggregationService(backend, shed_watermark_fraction=0.0)
+        with pytest.raises(ValueError, match="batching"):
+            DPAggregationService(backend, batching="on")
+        with pytest.raises(ValueError, match="batch_window_ms"):
+            DPAggregationService(backend, batch_window_ms=-5.0)
+        with pytest.raises(ValueError, match="max_batch_jobs"):
+            DPAggregationService(backend, max_batch_jobs=True)
 
     def test_service_knob_without_validation_is_flagged(self):
         """A new defaulted DPAggregationService.__init__ parameter with

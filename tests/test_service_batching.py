@@ -1,0 +1,339 @@
+"""Megabatched serving coverage (pipelinedp_tpu/service/batching.py).
+
+The contracts under test:
+
+  * **Per-lane bit-identity** — every job that executes as one lane of
+    a coalesced vmapped launch releases EXACTLY the outputs, spent
+    epsilon and ledger charge its solo (batching=False) run releases,
+    across count/sum, mean-with-private-selection, and standalone
+    partition selection. The lane keeps the job's own noise key; the
+    vmap only stacks the launch.
+  * **Fallthrough** — mixed specs never coalesce (their launch
+    fingerprints differ), a window that expires with one lane runs the
+    unchanged solo path, and neither case touches the batch counters.
+  * **Admission semantics survive** — the priority queue still orders
+    execution with batching on; stop() wakes a pending batch window so
+    in-flight lanes dispatch (bit-identically) instead of waiting out
+    the window during shutdown; ledgers reconcile bit-exactly under
+    concurrent batched tenants.
+  * **Warm path** — a repeated batch of the same (spec, row bucket,
+    lane bucket) adds 0 AOT executable-cache misses: the lane-stacked
+    kernel is cached per shape-class like every other entry point.
+  * **Observability** — batch launches record the declared
+    service_batch_launches / service_jobs_batched counters and the
+    service_batch_occupancy gauge, all scrapeable through the strict
+    Prometheus round-trip, and show up as batch_dispatch trace spans
+    carrying a lanes= attribute.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu.runtime import aot as rt_aot
+from pipelinedp_tpu.runtime import observability as obs
+from pipelinedp_tpu.runtime import telemetry
+from pipelinedp_tpu.runtime import trace
+from pipelinedp_tpu.service import DPAggregationService, JobSpec, JobStatus
+
+pytestmark = [pytest.mark.service, pytest.mark.batching]
+
+
+@pytest.fixture(autouse=True)
+def _batching_epoch():
+    telemetry.reset()
+    yield
+    trace.disable()
+    rt_aot.enable(False)
+    telemetry.reset()
+
+
+def _rows(seed, n=200):
+    r = np.random.default_rng(seed)
+    return [(int(r.integers(0, 40)), f"p{int(r.integers(0, 10))}",
+             float(r.uniform(0, 5))) for _ in range(n)]
+
+
+def _agg_spec(seed, metrics=None, priority=0):
+    params = pdp.AggregateParams(
+        metrics=metrics or [pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        max_partitions_contributed=2,
+        max_contributions_per_partition=3,
+        min_value=0.0, max_value=5.0)
+    return JobSpec(params=params, epsilon=1.0, delta=1e-6,
+                   noise_seed=seed, priority=priority)
+
+
+def _select_spec(seed):
+    params = pdp.SelectPartitionsParams(max_partitions_contributed=2)
+    return JobSpec(params=params, epsilon=0.5, delta=1e-6,
+                   noise_seed=seed)
+
+
+def _run_service(specs_and_rows, batching, **service_kwargs):
+    """Runs the given (tenant, spec, rows) jobs concurrently and returns
+    per-job results in submission order plus the service's ledger
+    verdict and spent epsilons."""
+    kwargs = dict(max_concurrent_jobs=len(specs_and_rows),
+                  batching=batching, batch_window_ms=2000.0,
+                  max_batch_jobs=max(2, len(specs_and_rows)))
+    kwargs.update(service_kwargs)
+    with DPAggregationService(pdp.TPUBackend(), **kwargs) as svc:
+        handles = [svc.submit(tenant, spec, rows)
+                   for tenant, spec, rows in specs_and_rows]
+        results = [h.result(timeout=300) for h in handles]
+        spent = [h.spent_epsilon for h in handles]
+        reconciled = svc.ledgers_reconciled()
+    return results, spent, reconciled
+
+
+def _batch_counters():
+    snap = telemetry.snapshot()
+    return (snap.get("service_batch_launches", 0),
+            snap.get("service_jobs_batched", 0))
+
+
+def _assert_same_release(solo, batched):
+    assert set(solo) == set(batched)
+    for part in solo:
+        assert np.array_equal(
+            np.asarray(solo[part], np.float64),
+            np.asarray(batched[part], np.float64)), part
+
+
+class TestBitIdentity:
+
+    @pytest.mark.parametrize("metrics", [
+        [pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        [pdp.Metrics.MEAN],
+    ], ids=["count_sum", "mean"])
+    def test_batched_lanes_bit_identical_to_solo(self, metrics):
+        jobs = [(f"tenant{i}", _agg_spec(50 + i, metrics=metrics),
+                 _rows(7 + i)) for i in range(4)]
+        solo, solo_spent, ok_solo = _run_service(jobs, batching=False)
+        l0, j0 = _batch_counters()
+        assert (l0, j0) == (0, 0), "solo run must not batch"
+        batched, bat_spent, ok_bat = _run_service(jobs, batching=True)
+        launches, lanes = _batch_counters()
+        assert launches >= 1, "4 identical specs must coalesce"
+        assert lanes == 4
+        assert ok_solo and ok_bat
+        assert solo_spent == bat_spent
+        for s, b in zip(solo, batched):
+            _assert_same_release(s, b)
+
+    def test_select_partitions_batched_bit_identical(self):
+        jobs = [(f"tenant{i}", _select_spec(70 + i), _rows(19 + i))
+                for i in range(4)]
+        solo, solo_spent, ok_solo = _run_service(jobs, batching=False)
+        batched, bat_spent, ok_bat = _run_service(jobs, batching=True)
+        launches, lanes = _batch_counters()
+        assert launches >= 1 and lanes == 4
+        assert ok_solo and ok_bat
+        assert solo_spent == bat_spent
+        for s, b in zip(solo, batched):
+            assert sorted(s) == sorted(b)
+
+    def test_ledger_charges_match_solo_bit_exactly(self):
+        jobs = [(f"tenant{i}", _agg_spec(90 + i), _rows(31 + i))
+                for i in range(4)]
+        with DPAggregationService(pdp.TPUBackend(), max_concurrent_jobs=4,
+                                  batching=True, batch_window_ms=2000.0,
+                                  max_batch_jobs=4) as svc:
+            handles = [svc.submit(t, s, r) for t, s, r in jobs]
+            for h in handles:
+                h.result(timeout=300)
+            assert svc.ledgers_reconciled()
+            for h in handles:
+                ledger = svc.tenant_ledger(h.tenant_id)
+                assert ledger.job_spent_epsilon(
+                    h.job_id) == h.spent_epsilon
+        launches, lanes = _batch_counters()
+        assert launches >= 1 and lanes == 4
+
+
+class TestFallthrough:
+
+    def test_mixed_specs_never_coalesce(self):
+        jobs = [("ta", _agg_spec(1, metrics=[pdp.Metrics.COUNT]),
+                 _rows(1)),
+                ("tb", _agg_spec(2, metrics=[pdp.Metrics.SUM]),
+                 _rows(2))]
+        solo, _, _ = _run_service(jobs, batching=False,
+                                  batch_window_ms=200.0)
+        batched, _, ok = _run_service(jobs, batching=True,
+                                      batch_window_ms=200.0)
+        assert _batch_counters() == (0, 0)
+        assert ok
+        for s, b in zip(solo, batched):
+            _assert_same_release(s, b)
+
+    def test_lone_job_window_expiry_runs_solo(self):
+        job = [("t0", _agg_spec(5), _rows(5))]
+        solo, _, _ = _run_service(job, batching=False,
+                                  batch_window_ms=100.0)
+        batched, _, ok = _run_service(job, batching=True,
+                                      batch_window_ms=100.0)
+        assert _batch_counters() == (0, 0)
+        assert ok
+        _assert_same_release(solo[0], batched[0])
+
+
+class TestAdmissionInteraction:
+
+    def test_priority_ordering_preserved_with_batching(self):
+        with DPAggregationService(pdp.TPUBackend(),
+                                  max_concurrent_jobs=1, batching=True,
+                                  batch_window_ms=50.0,
+                                  max_batch_jobs=4,
+                                  queue_timeout_s=300.0) as svc:
+            # The single worker runs the first job while the rest queue;
+            # the LOW-priority-value job queued last must still run
+            # before the higher-value one queued first.
+            first = svc.submit("t0", _agg_spec(10, priority=0), _rows(3))
+            late = svc.submit("t1", _agg_spec(11, priority=5), _rows(3))
+            urgent = svc.submit("t2", _agg_spec(12, priority=1),
+                                _rows(3))
+            for h in (first, late, urgent):
+                h.result(timeout=300)
+            assert urgent._started_at < late._started_at
+
+    def test_stop_wakes_pending_batch_window(self):
+        jobs = [(f"tenant{i}", _agg_spec(110 + i), _rows(41 + i))
+                for i in range(2)]
+        solo, _, _ = _run_service(jobs, batching=False)
+        telemetry.reset()
+        with DPAggregationService(pdp.TPUBackend(), max_concurrent_jobs=2,
+                                  batching=True,
+                                  # A window far beyond the test budget:
+                                  # only stop()'s close() can release it.
+                                  batch_window_ms=120_000.0,
+                                  max_batch_jobs=8) as svc:
+            handles = [svc.submit(t, s, r) for t, s, r in jobs]
+            # Both lanes reach the rendezvous and wait for a third that
+            # never comes; stop() must dispatch them NOW.
+            deadline = time.monotonic() + 60.0
+            while (not all(h.status == JobStatus.RUNNING
+                           for h in handles)
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            time.sleep(1.0)  # running -> parked in the batch window
+            svc.stop()
+            results = [h.result(timeout=300) for h in handles]
+            assert all(h.status == JobStatus.DONE for h in handles)
+            assert svc.ledgers_reconciled()
+        launches, lanes = _batch_counters()
+        assert launches == 1 and lanes == 2
+        for s, b in zip(solo, results):
+            _assert_same_release(s, b)
+
+
+class TestWarmPath:
+
+    def test_repeated_batch_shape_adds_zero_aot_retraces(self):
+        rt_aot.global_cache().clear()
+        jobs = [(f"tenant{i}", _agg_spec(130 + i), _rows(51 + i))
+                for i in range(2)]
+
+        def run():
+            with DPAggregationService(pdp.TPUBackend(aot=True),
+                                      max_concurrent_jobs=2,
+                                      batching=True,
+                                      batch_window_ms=2000.0,
+                                      max_batch_jobs=2) as svc:
+                handles = [svc.submit(t, s, r) for t, s, r in jobs]
+                return [h.result(timeout=300) for h in handles]
+
+        run()  # warms the lane-stacked executable for this shape-class
+        before = telemetry.snapshot()
+        run()
+        after = telemetry.snapshot()
+        assert after.get("aot_cache_misses", 0) == before.get(
+            "aot_cache_misses", 0), \
+            "a repeated (spec, row bucket, lane bucket) batch must " \
+            "reuse the cached lane-stacked executable"
+        assert after.get("aot_cache_hits", 0) > before.get(
+            "aot_cache_hits", 0)
+        launches, _ = _batch_counters()
+        assert launches >= 2
+
+
+class TestObservability:
+
+    def test_batch_metrics_export_and_spans(self):
+        trace.enable()
+        jobs = [(f"tenant{i}", _agg_spec(150 + i), _rows(61 + i))
+                for i in range(3)]
+        _run_service(jobs, batching=True)
+        launches, lanes = _batch_counters()
+        assert launches >= 1 and lanes == 3
+        occupancy = telemetry.gauge_snapshot()["service_batch_occupancy"]
+        assert occupancy[""] == 3.0  # process-level: the last launch
+        parsed = obs.parse_prometheus(obs.render_prometheus())
+        assert parsed["pdp_service_batch_launches"]["type"] == "counter"
+        assert parsed["pdp_service_batch_launches"]["samples"][""] >= 1.0
+        assert parsed["pdp_service_jobs_batched"]["samples"][""] == 3.0
+        assert parsed["pdp_service_batch_occupancy"]["type"] == "gauge"
+        assert parsed["pdp_service_batch_occupancy"]["samples"][""] == 3.0
+        spans = [e for e in trace.to_trace_events()["traceEvents"]
+                 if e["name"] == "batch_dispatch"]
+        assert spans, "batch launches must be visible as trace spans"
+        assert any(e["args"].get("lanes") == 3 for e in spans)
+
+
+class TestKnobs:
+
+    def test_batching_knob_rejections(self):
+        backend = pdp.TPUBackend()
+        with pytest.raises(ValueError, match="batching must be a bool"):
+            DPAggregationService(backend, batching=1)
+        with pytest.raises(ValueError, match="batch_window_ms"):
+            DPAggregationService(backend, batching=True,
+                                 batch_window_ms=0)
+        with pytest.raises(ValueError, match="batch_window_ms"):
+            DPAggregationService(backend, batching=True,
+                                 batch_window_ms=float("inf"))
+        with pytest.raises(ValueError, match="max_batch_jobs"):
+            DPAggregationService(backend, batching=True, max_batch_jobs=1)
+        with pytest.raises(ValueError, match="max_batch_jobs"):
+            DPAggregationService(backend, batching=True,
+                                 max_batch_jobs=2.5)
+
+
+class TestCollectiveSerialization:
+    """The service must bracket its worker pool with collective-launch
+    serialization: concurrent meshed programs from two host threads can
+    interleave their per-device rendezvous on the CPU backend and hang
+    forever, and the guard must stand down when no service is live so
+    single-threaded meshed callers keep XLA's async dispatch
+    pipelining."""
+
+    def test_service_lifetime_brackets_serialization(self):
+        from pipelinedp_tpu.parallel import sharded
+
+        def depth():
+            with sharded._COLLECTIVE_SERIALIZE_LOCK:
+                return sharded._collective_serialize_depth
+
+        base = depth()
+        svc_a = DPAggregationService(pdp.TPUBackend())
+        assert depth() == base + 1
+        with DPAggregationService(pdp.TPUBackend()):
+            assert depth() == base + 2  # refcounted across services
+        assert depth() == base + 1
+        svc_a.stop()
+        assert depth() == base
+        svc_a.stop()  # idempotent: a second stop must not double-drop
+        assert depth() == base
+
+    def test_unserialized_launch_skips_lock_and_drain(self):
+        from pipelinedp_tpu.parallel import sharded
+
+        calls = []
+        with sharded._COLLECTIVE_SERIALIZE_LOCK:
+            base = sharded._collective_serialize_depth
+        assert base == 0, "no service live: the guard must stand down"
+        assert sharded._collective_launch(lambda: calls.append(1) or 7) == 7
+        assert calls == [1]

@@ -445,10 +445,16 @@ class TestShardedBlockedLargeP:
         truth = np.bincount(pk, minlength=P)
         np.testing.assert_allclose(outputs["count"], truth[kept], atol=1e-4)
 
+    @pytest.mark.slow
     def test_percentile_blocked_sharded(self):
         # Per-block lazy quantile descent over the mesh: the [C, B]
         # child-count psum inside quantile_outputs is the collective under
         # test. Noise-free medians must land within leaf width of numpy.
+        # `slow`: ~4 min of wall alone on the CPU tier-1 box — the
+        # descent's per-level dispatches dominate; the same collective
+        # is covered fast by test_percentile_sharded (dense route) and
+        # test_percentile_blocked_matches_dense (blocked, single
+        # device), so tier-1 keeps both halves of the composition.
         import jax
         from pipelinedp_tpu.parallel import large_p
         mesh = make_mesh(n_devices=8)
