@@ -18,6 +18,7 @@ streamed through the kernel in chunks that fit HBM.
 import argparse
 import json
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -582,6 +583,162 @@ def _bench_megabatch(on_tpu):
         }
     except Exception as e:  # noqa: BLE001 - the receipt must survive megabatch-bench breakage; tests/test_service_batching.py owns failing on it
         return {"megabatch": {"error": f"{type(e).__name__}: {e}"}}
+
+
+def _bench_fleet(on_tpu):
+    """`fleet` receipt key: the fleet-operations plane timed end to end.
+    A mini elastic scale-UP (half the attached devices grow to the full
+    set at a block boundary, outputs bit-compared against the
+    fixed-geometry run), a drain-and-migrate (journaled run interrupted,
+    adopted into a new controller scope, resumed — blocks replayed from
+    the journal, migration counted once), and a 2-wave rolling-restart
+    drill with one mid-persist kill. The correctness gates live in
+    tier-1 (tests/test_fleet.py, tests/test_multihost.py); the receipt
+    reports the wall time each operation costs and the counter deltas a
+    fleet controller would watch."""
+    import numpy as np
+
+    import jax
+
+    import pipelinedp_tpu as pdp
+    from benchmarks import _common
+    from pipelinedp_tpu.parallel import large_p, make_mesh
+    from pipelinedp_tpu.runtime import BlockJournal
+    from pipelinedp_tpu.runtime import drill as rt_drill
+    from pipelinedp_tpu.runtime import faults as rt_faults
+    from pipelinedp_tpu.runtime import observability as rt_obs
+    from pipelinedp_tpu.runtime import retry as rt_retry
+    from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+    from pipelinedp_tpu.service import JobSpec
+
+    try:
+        n_dev = len(jax.devices())
+        P = 1 << 12
+        block = 1 << 10
+        _, cfg, stds, (min_v, max_v, min_s, max_s, mid) = \
+            _common.build_spec(P)
+        # Placement-independent integer rows (one row per privacy id,
+        # integer values): bounding drops nothing and per-shard partial
+        # sums are exact, so the bit-identity verdicts below are
+        # geometry-proof — the same construction tests/test_fleet.py
+        # gates on.
+        dense_parts = (np.arange(12, dtype=np.int64) * 239 + 57) % P
+        n_per = 120
+        pid = (np.repeat(np.arange(n_per), 12) * 1_000_003 +
+               np.tile(np.arange(12), n_per)).astype(np.int32)
+        pk = np.tile(dense_parts, n_per).astype(np.int32)
+        values = np.random.default_rng(7).integers(
+            0, 6, len(pk)).astype(np.float64)
+        valid = np.ones(len(pid), bool)
+        key = jax.random.PRNGKey(97)
+        fast = rt_retry.RetryPolicy(max_retries=2, base_delay=0.0,
+                                    max_delay=0.0)
+
+        def run(mesh, **kw):
+            return large_p.aggregate_blocked_sharded(
+                mesh, pid, pk, values, valid, min_v, max_v, min_s,
+                max_s, mid, stds, key, cfg, block_partitions=block,
+                **kw)
+
+        out: dict = {"fleet_devices": n_dev}
+        before = rt_telemetry.snapshot()
+
+        # Mini scale-UP: half the devices grow to the full set. A
+        # single attached chip has nothing to admit — skip, keep keys.
+        if n_dev >= 2:
+            half = n_dev // 2
+            base_kept, base_out = run(make_mesh(n_devices=half))
+            rt_retry.announce_join(n_devices=n_dev, block=2)
+            try:
+                start = time.perf_counter()
+                kept_g, out_g = run(make_mesh(n_devices=half),
+                                    retry=fast, elastic_grow=True,
+                                    job_id="bench-fleet-grow")
+                grow_s = time.perf_counter() - start
+            finally:
+                rt_retry.clear_joins()
+            out["fleet_grow_devices"] = f"{half}->{n_dev}"
+            out["fleet_grow_sec"] = round(grow_s, 3)
+            out["fleet_grow_bit_identical"] = bool(
+                np.array_equal(base_kept, kept_g) and all(
+                    np.array_equal(np.asarray(base_out[k]),
+                                   np.asarray(out_g[k]))
+                    for k in ("count", "sum")))
+        else:
+            base_kept, base_out = run(make_mesh(n_devices=n_dev))
+            out["fleet_grow_skipped"] = "single device — nothing to admit"
+
+        # Drain-and-migrate: interrupt at block 2, adopt, resume.
+        with tempfile.TemporaryDirectory() as tmp:
+            source = BlockJournal(tmp).scoped_to_process(0)
+            sched = rt_faults.FaultSchedule(
+                [rt_faults.Fault("fatal", block=2)])
+            with rt_faults.inject(sched):
+                try:
+                    run(make_mesh(n_devices=max(1, n_dev // 2)),
+                        journal=source, retry=fast,
+                        job_id="bench-fleet-migrate")
+                except rt_faults.InjectedFatalError:
+                    pass
+            rt_obs.persist_odometer(source, "bench-fleet-migrate")
+            target = BlockJournal(tmp).scoped_to_process(1)
+            start = time.perf_counter()
+            adopted = target.adopt_job("bench-fleet-migrate")
+            kept_m, out_m = run(make_mesh(n_devices=n_dev),
+                                journal=target, retry=fast,
+                                job_id="bench-fleet-migrate")
+            migrate_s = time.perf_counter() - start
+            out["fleet_migrate_adopted_blocks"] = int(adopted)
+            out["fleet_migrate_odometer_records"] = len(
+                rt_obs.load_odometer(target, "bench-fleet-migrate"))
+            out["fleet_migrate_resume_sec"] = round(migrate_s, 3)
+            out["fleet_migrate_bit_identical"] = bool(
+                np.array_equal(base_kept, kept_m) and all(
+                    np.array_equal(np.asarray(base_out[k]),
+                                   np.asarray(out_m[k]))
+                    for k in ("count", "sum")))
+
+        # 2-wave rolling-restart drill, one mid-persist kill.
+        rows = [("u1", "A", 1.0), ("u1", "B", 2.0), ("u2", "A", 1.0),
+                ("u2", "B", 3.0)]
+        ex = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                partition_extractor=lambda r: r[1],
+                                value_extractor=lambda r: r[2])
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=2,
+            max_contributions_per_partition=3,
+            min_value=0.0, max_value=5.0)
+
+        def spec(seed):
+            return JobSpec(params=params, epsilon=1.0, delta=1e-6,
+                           data_extractors=ex, noise_seed=seed,
+                           public_partitions=["A", "B"])
+
+        jobs = [rt_drill.LogicalJob(f"drill-j{i}",
+                                    "acme" if i % 2 else "beta",
+                                    spec(23 + i), rows)
+                for i in range(4)]
+        with tempfile.TemporaryDirectory() as tmp:
+            start = time.perf_counter()
+            report = rt_drill.rolling_restart_drill(jobs, tmp, waves=2)
+            drill_s = time.perf_counter() - start
+        out["fleet_drill_sec"] = round(drill_s, 3)
+        out["fleet_drill_zero_loss"] = bool(report["zero_loss"])
+        out["fleet_drill_bounces"] = int(report["bounces"])
+        out["fleet_drill_injected_failures"] = int(
+            report["injected_failures"])
+        out["fleet_drill_resubmissions"] = int(report["resubmissions"])
+
+        delta = rt_telemetry.delta(before)
+        out["fleet_counters"] = {
+            name: delta.get(name, 0)
+            for name in ("mesh_expansions", "job_migrations",
+                         "rolling_restarts", "journal_replays")
+        }
+        return {"fleet": out}
+    except Exception as e:  # noqa: BLE001 - the receipt must survive fleet-bench breakage; tests/test_fleet.py owns failing on it
+        return {"fleet": {"error": f"{type(e).__name__}: {e}"}}
 
 
 def _bench_select_partitions(jax, on_tpu):
@@ -1248,6 +1405,10 @@ def main():
     # occupancy, launches per N jobs, the single-row-job floor). ---
     megabatch_detail = _bench_megabatch(on_tpu)
 
+    # --- Fleet operations: mini scale-UP, drain-and-migrate, and the
+    # 2-wave rolling-restart drill (wall time + counter deltas). ---
+    fleet_detail = _bench_fleet(on_tpu)
+
     # --- BASELINE configs 1-3 (LocalBackend ref, Gaussian+public,
     # compound combiner). ---
     baseline_detail = _bench_baseline_configs(jax, jnp, on_tpu)
@@ -1389,6 +1550,7 @@ def main():
                 **multihost_detail,
                 **service_detail,
                 **megabatch_detail,
+                **fleet_detail,
                 **baseline_detail,
                 "runtime_fault_counters": fault_counters,
                 "runtime_phase_timings": phase_timings,

@@ -1189,6 +1189,8 @@ def _blocked_runtime_kwargs(backend, kind: str, static_config) -> dict:
     if getattr(backend, "mesh", None) is not None:
         if getattr(backend, "elastic", False):
             kwargs["elastic"] = True
+        if getattr(backend, "elastic_grow", False):
+            kwargs["elastic_grow"] = True
         min_devices = getattr(backend, "min_devices", 1)
         if min_devices != 1:
             kwargs["min_devices"] = min_devices
@@ -1230,6 +1232,8 @@ def _dense_runtime_kwargs(backend, kind: str) -> dict:
         kwargs["job_id"] = job_id
     if getattr(backend, "elastic", False):
         kwargs["elastic"] = True
+    if getattr(backend, "elastic_grow", False):
+        kwargs["elastic_grow"] = True
     min_devices = getattr(backend, "min_devices", 1)
     if min_devices != 1:
         kwargs["min_devices"] = min_devices

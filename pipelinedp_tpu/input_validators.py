@@ -107,6 +107,22 @@ def validate_elastic(elastic, obj_name: str) -> None:
                          f"mesh degradation on the meshed drivers).")
 
 
+def validate_elastic_grow(elastic_grow, obj_name: str) -> None:
+    """Validates the elastic scale-UP switch: a plain bool.
+
+    Raises:
+        ValueError: elastic_grow is not a bool (a truthy non-bool — say
+        a device list passed by mistake — would silently enable or
+        disable join admission).
+    """
+    if not isinstance(elastic_grow, bool):
+        raise ValueError(
+            f"{obj_name}: elastic_grow must be a bool, but "
+            f"{elastic_grow!r} given (True lets the meshed drivers admit "
+            f"announced join candidates at block boundaries and grow the "
+            f"mesh — shrink tolerance included, so it implies elastic).")
+
+
 def validate_min_devices(min_devices, obj_name: str) -> None:
     """Validates the elastic degradation floor: an integer >= 1.
 
@@ -331,6 +347,27 @@ def validate_queue_timeout_s(queue_timeout_s, obj_name: str) -> None:
             f"but queue_timeout_s={queue_timeout_s} given — jobs that "
             f"wait in the admission queue longer than this are shed "
             f"with a retry-after instead of running arbitrarily late.")
+
+
+def validate_drain_timeout_s(drain_timeout_s, obj_name: str) -> None:
+    """Validates the drain bound: a positive finite number of seconds.
+
+    Raises:
+        ValueError: drain_timeout_s is not a positive finite number (an
+        unbounded drain would let one wedged job stall a rolling
+        restart forever).
+    """
+    if (not isinstance(drain_timeout_s, numbers.Number) or
+            isinstance(drain_timeout_s, bool) or
+            math.isnan(drain_timeout_s)):
+        raise ValueError(f"{obj_name}: drain_timeout_s must be a number "
+                         f"of seconds, but {drain_timeout_s!r} given.")
+    if drain_timeout_s <= 0 or math.isinf(drain_timeout_s):
+        raise ValueError(
+            f"{obj_name}: drain_timeout_s must be positive and finite, "
+            f"but drain_timeout_s={drain_timeout_s} given — it bounds "
+            f"how long drain() waits for running jobs before a "
+            f"migration or rolling restart proceeds.")
 
 
 def validate_shed_watermark_fraction(shed_watermark_fraction,

@@ -245,6 +245,33 @@ def probe_live_devices(devices: Sequence, heartbeat=None) -> List:
     return live
 
 
+def join_candidates(mesh: Mesh, devices: Optional[Sequence] = None,
+                    n_devices: Optional[int] = None) -> List:
+    """Devices eligible to JOIN `mesh` in an elastic scale-UP.
+
+    Resolves a join announcement (runtime/retry.announce_join) against
+    the live mesh: either an explicit device list (devices already in
+    the mesh are dropped — re-admitting them is a no-op), or a TARGET
+    total of `n_devices`, filled from jax.devices() in enumeration order
+    (the stable order every controller of a pod agrees on, so all of
+    them resolve the same candidate set from the same announcement).
+    Candidates are only nominated here; the elastic runtime still
+    probes them (probe_live_devices) before rebuilding the mesh.
+    """
+    current = {getattr(d, "id", d) for d in mesh.devices.flat}
+    if devices is not None:
+        return [d for d in devices if getattr(d, "id", d) not in current]
+    if n_devices is None:
+        return []
+    out = []
+    for d in jax.devices():
+        if len(current) + len(out) >= int(n_devices):
+            break
+        if getattr(d, "id", d) not in current:
+            out.append(d)
+    return out
+
+
 def shard_map(f, mesh: Mesh, in_specs, out_specs):
     """Version-portable shard_map with replication checking off.
 

@@ -391,6 +391,17 @@ class TPUBackend(LocalBackend):
             (zero duplicate ledger registrations). At the one-device
             floor the unsharded driver runs instead. Meaningless
             without a mesh.
+        elastic_grow: full fleet elasticity for the meshed paths. When
+            True, the meshed drivers run under
+            runtime/retry.run_with_mesh_elasticity: everything elastic
+            does (shrink tolerance is included — elastic_grow implies
+            elastic), PLUS scale-UP — join candidates announced via
+            runtime/retry.announce_join (new hosts/devices probed
+            healthy) are admitted at the next block boundary and the
+            mesh rebuilds over the larger device set. Block keys are
+            geometry-independent, so the grown run's releases are
+            bit-identical to the fixed-geometry run's. Meaningless
+            without a mesh.
         min_devices: elastic degradation floor (default 1). Losses that
             leave fewer live devices raise
             runtime.MeshDegradationError naming the job_id and journal
@@ -504,6 +515,7 @@ class TPUBackend(LocalBackend):
                  timeout_s: Optional[float] = None,
                  watchdog=None,
                  elastic: bool = False,
+                 elastic_grow: bool = False,
                  min_devices: int = 1,
                  trace: bool = False,
                  aot: bool = False,
@@ -534,6 +546,7 @@ class TPUBackend(LocalBackend):
         if watchdog is not None:
             input_validators.validate_watchdog(watchdog, "TPUBackend")
         input_validators.validate_elastic(elastic, "TPUBackend")
+        input_validators.validate_elastic_grow(elastic_grow, "TPUBackend")
         input_validators.validate_min_devices(min_devices, "TPUBackend")
         input_validators.validate_trace(trace, "TPUBackend")
         input_validators.validate_aot(aot, "TPUBackend")
@@ -584,6 +597,7 @@ class TPUBackend(LocalBackend):
         self.timeout_s = timeout_s
         self.watchdog = watchdog
         self.elastic = elastic
+        self.elastic_grow = elastic_grow
         self.min_devices = min_devices
         self.trace = trace
         self.aot = aot
@@ -652,6 +666,7 @@ class TPUBackend(LocalBackend):
             timeout_s=self.timeout_s,
             watchdog=self.watchdog,
             elastic=self.elastic,
+            elastic_grow=self.elastic_grow,
             min_devices=self.min_devices,
             aot=self.aot,
             fused_release=self.fused_release,

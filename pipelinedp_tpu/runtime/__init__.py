@@ -26,6 +26,19 @@ semantics:
     release; the one-device floor falls back to the unsharded driver,
     and losses past min_devices raise MeshDegradationError with a
     resume pointer.
+  * retry.run_with_mesh_elasticity — the same machinery grown UPWARD:
+    announce_join posts a join ticket and a driver invoked with
+    elastic_grow=True admits the candidates at the next block boundary
+    (probing each one), rebuilds the mesh over the larger device set
+    and re-enters — consumed blocks replay, the rest re-derive the
+    same geometry-independent keys, so the grown run is bit-identical
+    to the fixed-geometry run. A failed admission probe aborts back
+    onto the old mesh with the ticket spent.
+  * drill.rolling_restart_drill — the fleet-operations gate: a
+    sustained submit loop survives every service instance bounced in
+    turn over one durable ledger directory (one job killed between its
+    ledger's fsync and rename) with zero lost jobs and every tenant's
+    disk spend reconciling bit-exactly.
   * watchdog — deadline/heartbeat monitoring of every block-stream step
     (dispatch, drain, collective reshard, control fetches): per-block
     deadlines (explicit timeout_s or a multiple of the pass-1 profiled
@@ -106,10 +119,27 @@ from pipelinedp_tpu.runtime.journal import (BlockJournal,
                                             JournalCorruptionError)
 from pipelinedp_tpu.runtime.retry import (BlockOOMError,
                                           MeshDegradationError, RetryPolicy,
-                                          is_device_fatal, retry_call,
-                                          run_with_degradation,
-                                          run_with_mesh_degradation)
+                                          announce_join, clear_joins,
+                                          is_device_fatal, pending_joins,
+                                          retry_call, run_with_degradation,
+                                          run_with_mesh_degradation,
+                                          run_with_mesh_elasticity)
 from pipelinedp_tpu.runtime.watchdog import BlockTimeoutError, Watchdog
+
+
+def __getattr__(name):
+    # The drill drives DPAggregationService, whose import chain reaches
+    # back through executor/combiners into this package — a module-level
+    # import here would be circular. PEP 562 lazy attribute: the drill
+    # loads on first access, after the package graph is complete.
+    if name == "drill":
+        import importlib
+        module = importlib.import_module("pipelinedp_tpu.runtime.drill")
+        globals()["drill"] = module
+        return module
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = [
     "BlockJournal",
@@ -125,16 +155,21 @@ __all__ = [
     "PIPELINE_DEPTH",
     "RetryPolicy",
     "Watchdog",
+    "announce_join",
     "aot",
+    "clear_joins",
+    "drill",
     "entry",
     "faults",
     "health",
     "observability",
+    "pending_joins",
     "pipeline",
     "is_device_fatal",
     "retry_call",
     "run_with_degradation",
     "run_with_mesh_degradation",
+    "run_with_mesh_elasticity",
     "telemetry",
     "trace",
 ]

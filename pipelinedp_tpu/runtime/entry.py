@@ -6,7 +6,8 @@ select_partitions_blocked_sharded) and the two unsharded blocked drivers
 a single API boundary for the runtime knobs:
 
   * validation: every runtime knob (job_id, timeout_s, retry, journal,
-    watchdog, elastic, min_devices) is rejected with an actionable
+    watchdog, elastic, elastic_grow, min_devices) is rejected with an
+    actionable
     message HERE, through input_validators, before any device work —
     tests/test_knob_validation.py greps this module to prove no knob
     can skip it.
@@ -29,6 +30,11 @@ a single API boundary for the runtime knobs:
     multi-controller meshes the same loop covers whole-host loss: the
     mesh rebuilds over the surviving hosts, and an evacuated controller
     (no addressable device left) raises HostEvacuatedError.
+    elastic_grow=True upgrades the loop to full fleet elasticity
+    (run_with_mesh_elasticity): announced join candidates
+    (retry.announce_join) are admitted at block boundaries and the mesh
+    rebuilds over the LARGER device set — shrink tolerance included, so
+    elastic_grow implies elastic.
   * multi-controller coordination (meshed drivers on a mesh that is not
     fully addressable): the journal knob is automatically scoped to this
     controller's process index (BlockJournal.scoped_to_process) so
@@ -73,6 +79,7 @@ def runtime_entry(kind: str, fallback: Optional[Callable] = None):
                     watchdog: Optional[rt_watchdog.Watchdog] = None,
                     job_id: Optional[str] = None,
                     elastic: bool = False,
+                    elastic_grow: bool = False,
                     min_devices: int = 1,
                     **kwargs):
             job = job_id or kind
@@ -92,6 +99,7 @@ def runtime_entry(kind: str, fallback: Optional[Callable] = None):
                 input_validators.validate_fused_release(
                     kwargs["fused"], kind)
             input_validators.validate_elastic(elastic, kind)
+            input_validators.validate_elastic_grow(elastic_grow, kind)
             input_validators.validate_min_devices(min_devices, kind)
             if elastic and not meshed:
                 # The unsharded drivers have no mesh to degrade; the knob
@@ -133,8 +141,15 @@ def runtime_entry(kind: str, fallback: Optional[Callable] = None):
             with rt_health.job_scope(job), rt_watchdog.activate(wd), \
                     mesh_lib.fetch_retry_scope(fetch_retries), \
                     rt_trace.span(kind, **span_attrs):
-                if meshed and elastic:
-                    result = rt_retry.run_with_mesh_degradation(
+                if meshed and (elastic or elastic_grow):
+                    # elastic_grow implies shrink tolerance: the full-
+                    # fleet loop (run_with_mesh_elasticity) is the shrink
+                    # loop plus join admission, so the strongest knob
+                    # picks the engine.
+                    elastic_runner = (rt_retry.run_with_mesh_elasticity
+                                      if elastic_grow else
+                                      rt_retry.run_with_mesh_degradation)
+                    result = elastic_runner(
                         lambda m: fn(m, *args[1:], job_id=job, **kwargs),
                         args[0],
                         fallback=lambda: fallback(args, kwargs, job),

@@ -63,6 +63,30 @@ Fault kinds and the exception they raise:
                                       so the mesh.probe_live_devices
                                       liveness probe sees a consistent
                                       dead set across re-entries.
+  host_join_failure
+              InjectedHostJoinError   a JOINING host/device died mid-
+                                      admit during an elastic scale-UP
+                                      (retry.run_with_mesh_elasticity):
+                                      the grow must abort back to the
+                                      old mesh and continue — never
+                                      wedge on the half-admitted
+                                      geometry.
+  restart_during_persist
+              InjectedRestartError    a process kill between a journal
+                                      record's fsync and its atomic
+                                      rename (journal.put): the tmp file
+                                      is unlinked, the old record (or
+                                      none) remains the durable truth —
+                                      exactly what a real mid-persist
+                                      restart leaves behind. `point`
+                                      targets odometer (ledger/odometer
+                                      trail persists) | block (block
+                                      records); None fires on whichever
+                                      persist reaches it first.
+
+Most schedules are thread-local (inject()); the rolling-restart drill
+injects with scope="process" so faults scheduled from the drill thread
+fire inside service worker threads' persist paths too.
 """
 
 import contextlib
@@ -74,6 +98,7 @@ import time
 from typing import List, Optional
 
 from pipelinedp_tpu.runtime import telemetry
+from pipelinedp_tpu.runtime.concurrency import guarded_by
 
 # Hard cap on an injected hang with no explicit delay: long enough that a
 # configured watchdog always wins the race, short enough that a watchdog
@@ -111,6 +136,21 @@ class InjectedDeviceLossError(InjectedFault):
     re-dispatching the same program onto a dead chip cannot succeed)."""
 
 
+class InjectedHostJoinError(InjectedFault):
+    """A joining host/device died mid-admit during elastic scale-UP. The
+    grow aborts back to the old (still fully live) mesh and the run
+    continues there — the join candidates were never part of any
+    dispatched program, so nothing was computed (let alone released) on
+    them and no recovery beyond dropping the ticket is needed."""
+
+
+class InjectedRestartError(InjectedFault):
+    """A process restart between a journal record's fsync and its atomic
+    rename: the record was never named, so a reload sees the previous
+    trail (or none). Models the kill window the rolling-restart drill
+    exercises against the ledger persist path."""
+
+
 _RAISES = {
     "dispatch": InjectedDispatchError,
     "consume": InjectedConsumeError,
@@ -118,6 +158,8 @@ _RAISES = {
     "collective": InjectedCollectiveError,
     "fatal": InjectedFatalError,
     "device_loss": InjectedDeviceLossError,
+    "host_join_failure": InjectedHostJoinError,
+    "restart_during_persist": InjectedRestartError,
 }
 
 
@@ -128,9 +170,11 @@ class Fault:
 
     delay: seconds — the sleep of a "slow" fault, or the hard cap of a
         "hang" fault (0 = the 30 s default cap).
-    point: "hang" (dispatch | drain | collective) and "device_loss"
-        (dispatch | collective) only — restrict to one hook site; None
-        fires at whichever site reaches it first.
+    point: "hang" (dispatch | drain | collective), "device_loss"
+        (dispatch | collective) and "restart_during_persist"
+        (odometer | block — which journal persist the kill targets)
+        only — restrict to one hook site; None fires at whichever site
+        reaches it first.
     mode: "corrupt" only — "flip" (default) flips one payload byte,
         "truncate" cuts the file in half.
     device: "device_loss" only — global jax device id of the lost chip.
@@ -145,7 +189,7 @@ class Fault:
     block: Optional[int] = None
     times: int = 1
     delay: float = 0.0  # kind in ("slow", "hang") only
-    point: Optional[str] = None  # kind in ("hang", "device_loss") only
+    point: Optional[str] = None  # "hang"/"device_loss"/"restart_during_persist"
     mode: str = "flip"  # kind == "corrupt" only
     device: Optional[int] = None  # kind == "device_loss" only
     process: Optional[int] = None  # kind == "device_loss" only
@@ -155,9 +199,10 @@ class Fault:
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.times <= 0:
             raise ValueError("times must be positive")
-        allowed_points = (("dispatch", "collective")
-                          if self.kind == "device_loss" else
-                          ("dispatch", "drain", "collective"))
+        allowed_points = {
+            "device_loss": ("dispatch", "collective"),
+            "restart_during_persist": ("odometer", "block"),
+        }.get(self.kind, ("dispatch", "drain", "collective"))
         if self.point is not None and self.point not in allowed_points:
             raise ValueError(f"unknown {self.kind} point {self.point!r}")
         if self.mode not in ("flip", "truncate"):
@@ -241,14 +286,64 @@ class FaultSchedule:
 _active = threading.local()
 
 
+class _ProcessSchedule:
+    """Process-wide fallback schedule slot (inject(scope="process")).
+
+    The thread-local slot always wins when set; the process slot exists
+    for the rolling-restart drill, whose scheduled persist kill must
+    fire inside a SERVICE WORKER thread's ledger persist while the
+    schedule is installed from the drill's own thread. FaultSchedule
+    itself is not thread-safe, so a process-scoped schedule should be
+    consumed by one worker at a time (the drill runs the service with
+    max_concurrent_jobs=1)."""
+
+    _GUARDED_BY = guarded_by("_lock", "_schedule")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._schedule: Optional[FaultSchedule] = None
+
+    def get(self) -> Optional[FaultSchedule]:
+        with self._lock:
+            return self._schedule
+
+    def swap(self,
+             schedule: Optional[FaultSchedule]) -> Optional[FaultSchedule]:
+        with self._lock:
+            prev = self._schedule
+            self._schedule = schedule
+            return prev
+
+
+_process = _ProcessSchedule()
+
+
 def active() -> Optional[FaultSchedule]:
-    return getattr(_active, "schedule", None)
+    local = getattr(_active, "schedule", None)
+    if local is not None:
+        return local
+    return _process.get()
 
 
 @contextlib.contextmanager
-def inject(schedule: FaultSchedule):
-    """Activates `schedule` for the current thread within the scope."""
-    prev = active()
+def inject(schedule: FaultSchedule, scope: str = "thread"):
+    """Activates `schedule` within the context.
+
+    scope="thread" (default): visible to the current thread only.
+    scope="process": a process-wide fallback every thread without its
+    own thread-local schedule consults — hooks running on OTHER threads
+    (service workers persisting a ledger) see it too.
+    """
+    if scope not in ("thread", "process"):
+        raise ValueError(f"unknown inject scope {scope!r}")
+    if scope == "process":
+        prev = _process.swap(schedule)
+        try:
+            yield schedule
+        finally:
+            _process.swap(prev)
+        return
+    prev = getattr(_active, "schedule", None)
     _active.schedule = schedule
     try:
         yield schedule

@@ -78,9 +78,21 @@ _STALLING_COUNTERS = frozenset({"block_timeouts", "watchdog_timeouts"})
 # aot_cache_hits/misses are neutral like jit_cache_misses: per-job
 # attribution is what lets the service prove a second identical-spec
 # tenant executed with ZERO AOT retraces on its own record.
+# Fleet-operation counters are tracked but NEUTRAL: a scale-UP
+# admission, a journal migration or a rolling restart is planned
+# operations work, not adversity — the job's results are bit-identical
+# and nothing was lost, so the state machine must not call it DEGRADED.
+# Per-job attribution is what lets the fleet tests assert "this job
+# grew/migrated" on its own health record.
 _TRACKED_COUNTERS = (_DEGRADING_COUNTERS | _STALLING_COUNTERS |
                      frozenset({"journal_replays", "jit_cache_misses",
-                                "aot_cache_hits", "aot_cache_misses"}))
+                                "aot_cache_hits", "aot_cache_misses",
+                                "mesh_expansions", "job_migrations",
+                                "rolling_restarts"}))
+
+# Bound on the per-job fleet-event note list: the notes are a human
+# audit trail (REJOINING/MIGRATING annotations), not a log.
+_MAX_FLEET_EVENTS = 32
 
 
 def _process_index() -> int:
@@ -119,7 +131,7 @@ class JobHealth:
     _GUARDED_BY = guarded_by("_lock", "_state", "_counters",
                              "_phase_seconds", "_last_error", "_last_beat",
                              "_planned_devices", "_live_devices",
-                             "_completed_runs")
+                             "_completed_runs", "_fleet_events")
 
     def __init__(self, job_id: str):
         self.job_id = job_id
@@ -142,6 +154,11 @@ class JobHealth:
         # reports them).
         self._planned_devices: Optional[int] = None
         self._live_devices: Optional[int] = None
+        # Fleet-operation annotations (REJOINING scale-UP admissions,
+        # MIGRATING journal adoptions): bounded (kind, detail) audit
+        # trail, surfaced verbatim in snapshots. Notes, not states —
+        # fleet operations are benign and never move the state machine.
+        self._fleet_events: list = []
 
     # -- event intake ----------------------------------------------------
 
@@ -188,6 +205,20 @@ class JobHealth:
         # nest the two): the live-device level is scrapeable mid-run.
         telemetry.set_gauge("live_devices", int(live_devices),
                             job_id=self.job_id)
+
+    def note_fleet_event(self, kind: str, detail: str) -> None:
+        """Annotates a fleet operation on the job's record: REJOINING (a
+        scale-UP admitted — or aborted admitting — joining devices) or
+        MIGRATING (journal records adopted into a new controller scope).
+        Events are notes, not states: a grow or a migration is planned
+        work with bit-identical results, so the health state is
+        untouched — but an operator reading the snapshot sees WHAT fleet
+        operations the job lived through, in order."""
+        if kind not in ("REJOINING", "MIGRATING"):
+            raise ValueError(f"unknown fleet event kind {kind!r}")
+        with self._lock:
+            if len(self._fleet_events) < _MAX_FLEET_EVENTS:
+                self._fleet_events.append((kind, str(detail)))
 
     def note_recovered(self) -> None:
         """A stalled operation completed (late) or its retry succeeded:
@@ -237,6 +268,9 @@ class JobHealth:
                     self._counters.get("journal_quarantined", 0),
                 "planned_devices": self._planned_devices,
                 "live_devices": self._live_devices,
+                "fleet_events": [
+                    {"kind": k, "detail": d} for k, d in self._fleet_events
+                ],
                 "phase_seconds": {
                     k: round(v, 6) for k, v in self._phase_seconds.items()
                 },

@@ -67,6 +67,12 @@ _CRC_KEY = "__crc32__"
 # retry.run_with_degradation, interpreted by compact().
 PLAN_KEY = "__plan__"
 
+# Journal key of the per-job odometer/ledger trail (written by
+# observability.persist_odometer as ODOMETER_KEY). Named here so the
+# restart_during_persist fault hook can target odometer persists
+# distinctly from block-record persists.
+_ODOMETER_KEY = "__odometer__"
+
 
 class JournalCorruptionError(RuntimeError):
     """A journal record failed its integrity check."""
@@ -170,6 +176,92 @@ class BlockJournal:
         scoped._process_index = process_index
         return scoped
 
+    def adopt_job(self, job_id: str,
+                  source_process_index: Optional[int] = None) -> int:
+        """Imports another controller scope's records for `job_id` into
+        THIS journal's scope — the drain-and-migrate primitive.
+
+        A job cancelled on pod A leaves its consumed-block records (and
+        its odometer/ledger trail) in the shared journal directory under
+        pod A's scope. A controller on pod B — any geometry — adopts
+        them here: each record is CRC-verified, re-written under this
+        journal's own scope, and the resumed run replays them exactly as
+        a same-pod resume would. Block keys are fold_in(final_key, b) —
+        geometry-independent — so the migrated run is a replay of the
+        same release, never a second one.
+
+        Records are replicated across a pod's controllers, so ONE source
+        scope suffices: `source_process_index` names it explicitly;
+        default is the unscoped records if any (and this journal is
+        scoped), else the lowest-indexed foreign ``p<i>`` scope. Records
+        already present under this scope are kept (never overwritten —
+        they are this controller's own released truth); corrupt source
+        records are quarantined and skipped, and their blocks simply
+        re-dispatch under the same keys on resume.
+
+        Returns the number of records adopted (0 = nothing to migrate).
+        """
+        if self._dir is None:
+            raise ValueError(
+                "adopt_job requires a directory-backed journal: "
+                "migration moves records between controller scopes of a "
+                "SHARED directory (BlockJournal(directory=...))")
+        base_prefix = f"{_safe(job_id)}__"
+        scoped_re = re.compile(r"^p(\d+)__(.+)$")
+        by_scope: Dict[Optional[int], Dict[str, str]] = {}
+        for name in os.listdir(self._dir):
+            if not (name.startswith(base_prefix) and name.endswith(".npz")):
+                continue
+            rest = name[len(base_prefix):-len(".npz")]
+            m = scoped_re.match(rest)
+            scope = int(m.group(1)) if m else None
+            key = m.group(2) if m else rest
+            by_scope.setdefault(scope, {})[key] = name
+        mine = self._process_index
+        if source_process_index is not None:
+            sources = [int(source_process_index)]
+        else:
+            foreign = sorted(s for s in by_scope
+                             if s is not None and s != mine)
+            sources = ([None] if None in by_scope and mine is not None
+                       else []) + foreign
+        have = set(self.keys(job_id))
+        adopted = 0
+        for source in sources:
+            if source == mine or source not in by_scope:
+                continue
+            for key, name in sorted(by_scope[source].items()):
+                if key in have or _safe(key) in {_safe(k) for k in have}:
+                    continue
+                path = os.path.join(self._dir, name)
+                try:
+                    record = self._load_verified(path)
+                except Exception as e:  # noqa: BLE001 - any load failure
+                    self._quarantine(job_id, key, path, e)
+                    continue
+                self.put(job_id, key, record)
+                have.add(key)
+                adopted += 1
+            break  # records are replicated; one source is complete
+        if adopted:
+            from pipelinedp_tpu.runtime import health as rt_health
+            from pipelinedp_tpu.runtime import telemetry
+            rt_health.for_job(job_id).note_fleet_event(
+                "MIGRATING",
+                f"adopted {adopted} journal record(s) into "
+                f"process scope {mine!r}")
+            if rt_health.current() is None:
+                with rt_health.track(rt_health.for_job(job_id)):
+                    telemetry.record("job_migrations", records=adopted)
+            else:
+                telemetry.record("job_migrations", records=adopted)
+            logging.info(
+                "journal: job %r migrated into process scope %r — "
+                "adopted %d record(s); the resumed run replays them "
+                "bit-identically (block keys are geometry-independent).",
+                job_id, mine, adopted)
+        return adopted
+
     def _job_prefix(self, job_id: str) -> str:
         """File-name prefix of one job's records under this scope."""
         if self._process_index is None:
@@ -233,6 +325,16 @@ class BlockJournal:
                     np.savez(f, **payload)
                     f.flush()
                     os.fsync(f.fileno())
+                # Fault-injection hook: 'restart_during_persist' kills
+                # the writer in the window between durability (fsync)
+                # and nameability (rename) — the previous record, or
+                # none, stays the durable truth, exactly as a real
+                # mid-persist process death would leave it.
+                from pipelinedp_tpu.runtime import faults as rt_faults
+                rt_faults.maybe_fail(
+                    "restart_during_persist", 0,
+                    point=("odometer" if str(key) == _ODOMETER_KEY
+                           else "block"))
                 os.replace(tmp, self._path(job_id, key))
             except BaseException:
                 if os.path.exists(tmp):

@@ -23,7 +23,26 @@ the same device count:
     (Fault(device_loss, process=...)): the surviving controller rebuilds
     the mesh over its own devices and completes bit-identically (block
     keys are geometry-independent), while the evacuated controller
-    raises HostEvacuatedError and exits cleanly.
+    raises HostEvacuatedError and exits cleanly;
+  * the grow scenario (fleet operations, PR 17) starts each controller
+    on HALF its devices (one per process), announces the other half as
+    join candidates at block 2, and proves the elastic scale-UP
+    (retry.run_with_mesh_elasticity) completes bit-identically to the
+    full-geometry reference — the mirror image of host loss;
+  * the migrate_source scenario interrupts a journaled blocked run with
+    an injected fatal at block 4 and persists each controller's
+    odometer trail; the PARENT then adopts the journal records into its
+    own scope (BlockJournal.adopt_job) and resumes at a DIFFERENT
+    geometry, bit-identically — the drain-and-migrate path;
+  * the drill:<gen>:<state_dir> scenarios are the pod half of the
+    rolling-restart drill: each generation is a full controller respawn
+    over a shared ledger directory (jax.distributed worlds are fixed at
+    init, so a controller bounce IS a new generation), generation 1
+    kills controller p1 inside its last ledger persist's fsync-to-
+    rename window, and generation 2's restarted controllers reload
+    their trails and re-charge the lost job under the SAME id —
+    idempotent where the charge landed, an append where the kill ate it
+    — with the final per-process trails reconciling bit-exactly.
 
 The spawn helper enforces a HARD timeout — a wedged child (a collective
 waiting on a dead peer) is killed and surfaced as a failure, so the
@@ -380,6 +399,261 @@ def reference_host_loss_outputs() -> Dict[str, np.ndarray]:  # staticcheck: disa
     }
 
 
+def run_grow_workload(journal_dir: str) -> Dict[str, np.ndarray]:  # staticcheck: disable=key-hygiene — fixed literal harness key shared with the full-geometry reference (bit-identity proof); noise stds are zeroed, not a product release
+    """The blocked aggregate driver under an elastic SCALE-UP: each
+    controller starts on HALF its devices (one per process — the pod's
+    "before more hardware arrived" geometry), announces the remaining
+    devices as join candidates at block 2, and runs with
+    elastic_grow=True. Both controllers announce identically, so both
+    unwind at the same block boundary, admit the same candidates (the
+    jax.devices() enumeration order is pod-consistent) and rebuild the
+    same full mesh — blocks 0-1 replay from each controller's scoped
+    journal, the rest dispatch on the grown mesh with unchanged
+    fold_in(final_key, b) keys. Host-numpy inputs, so every re-entry
+    re-stages onto whatever mesh is current."""
+    import jax
+
+    from pipelinedp_tpu.parallel import large_p
+    from pipelinedp_tpu.parallel import mesh as mesh_lib
+    from pipelinedp_tpu.runtime import journal as rt_journal
+    from pipelinedp_tpu.runtime import retry as rt_retry
+
+    devices = sorted(jax.devices(),
+                     key=lambda d: (d.process_index, d.id))
+    by_proc: Dict[int, list] = {}
+    for d in devices:
+        by_proc.setdefault(int(d.process_index), []).append(d)
+    small = [ds[0] for _, ds in sorted(by_proc.items())]
+    mesh = mesh_lib.make_mesh(devices=small)
+
+    P_big = 4096
+    cfg_big, _, stds_big, (min_v, max_v, min_s, max_s, mid) = _pod_spec(
+        P_big)
+    pid_b, pk_b, values_b, valid_b = _pod_rows(P_big)
+    journal = rt_journal.BlockJournal(journal_dir)
+    rt_retry.announce_join(n_devices=len(devices), block=2)
+    try:
+        blk_ids, blk_out = large_p.aggregate_blocked_sharded(
+            mesh, pid_b, pk_b, values_b, valid_b, min_v, max_v, min_s,
+            max_s, mid, stds_big, jax.random.PRNGKey(7), cfg_big,
+            block_partitions=512, journal=journal, elastic_grow=True)
+    finally:
+        rt_retry.clear_joins()
+    return {
+        "blk_ids": np.asarray(blk_ids),
+        "blk_count": np.asarray(blk_out["count"]),
+        "blk_sum": np.asarray(blk_out["sum"]),
+    }
+
+
+MIGRATE_JOB_ID = "migrate-job"
+
+
+def run_migrate_source_workload(mesh,  # staticcheck: disable=key-hygiene — fixed literal harness key shared with the resumed run and the clean reference (bit-identity proof); noise-free, not a product release
+                                journal_dir: str) -> None:
+    """Pod A's half of drain-and-migrate: the journaled blocked
+    aggregate is interrupted by an injected fatal at block 4 (the
+    sharded driver numbers blocks by partition stride, so blocks 0 and
+    2 are drained and journaled first), and the controller persists
+    its odometer trail into its journal scope before exiting — the
+    complete state a migration target needs. Raises InjectedFatalError
+    (the caller marks the job interrupted)."""
+    import jax
+
+    from pipelinedp_tpu.parallel import large_p
+    from pipelinedp_tpu.parallel import mesh as mesh_lib
+    from pipelinedp_tpu.runtime import faults as rt_faults
+    from pipelinedp_tpu.runtime import journal as rt_journal
+    from pipelinedp_tpu.runtime import observability as rt_obs
+
+    P_big = 4096
+    cfg_big, _, stds_big, (min_v, max_v, min_s, max_s, mid) = _pod_spec(
+        P_big)
+    pid_b, pk_b, values_b, valid_b = _pod_rows(P_big)
+    journal = rt_journal.BlockJournal(journal_dir)
+    try:
+        with rt_faults.inject(rt_faults.FaultSchedule(
+                [rt_faults.Fault("fatal", block=4)])):
+            large_p.aggregate_blocked_sharded(
+                mesh, pid_b, pk_b, values_b, valid_b, min_v, max_v,
+                min_s, max_s, mid, stds_big, jax.random.PRNGKey(7),
+                cfg_big, block_partitions=512, journal=journal,
+                job_id=MIGRATE_JOB_ID)
+    finally:
+        # The cancelled job's odometer trail rides along with its block
+        # records (the entry wrapper only persists on success): the
+        # migration target adopts BOTH, so the tenant ledger's
+        # provenance survives the pod move.
+        scoped = journal.scoped_to_process(mesh_lib.process_index())
+        rt_obs.persist_odometer(scoped, MIGRATE_JOB_ID)
+
+
+def run_migration_target(journal_dir: str,  # staticcheck: disable=key-hygiene — fixed literal harness key shared with the interrupted source and the clean reference (bit-identity proof); noise-free, not a product release
+                         n_devices: int,
+                         source_process_index: Optional[int] = None
+                         ) -> Tuple[int, int, Dict[str, np.ndarray]]:
+    """Pod B's half of drain-and-migrate: adopts the interrupted job's
+    journal records into THIS process's scope (BlockJournal.adopt_job)
+    and resumes the same driver call at a (possibly different) geometry.
+    Adopted blocks replay, the rest re-derive the same geometry-
+    independent keys — the resumed outputs are bit-identical to an
+    uninterrupted run. Returns (records_adopted,
+    adopted_odometer_records, outputs) — the odometer count is read
+    BETWEEN adopt and resume, proving the tenant-ledger provenance
+    crossed the pod boundary (the resume's own teardown persist
+    supersedes it afterwards)."""
+    import jax
+
+    from pipelinedp_tpu.parallel import large_p
+    from pipelinedp_tpu.parallel.mesh import make_mesh
+    from pipelinedp_tpu.runtime import journal as rt_journal
+    from pipelinedp_tpu.runtime import observability as rt_obs
+
+    journal = rt_journal.BlockJournal(journal_dir)
+    adopted = journal.adopt_job(MIGRATE_JOB_ID,
+                                source_process_index=source_process_index)
+    adopted_odometer = len(rt_obs.load_odometer(journal, MIGRATE_JOB_ID))
+    P_big = 4096
+    cfg_big, _, stds_big, (min_v, max_v, min_s, max_s, mid) = _pod_spec(
+        P_big)
+    pid_b, pk_b, values_b, valid_b = _pod_rows(P_big)
+    blk_ids, blk_out = large_p.aggregate_blocked_sharded(
+        make_mesh(n_devices=n_devices), pid_b, pk_b, values_b, valid_b,
+        min_v, max_v, min_s, max_s, mid, stds_big, jax.random.PRNGKey(7),
+        cfg_big, block_partitions=512, journal=journal,
+        job_id=MIGRATE_JOB_ID)
+    return adopted, adopted_odometer, {
+        "blk_ids": np.asarray(blk_ids),
+        "blk_count": np.asarray(blk_out["count"]),
+        "blk_sum": np.asarray(blk_out["sum"]),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Rolling-restart drill generations (the pod half of the drill)
+# ---------------------------------------------------------------------------
+
+# The drill's tenant and planned job ids (service format, so
+# TenantLedger.max_job_seq parses them).
+DRILL_TENANT = "acme"
+
+
+def _drill_planned_jobs(gen: int) -> List[str]:
+    """Generation g's planned job ids. Every generation after the first
+    FIRST re-charges the previous generation's last job under the SAME
+    id: where the charge landed the replay is idempotent (no second
+    spend), where the mid-persist kill ate it the replay is the append
+    that makes the trail whole — the no-loss/no-double-spend pincer."""
+    own = [f"{DRILL_TENANT}--j{gen:03d}1", f"{DRILL_TENANT}--j{gen:03d}2"]
+    if gen <= 1:
+        return own
+    return [f"{DRILL_TENANT}--j{gen - 1:03d}2"] + own
+
+
+def _drill_records() -> List[dict]:
+    """A real accountant's mechanism trail (COUNT+SUM registration, eps
+    shares resolved by compute_budgets), deterministic across processes
+    and generations — the charge payload every drill job records."""
+    import pipelinedp_tpu as pdp
+    from pipelinedp_tpu import combiners
+    from pipelinedp_tpu.runtime import observability as rt_obs
+
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+        max_partitions_contributed=2,
+        max_contributions_per_partition=3,
+        min_value=0.0,
+        max_value=9.0)
+    acc = pdp.NaiveBudgetAccountant(total_epsilon=1.0, total_delta=1e-6)
+    combiners.create_compound_combiner(params, acc)
+    acc.compute_budgets()
+    records = rt_obs.odometer_report(accountant=acc)["records"]
+    rt_obs.prune_odometer(accountant=acc)
+    return records
+
+
+def _drill_dense_outputs(mesh) -> Dict[str, np.ndarray]:  # staticcheck: disable=key-hygiene — fixed literal harness key: every drill generation and the reference must draw identical outputs for the cross-controller bit-compare; not a product release
+    """The drill generations' sustained traffic: the dense meshed
+    aggregate over the shared recipe — cheap, and bit-comparable to the
+    single-process reference across every generation."""
+    import jax
+
+    from pipelinedp_tpu.parallel import sharded
+    from pipelinedp_tpu.parallel.mesh import host_fetch
+
+    P_dense = 48
+    cfg, _, stds, (min_v, max_v, min_s, max_s, mid) = _pod_spec(P_dense)
+    pid, pk, values, valid = _pod_rows(P_dense)
+    cols = _stage_global_rows(mesh, pid, pk, values, valid)
+    outputs, keep, _ = sharded.sharded_aggregate_arrays(
+        mesh, *cols, min_v, max_v, min_s, max_s, mid, stds,
+        jax.random.PRNGKey(3), cfg)
+    return {
+        "dense_count": host_fetch(outputs["count"]),
+        "dense_sum": host_fetch(outputs["sum"]),
+        "dense_keep": host_fetch(keep),
+    }
+
+
+def reference_drill_outputs() -> Dict[str, np.ndarray]:
+    """Single-process reference of the drill generations' traffic."""
+    from pipelinedp_tpu.parallel.mesh import make_mesh
+
+    n_dev = POD_PROCESSES * POD_DEVICES_PER_PROCESS
+    return _drill_dense_outputs(make_mesh(n_devices=n_dev))
+
+
+def _drill_generation(gen: int, state_dir: str, mesh,
+                      info: Dict[str, object]) -> Dict[str, np.ndarray]:
+    """One controller's life in drill generation `gen` (see the module
+    docstring): reload the per-process ledger trail from the shared
+    state_dir, run the sustained traffic, charge the generation's
+    planned jobs — and in generation 1, controller p1 dies inside its
+    LAST charge's ledger persist (fsync done, rename never happens),
+    modelling the kill -9 the rolling restart must absorb."""
+    from pipelinedp_tpu.parallel import mesh as mesh_lib
+    from pipelinedp_tpu.runtime import faults as rt_faults
+    from pipelinedp_tpu.runtime import journal as rt_journal
+    from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+    from pipelinedp_tpu.service.ledger import TenantLedger
+
+    me = mesh_lib.process_index()
+    ledger_journal = rt_journal.BlockJournal(
+        state_dir).scoped_to_process(me)
+    ledger = TenantLedger(DRILL_TENANT, 100.0, ledger_journal)
+    info["ledger_jobs_at_start"] = sorted(
+        {r.get("job_id") for r in ledger.records()})
+    if gen > 1:
+        # A later generation IS this controller's rolling restart:
+        # fresh process, ledger reloaded from the durable trail.
+        rt_telemetry.record("rolling_restarts", generation=gen)
+    outputs = _drill_dense_outputs(mesh)
+    planned = _drill_planned_jobs(gen)
+    info["planned_jobs"] = planned
+    info["died_during_persist"] = False
+    for job_id in planned:
+        records = _drill_records()
+        if gen == 1 and me == 1 and job_id == planned[-1]:
+            try:
+                with rt_faults.inject(rt_faults.FaultSchedule(
+                        [rt_faults.Fault("restart_during_persist",
+                                         point="odometer")])):
+                    ledger.charge(job_id, records)
+            except rt_faults.InjectedRestartError:
+                # A real kill -9 ends the process here: the in-memory
+                # trail dies with it, the disk keeps only what renamed.
+                # (The drill child exits cleanly so the spawner does
+                # not mistake the SCRIPTED kill for a harness failure.)
+                info["died_during_persist"] = True
+                break
+        else:
+            ledger.charge(job_id, records)
+    info["ledger_spent"] = ledger.spent_epsilon()
+    info["ledger_jobs_at_end"] = sorted(
+        {r.get("job_id") for r in ledger.records()})
+    return outputs
+
+
 def reference_identity_outputs(tmp_journal_dir: Optional[str] = None
                                ) -> Dict[str, np.ndarray]:
     """Single-process reference of the identity scenario: same recipe,
@@ -467,6 +741,27 @@ def _child_main(scenario: str, out_path: str) -> int:
         except rt_retry.HostEvacuatedError as e:
             info["evacuated"] = True
             info["evacuation_error"] = str(e)[:500]
+        with open(exporter.path) as f:
+            info["scrape"] = f.read()
+    elif scenario == "grow":
+        outputs.update(run_grow_workload(journal_dir))
+        with open(exporter.path) as f:
+            info["scrape"] = f.read()
+    elif scenario == "migrate_source":
+        from pipelinedp_tpu.runtime import faults as rt_faults
+        try:
+            run_migrate_source_workload(mesh, journal_dir)
+            raise SystemExit(
+                "migrate_source: the injected fatal never fired")
+        except rt_faults.InjectedFatalError as e:
+            info["interrupted"] = True
+            info["interruption_error"] = str(e)[:500]
+        with open(exporter.path) as f:
+            info["scrape"] = f.read()
+    elif scenario.startswith("drill:"):
+        _, gen_s, state_dir = scenario.split(":", 2)
+        outputs.update(
+            _drill_generation(int(gen_s), state_dir, mesh, info))
         with open(exporter.path) as f:
             info["scrape"] = f.read()
     else:
@@ -641,6 +936,155 @@ def check_host_loss_results(results: List[Tuple[dict, dict]],
             f"{counters.get('host_losses')}, journal_replays="
             f"{counters.get('journal_replays')}), lost controller "
             f"evacuated cleanly")
+
+
+def check_grow_results(results: List[Tuple[dict, dict]],
+                       reference: Dict[str, np.ndarray]) -> str:
+    """Asserts the grow scenario: every controller scaled UP mid-run
+    (mesh_expansions fired, journaled blocks replayed) and finished
+    bit-identically to the full-geometry reference."""
+    assert len(results) == POD_PROCESSES
+    for p, (info, outputs) in enumerate(results):
+        _assert_outputs_equal(outputs, reference,
+                              f"process {p} grown run vs full-geometry "
+                              f"reference")
+        counters = info["counters"]
+        assert counters.get("mesh_expansions", 0) >= 1, counters
+        assert counters.get("journal_replays", 0) >= 1, counters
+        assert counters.get("mesh_degradations", 0) == 0, counters
+    return (f"elastic scale-UP: {POD_PROCESSES} controllers grew "
+            f"{POD_PROCESSES} -> "
+            f"{POD_PROCESSES * POD_DEVICES_PER_PROCESS} devices at "
+            f"block 2 and finished bit-identically "
+            f"({len(reference['blk_ids'])} blocked partitions)")
+
+
+def check_migration_results(results: List[Tuple[dict, dict]],
+                            adopted: int,
+                            adopted_odometer: int,
+                            resumed: Dict[str, np.ndarray],
+                            reference: Dict[str, np.ndarray]) -> str:
+    """Asserts drain-and-migrate: every source controller was
+    interrupted AFTER journaling its progress, the target adopted a
+    complete scope (blocks + odometer trail), and the resumed run —
+    different process, different geometry — is bit-identical to an
+    uninterrupted one."""
+    assert len(results) == POD_PROCESSES
+    for p, (info, _) in enumerate(results):
+        assert info.get("interrupted"), (
+            f"process {p} was never interrupted — the migration source "
+            f"finished instead of draining")
+    assert adopted >= 1, (
+        "the migration target adopted no records — nothing migrated")
+    assert adopted_odometer >= 1, (
+        "the adopted scope carried no odometer trail — the tenant "
+        "ledger's provenance was lost in the move")
+    _assert_outputs_equal(resumed, reference,
+                          "migrated resume vs uninterrupted reference")
+    return (f"drain-and-migrate: adopted {adopted} journal record(s) "
+            f"(odometer trail included) from the interrupted pod and "
+            f"resumed bit-identically at a different geometry "
+            f"({len(reference['blk_ids'])} blocked partitions)")
+
+
+def run_pod_drill(state_dir: str, out_root: str,
+                  generations: int = 2,
+                  timeout_s: float = 240.0
+                  ) -> List[List[Tuple[dict, dict]]]:
+    """Runs `generations` pod generations of the rolling-restart drill
+    over one shared ledger state_dir. Each generation is a full
+    controller respawn (jax.distributed worlds are fixed at init — a
+    bounced controller IS a new process in a new world); generation 1
+    takes the scripted mid-persist kill on controller p1."""
+    all_results = []
+    for gen in range(1, generations + 1):
+        out_dir = os.path.join(out_root, f"gen{gen}")
+        os.makedirs(out_dir, exist_ok=True)
+        all_results.append(spawn_local_pod(
+            f"drill:{gen}:{state_dir}", out_dir, timeout_s=timeout_s))
+    return all_results
+
+
+def check_pod_drill_results(all_results: List[List[Tuple[dict, dict]]],
+                            state_dir: str,
+                            reference: Dict[str, np.ndarray]) -> str:
+    """Asserts the pod drill's zero-loss gates across generations:
+
+      * generation 1's controller p1 died inside its last ledger
+        persist (the scripted kill), p0 did not;
+      * every generation's traffic on every controller is bit-identical
+        to the single-process reference (restarts never perturbed
+        results);
+      * the final per-process disk trails charge every planned job
+        EXACTLY once, with per-job eps sums bit-equal across the two
+        controllers (same seq layout, same spend — the trail the kill
+        interrupted was made whole by the same-id re-charge, without
+        double-charging the controller where the original landed);
+      * restarted controllers counted their rolling_restarts.
+    """
+    from pipelinedp_tpu.runtime import journal as rt_journal
+    from pipelinedp_tpu.runtime import observability as rt_obs
+
+    generations = len(all_results)
+    assert generations >= 2, "the drill needs >= 2 generations"
+    gen1 = all_results[0]
+    assert gen1[1][0].get("died_during_persist"), (
+        "generation 1 controller p1 never took the scripted "
+        "mid-persist kill")
+    assert not gen1[0][0].get("died_during_persist")
+    for gen, results in enumerate(all_results, start=1):
+        for p, (info, outputs) in enumerate(results):
+            _assert_outputs_equal(
+                outputs, reference,
+                f"drill generation {gen} process {p} vs reference")
+            if gen > 1:
+                assert info["counters"].get("rolling_restarts", 0) >= 1, (
+                    f"generation {gen} process {p} never counted its "
+                    f"rolling restart")
+    # The planned universe: every generation's jobs, deduplicated (the
+    # re-charged job appears in two generations by design).
+    planned = set()
+    for gen in range(1, generations + 1):
+        planned.update(_drill_planned_jobs(gen))
+    journal = rt_journal.BlockJournal(state_dir)
+    per_proc = []
+    for p in range(POD_PROCESSES):
+        trail = rt_obs.load_odometer(journal.scoped_to_process(p),
+                                     DRILL_TENANT)
+        sums: Dict[str, float] = {}
+        counts: Dict[str, int] = {}
+        for r in trail:
+            jid = r.get("job_id") or ""
+            if r.get("eps") is not None:
+                sums[jid] = sums.get(jid, 0.0) + \
+                    r["eps"] * r.get("count", 1)
+            counts[jid] = counts.get(jid, 0) + 1
+        seqs = [r.get("seq") for r in trail]
+        assert seqs == sorted(set(seqs)), (
+            f"process {p} trail seq numbers are not unique/ordered — a "
+            f"record was double-charged: {seqs}")
+        assert set(sums) == planned, (
+            f"process {p} trail charges {sorted(sums)} but the drill "
+            f"planned {sorted(planned)} — a lost or phantom job")
+        per_proc.append((sums, counts))
+    sums0, counts0 = per_proc[0]
+    for p, (sums, counts) in enumerate(per_proc[1:], start=1):
+        assert sums == sums0, (
+            f"per-job spends diverged between controller trails (p0 vs "
+            f"p{p}): {sums0} vs {sums} — must be bit-equal")
+        assert counts == counts0, (
+            f"per-job record counts diverged (p0 vs p{p}): {counts0} "
+            f"vs {counts}")
+    final_spent = {info["ledger_spent"]
+                   for info, _ in all_results[-1]}
+    assert len(final_spent) == 1, (
+        f"final-generation ledgers disagree on total spend: "
+        f"{final_spent}")
+    return (f"pod rolling-restart drill: {generations} generations, "
+            f"{len(planned)} planned jobs each charged exactly once on "
+            f"both controller trails (total spend "
+            f"{final_spent.pop():.6f} eps, bit-equal across "
+            f"controllers); generation-1 mid-persist kill absorbed")
 
 
 def check_pod_observability(out_dir: str,

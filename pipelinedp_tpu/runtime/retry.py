@@ -23,8 +23,10 @@ text (there is no stable cross-version exception taxonomy to type-match)
 plus the injection harness's typed exceptions.
 """
 
+import contextlib
 import dataclasses
 import logging
+import threading
 import time
 from typing import Callable, List, Optional
 
@@ -35,6 +37,7 @@ from pipelinedp_tpu.runtime import health as health_lib
 from pipelinedp_tpu.runtime import journal as journal_lib
 from pipelinedp_tpu.runtime import telemetry
 from pipelinedp_tpu.runtime import watchdog as watchdog_lib
+from pipelinedp_tpu.runtime.concurrency import guarded_by
 
 # PJRT status markers of failures worth re-dispatching: the runtime came
 # back (or will), the program itself is fine.
@@ -115,6 +118,133 @@ class HostEvacuatedError(MeshDegradationError):
     and their journals/health carry the degradation record."""
 
 
+class MeshGrowthSignal(RuntimeError):
+    """Control-flow signal of an elastic SCALE-UP: a join announcement
+    (announce_join) matched the current block boundary, so the running
+    driver must unwind — draining every in-flight block into the journal
+    on the way out, exactly like the shrink path — and let
+    run_with_mesh_elasticity rebuild the mesh over the larger device
+    set. Never an error: is_transient/is_oom/is_device_fatal all
+    classify it false, so it propagates straight to the elastic loop.
+
+    Bit-identity is preserved by construction: block keys are
+    fold_in(final_key, b) — pure functions of the run key and block
+    index, independent of mesh geometry — so the re-entered run replays
+    journaled blocks and re-derives the same keys for the rest."""
+
+    def __init__(self, devices=None, n_devices: Optional[int] = None,
+                 block: int = 0):
+        super().__init__(
+            f"mesh growth admitted at block boundary {block} "
+            f"(join announcement matched)")
+        self.devices = devices
+        self.n_devices = n_devices
+        self.block = block
+
+
+class _JoinRegistry:
+    """Process-wide registry of announced join candidates.
+
+    A scale-UP is initiated from OUTSIDE the running driver (a cluster
+    manager noticing healthy spare hosts), so announcements land in a
+    shared registry and the driver polls it at block boundaries
+    (maybe_grow, hooked into retry_call's dispatch sequence). Tickets
+    are consumed once — matched at the first dispatched block >= the
+    ticket's block (None = the very next boundary); every controller of
+    a pod announces the same ticket from the same recipe, so all of
+    them grow at the same boundary to the same device set."""
+
+    _GUARDED_BY = guarded_by("_lock", "_tickets")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tickets: List[dict] = []
+
+    def announce(self, devices=None, n_devices: Optional[int] = None,
+                 block: Optional[int] = None) -> None:
+        if devices is None and n_devices is None:
+            raise ValueError(
+                "announce_join needs devices= (explicit joining device "
+                "objects) or n_devices= (target total, resolved against "
+                "jax.devices() at admit time)")
+        with self._lock:
+            self._tickets.append({
+                "devices": None if devices is None else list(devices),
+                "n_devices": None if n_devices is None else int(n_devices),
+                "block": None if block is None else int(block),
+            })
+
+    def take(self, block: int) -> Optional[dict]:
+        with self._lock:
+            for i, t in enumerate(self._tickets):
+                if t["block"] is None or block >= t["block"]:
+                    return self._tickets.pop(i)
+        return None
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._tickets)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._tickets.clear()
+
+
+_joins = _JoinRegistry()
+
+
+def announce_join(devices=None, n_devices: Optional[int] = None,
+                  block: Optional[int] = None) -> None:
+    """Announces devices/hosts wanting to JOIN the next elastic run's
+    mesh at a block boundary: either explicit device objects, or a
+    target total `n_devices` resolved against jax.devices() at admit
+    time (mesh.join_candidates). `block` defers the admit to the first
+    dispatched block >= block (None = the very next boundary). Only
+    drivers running under run_with_mesh_elasticity consume
+    announcements; plain and shrink-only-elastic runs ignore them."""
+    _joins.announce(devices=devices, n_devices=n_devices, block=block)
+
+
+def pending_joins() -> int:
+    """Announced join tickets not yet consumed by an elastic run."""
+    return _joins.pending()
+
+
+def clear_joins() -> None:
+    """Drops every pending join announcement (test isolation)."""
+    _joins.clear()
+
+
+# Growth is opt-in per DRIVER INVOCATION, not per process: only the
+# thread actively inside run_with_mesh_elasticity's run() treats a
+# pending join ticket as a grow signal. Thread-local depth counter —
+# cheap, and re-entrant in case an elastic driver composes another.
+_growth = threading.local()
+
+
+@contextlib.contextmanager
+def _growth_scope():
+    _growth.depth = getattr(_growth, "depth", 0) + 1
+    try:
+        yield
+    finally:
+        _growth.depth -= 1
+
+
+def maybe_grow(block: int = 0) -> None:
+    """Block-boundary hook (retry_call): raises MeshGrowthSignal when a
+    join announcement matches and the thread is inside an elasticity
+    scope. A no-op everywhere else — announcements never perturb runs
+    that did not opt into growing."""
+    if getattr(_growth, "depth", 0) <= 0:
+        return
+    ticket = _joins.take(block)
+    if ticket is None:
+        return
+    raise MeshGrowthSignal(devices=ticket["devices"],
+                           n_devices=ticket["n_devices"], block=block)
+
+
 def is_device_fatal(exc: BaseException) -> bool:
     """Whether the failure means a device dropped off the mesh.
 
@@ -123,6 +253,8 @@ def is_device_fatal(exc: BaseException) -> bool:
     a smaller mesh from the survivors (run_with_mesh_degradation) can
     make progress.
     """
+    if isinstance(exc, MeshGrowthSignal):
+        return False
     if isinstance(exc, faults.InjectedDeviceLossError):
         return True
     if isinstance(exc, faults.InjectedFault):
@@ -144,6 +276,8 @@ def is_oom(exc: BaseException) -> bool:
 
 def is_transient(exc: BaseException) -> bool:
     """Whether re-dispatching the same program can plausibly succeed."""
+    if isinstance(exc, MeshGrowthSignal):
+        return False
     if isinstance(exc,
                   (faults.InjectedDispatchError, faults.InjectedConsumeError,
                    faults.InjectedCollectiveError)):
@@ -212,6 +346,10 @@ def retry_call(fn: Callable,
     attempt = 0
     while True:
         try:
+            # Scale-UP poll first: a block boundary is the only safe
+            # point to grow (nothing of this block has dispatched yet,
+            # so the re-entered run re-derives its key unchanged).
+            maybe_grow(block)
             faults.maybe_fail("fatal", block)
             faults.maybe_fail("device_loss", block, point="dispatch")
             faults.maybe_fail("oom", block)
@@ -395,6 +533,96 @@ def run_with_mesh_degradation(run: Callable,
 
     Returns whatever run()/fallback() returns.
     """
+    return _elastic_loop(run, mesh, grow=False, fallback=fallback,
+                         min_devices=min_devices, job_id=job_id,
+                         journal=journal)
+
+
+def run_with_mesh_elasticity(run: Callable,
+                             mesh,
+                             *,
+                             fallback: Optional[Callable] = None,
+                             min_devices: int = 1,
+                             job_id: str = "",
+                             journal=None):
+    """run_with_mesh_degradation's full-fleet counterpart: the same
+    shrink-on-device-loss loop, PLUS elastic scale-UP.
+
+    While the driver runs, announce_join tickets (new hosts/devices
+    probed healthy and wanting in) are polled at every block boundary
+    (retry_call's maybe_grow hook). When one matches, the driver unwinds
+    via MeshGrowthSignal — draining in-flight blocks into the journal
+    exactly like the shrink path — the candidates are resolved
+    (mesh.join_candidates) and probed (mesh.probe_live_devices), and the
+    mesh rebuilds over the LARGER device set: current devices first, in
+    their existing order, admitted joiners appended. The re-entered run
+    replays journaled blocks and re-derives fold_in(final_key, b) keys
+    for the rest — geometry-independent, so the grown run's releases are
+    bit-identical to the fixed-geometry run's by construction.
+
+    A failed admit — an injected host_join_failure, a joiner failing its
+    liveness probe, or a current device dying mid-admit — ABORTS the
+    grow: the ticket is spent, the old mesh (still fully live) carries
+    on, and the job records the aborted REJOINING event. Growth never
+    wedges a healthy run.
+
+    Shrink behavior, floors, whole-host loss and HostEvacuatedError are
+    exactly run_with_mesh_degradation's.
+    """
+    return _elastic_loop(run, mesh, grow=True, fallback=fallback,
+                         min_devices=min_devices, job_id=job_id,
+                         journal=journal)
+
+
+def _admit_joiners(current, signal: MeshGrowthSignal, job_id: str):
+    """Resolves and probes a grow ticket's join candidates against the
+    CURRENT mesh. Returns the admitted device list (empty = abort the
+    grow). Any admit failure aborts rather than propagates: the old
+    mesh is still fully live, and the joiners were never part of any
+    dispatched program, so nothing needs recovery beyond dropping the
+    ticket."""
+    from pipelinedp_tpu.parallel import mesh as mesh_lib
+    joining = mesh_lib.join_candidates(current, devices=signal.devices,
+                                       n_devices=signal.n_devices)
+    if not joining:
+        return []
+    try:
+        # Fault-injection hook: a joining host dying exactly mid-admit.
+        faults.maybe_fail("host_join_failure", signal.block)
+        live = mesh_lib.probe_live_devices(
+            list(current.devices.flat) + list(joining))
+        live_ids = {getattr(d, "id", d) for d in live}
+        if any(getattr(d, "id", d) not in live_ids
+               for d in current.devices.flat):
+            raise RuntimeError(
+                "a device of the CURRENT mesh failed its liveness probe "
+                "mid-admit; growing onto a set containing it would wedge "
+                "the run")
+        return [d for d in joining if getattr(d, "id", d) in live_ids]
+    except Exception as e:  # noqa: BLE001 - any admit failure aborts the grow
+        logging.warning(
+            "elastic scale-UP for job %r aborted at block %d: %s: %s — "
+            "the join ticket is dropped and the run continues on the "
+            "old %d-device mesh (still fully live; the joiners never "
+            "carried any dispatched work).", job_id, signal.block,
+            type(e).__name__,
+            str(e).splitlines()[0][:160], int(current.devices.size))
+        return []
+
+
+def _elastic_loop(run: Callable,
+                  mesh,
+                  *,
+                  grow: bool,
+                  fallback: Optional[Callable] = None,
+                  min_devices: int = 1,
+                  job_id: str = "",
+                  journal=None):
+    """The shared elastic engine: shrink on device loss (always), grow
+    on join announcements (grow=True). Both directions re-enter run()
+    on a rebuilt mesh and rely on the same invariant — block keys are
+    geometry-independent, so every re-entry is a replay of the same
+    release, never a second one."""
     from pipelinedp_tpu.parallel import mesh as mesh_lib
 
     current = mesh
@@ -403,6 +631,9 @@ def run_with_mesh_degradation(run: Callable,
     health = health_lib.current()
     if health is not None:
         health.note_mesh(planned, planned)
+    if grow:
+        telemetry.set_gauge("mesh_target_devices", planned,
+                            job_id=job_id or None)
     while True:
         n_live = int(current.devices.size)
         try:
@@ -413,7 +644,41 @@ def run_with_mesh_degradation(run: Callable,
                     "(results are identical — block keys are independent "
                     "of mesh geometry).", job_id)
                 return fallback()
+            if grow:
+                with _growth_scope():
+                    return run(current)
             return run(current)
+        except MeshGrowthSignal as sig:
+            admitted = _admit_joiners(current, sig, job_id)
+            if not admitted:
+                if health is not None:
+                    health.note_fleet_event(
+                        "REJOINING",
+                        f"scale-UP aborted at block {sig.block}: join "
+                        f"candidates failed the admit; continuing on "
+                        f"{n_live} device(s)")
+                continue
+            current = mesh_lib.make_mesh(
+                devices=list(current.devices.flat) + list(admitted))
+            planned = int(current.devices.size)
+            telemetry.record("mesh_expansions", block=sig.block,
+                             devices=planned)
+            telemetry.set_gauge("mesh_target_devices", planned,
+                                job_id=job_id or None)
+            if health is not None:
+                health.note_mesh(planned, planned)
+                health.note_fleet_event(
+                    "REJOINING",
+                    f"admitted {len(admitted)} joining device(s) at "
+                    f"block {sig.block}; mesh grew {n_live} -> {planned}")
+            logging.warning(
+                "elastic scale-UP for job %r: admitted %d joining "
+                "device(s) at block boundary %d; rebuilding a %d-device "
+                "mesh and re-entering the driver — journaled blocks "
+                "replay, the rest re-derive the same fold_in(final_key, "
+                "b) keys, so the grown run is bit-identical to the "
+                "fixed-geometry run.", job_id, len(admitted), sig.block,
+                planned)
         except Exception as e:  # noqa: BLE001 - classified below
             if not is_device_fatal(e):
                 raise
@@ -456,6 +721,9 @@ def run_with_mesh_degradation(run: Callable,
                     f"blocks replay, the rest re-derive the same "
                     f"fold_in keys.") from e
             telemetry.record("mesh_degradations")
+            if grow:
+                telemetry.set_gauge("mesh_target_devices", target,
+                                    job_id=job_id or None)
             survivors = live[:target]
             me = mesh_lib.process_index()
             if (len(procs_before) > 1 and

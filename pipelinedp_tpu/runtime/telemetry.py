@@ -164,6 +164,18 @@ REGISTRY: Dict[str, Metric] = {
                  "(typed AdmissionRejectedError with retry-after; "
                  "tenant-budget refusals are NOT sheds and raise "
                  "TenantBudgetExceededError uncounted here)"),
+        _counter("mesh_expansions",
+                 "elastic mesh rebuilds onto MORE devices after admitting "
+                 "joining devices/hosts at a block boundary "
+                 "(run_with_mesh_elasticity scale-UP)"),
+        _counter("job_migrations",
+                 "jobs whose journal records were adopted into a new "
+                 "controller's scope (BlockJournal.adopt_job — the "
+                 "drain-and-migrate resume path)"),
+        _counter("rolling_restarts",
+                 "controller/service bounces performed under the rolling-"
+                 "restart discipline (each bounce reloads persisted "
+                 "ledgers and resumes journaled work)"),
         _gauge("pipeline_queue_depth",
                "encoded chunks currently staged between the host encode "
                "pool and the device accumulator (bounded by "
@@ -171,6 +183,10 @@ REGISTRY: Dict[str, Metric] = {
         _gauge("live_devices",
                "devices currently live in the elastic mesh of the "
                "gauge's job (== planned until a device loss shrinks it)"),
+        _gauge("mesh_target_devices",
+               "device count the elastic runtime currently targets for "
+               "the gauge's job (== planned at entry; grows on scale-UP "
+               "admissions, shrinks on degradations)"),
         _gauge("job_health_state",
                "numeric health state of a job (0 HEALTHY, 1 DEGRADED, "
                "2 STALLED, 3 FAILED — runtime/health.HealthState)"),

@@ -35,6 +35,7 @@ Accounting discipline (two-phase, mirroring the admission flow):
     reservation instead.
 """
 
+import logging
 import re
 import threading
 from typing import Any, Dict, List, Optional
@@ -194,7 +195,15 @@ class TenantLedger:
                records: List[Dict[str, Any]]) -> float:
         """Converts the reservation into ledger records (the job's
         ordered odometer trail) and persists the full trail. Returns
-        the job's recorded spend."""
+        the job's recorded spend.
+
+        IDEMPOTENT per job_id: a job the trail already contains is
+        never appended again — the existing spend is returned and the
+        reservation (if any) simply dropped. This is the no-double-
+        spend guard for fleet operations: a migrated job re-charging
+        its carried-over trail on the target pod, or a restarted
+        service replaying a completion whose persist DID land before
+        the kill, records each job exactly once."""
         stamped = []
         for r in records:
             row = dict(r)
@@ -202,11 +211,22 @@ class TenantLedger:
             stamped.append(row)
         with self._lock:
             self._reserved.pop(job_id, None)
-            base = len(self._records)
-            for i, row in enumerate(stamped):
-                row["seq"] = base + i
-            self._records.extend(stamped)
-            self._version += 1
+            if any(r.get("job_id") == job_id for r in self._records):
+                already = True
+            else:
+                already = False
+                base = len(self._records)
+                for i, row in enumerate(stamped):
+                    row["seq"] = base + i
+                self._records.extend(stamped)
+                self._version += 1
+        if already:
+            logging.info(
+                "tenant %r: job %r is already on the ledger trail — "
+                "charge is idempotent, returning the recorded spend "
+                "without appending (migrated/replayed completion).",
+                self.tenant_id, job_id)
+            return self.job_spent_epsilon(job_id)
         self._persist_latest()
         return self.job_spent_epsilon(job_id)
 

@@ -281,6 +281,10 @@ class DPAggregationService:
             than this is shed with a retry-after instead of running
             arbitrarily late (also the default retry-after for
             watermark sheds).
+        drain_timeout_s: how long drain() — the migration/rolling-
+            restart teardown — waits for RUNNING jobs to finish before
+            proceeding; queued jobs are cancelled for resubmission on
+            the successor either way.
         shed_watermark_fraction: submissions are shed while the live
             device-memory watermark exceeds this fraction of the
             memory limit.
@@ -315,6 +319,7 @@ class DPAggregationService:
                  max_concurrent_jobs: int = 2,
                  tenant_budget_epsilon: float = float("inf"),
                  queue_timeout_s: float = 30.0,
+                 drain_timeout_s: float = 30.0,
                  shed_watermark_fraction: float = 0.9,
                  memory_limit_bytes: Optional[int] = None,
                  batching: bool = False,
@@ -331,6 +336,8 @@ class DPAggregationService:
             tenant_budget_epsilon, "DPAggregationService")
         input_validators.validate_queue_timeout_s(
             queue_timeout_s, "DPAggregationService")
+        input_validators.validate_drain_timeout_s(
+            drain_timeout_s, "DPAggregationService")
         input_validators.validate_shed_watermark_fraction(
             shed_watermark_fraction, "DPAggregationService")
         input_validators.validate_batching(batching,
@@ -345,6 +352,7 @@ class DPAggregationService:
         self._max_concurrent_jobs = int(max_concurrent_jobs)
         self._tenant_budget_epsilon = float(tenant_budget_epsilon)
         self._queue_timeout_s = float(queue_timeout_s)
+        self._drain_timeout_s = float(drain_timeout_s)
         self._shed_watermark_fraction = float(shed_watermark_fraction)
         self._memory_limit_bytes = (None if memory_limit_bytes is None
                                     else int(memory_limit_bytes))
@@ -423,6 +431,50 @@ class DPAggregationService:
                     f"job {job.job_id!r} cancelled: service stopped "
                     f"before a worker picked it up"))
         self._set_queue_depth()
+
+    def drain(self) -> Dict[str, int]:
+        """Drains the service for a migration or rolling restart.
+
+        Intake stops, RUNNING jobs get drain_timeout_s to finish (their
+        charges persist to the ledger journal on completion, as every
+        charge does), and queued jobs that never ran are cancelled —
+        reservations released, handles failed with
+        AdmissionRejectedError — so the caller resubmits them on the
+        successor service. Nothing extra needs flushing: the tenant
+        ledger trails are already durable per charge, journaled block
+        results live in their own directory, and a successor constructed
+        over the same ledger_dir reloads exactly the spend this instance
+        recorded (TenantLedger reload + max_job_seq keep job ids from
+        colliding, and idempotent charges keep replays from double-
+        spending).
+
+        Returns counts: {"completed": jobs that finished DONE,
+        "cancelled": queued jobs cancelled for resubmission,
+        "failed": jobs that failed for any other reason,
+        "shed": submissions shed before the drain}.
+        """
+        self.stop(timeout_s=self._drain_timeout_s)
+        with self._lock:
+            handles = list(self._handles)
+        counts = {"completed": 0, "cancelled": 0, "failed": 0, "shed": 0}
+        for handle in handles:
+            status = handle.status
+            if status == JobStatus.DONE:
+                counts["completed"] += 1
+            elif status == JobStatus.SHED:
+                counts["shed"] += 1
+            elif status == JobStatus.FAILED:
+                error = handle.exception(timeout=0)
+                if isinstance(error, AdmissionRejectedError):
+                    counts["cancelled"] += 1
+                else:
+                    counts["failed"] += 1
+        logging.info(
+            "service drained for handover: %d completed, %d queued "
+            "job(s) cancelled for resubmission on the successor, %d "
+            "failed, %d shed.", counts["completed"], counts["cancelled"],
+            counts["failed"], counts["shed"])
+        return counts
 
     # -- tenant ledgers --------------------------------------------------
 

@@ -840,6 +840,12 @@ KNOB_VALIDATORS: Dict[str, str] = {
     "batching": "validate_batching",
     "batch_window_ms": "validate_batch_window_ms",
     "max_batch_jobs": "validate_max_batch_jobs",
+    # Fleet-operations knobs (PR 17): scale-UP admission and the
+    # service's drain window — an unvetted grow switch or drain
+    # timeout changes failure semantics (which jobs finish vs cancel
+    # during a rolling restart), so both go through the validators.
+    "elastic_grow": "validate_elastic_grow",
+    "drain_timeout_s": "validate_drain_timeout_s",
 }
 
 # Data-plane parameters: configuration, not failure semantics — adding

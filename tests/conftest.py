@@ -89,6 +89,11 @@ def pytest_configure(config):
         "launch: per-lane bit-identity vs solo, fallthrough/fallback "
         "paths, ledger reconciliation, launch-count collapse (tier-1, "
         "NOT slow; select alone with -m batching)")
+    config.addinivalue_line(
+        "markers",
+        "fleet: fleet operations — elastic scale-UP, journal-based "
+        "job migration, and the zero-loss rolling-restart drill "
+        "(tier-1, NOT slow; select alone with -m fleet)")
 
 
 @pytest.fixture(autouse=True)

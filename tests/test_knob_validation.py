@@ -135,6 +135,25 @@ class TestKnobRejection:
         with pytest.raises(ValueError, match="watchdog"):
             pipeline_backend.TPUBackend(watchdog=5.0)
 
+    def test_backend_rejects_bad_elastic_grow(self):
+        """The fleet-operations knobs ride the same discipline: a
+        non-bool scale-UP switch and a bad drain window both die at
+        the boundary."""
+        with pytest.raises(ValueError, match="elastic_grow"):
+            pipeline_backend.TPUBackend(elastic_grow="yes")
+        with pytest.raises(ValueError, match="elastic_grow"):
+            pipeline_backend.TPUBackend(elastic_grow=1)
+
+    def test_service_rejects_bad_drain_timeout(self):
+        from pipelinedp_tpu.service import DPAggregationService
+        backend = pipeline_backend.TPUBackend()
+        with pytest.raises(ValueError, match="drain_timeout_s"):
+            DPAggregationService(backend, drain_timeout_s=-1.0)
+        with pytest.raises(ValueError, match="drain_timeout_s"):
+            DPAggregationService(backend, drain_timeout_s=float("nan"))
+        with pytest.raises(ValueError, match="drain_timeout_s"):
+            DPAggregationService(backend, drain_timeout_s=True)
+
     def test_service_rejects_bad_knobs(self):
         """The DPAggregationService boundary is under the same
         discipline: every service knob maps to an invoked validator
@@ -178,6 +197,8 @@ class TestKnobRejection:
             sharded.sharded_select_partitions(*args, elastic=1)
         with pytest.raises(ValueError, match="min_devices"):
             sharded.sharded_select_partitions(*args, min_devices=-2)
+        with pytest.raises(ValueError, match="elastic_grow"):
+            sharded.sharded_select_partitions(*args, elastic_grow="on")
         with pytest.raises(ValueError, match="journal"):
             large_p.aggregate_blocked(np.zeros(4, np.int32),
                                       journal="/tmp/nope")

@@ -335,6 +335,10 @@ class TestBlockedAggregation:
                                        np.asarray(dense_out[name]),
                                        atol=(max_v - min_v) / 1e4)
 
+    # `slow`: ~23s scale exercise. Blocked-percentile correctness stays
+    # in tier-1 via test_percentile_blocked_matches_dense; this adds the
+    # P=10^7 bounded-memory regime on top.
+    @pytest.mark.slow
     def test_percentile_blocked_huge_p_bounded_memory(self):
         # P = 10^7 with rows concentrated in a few partitions: only
         # row-bearing blocks run; percentile values stay close to the true

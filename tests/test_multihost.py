@@ -469,6 +469,11 @@ class TestMultihostReceipt:
 
 class TestTwoProcessPod:
 
+    # `slow`: ~52s of pod spawn + 4-driver sweep. The identity pod gate
+    # still runs on every dryrun (__graft_entry__._dryrun_multihost_pod)
+    # and tier-1 keeps a real 2-process spawn via
+    # test_two_process_whole_host_loss (~16s).
+    @pytest.mark.slow
     @pytest.mark.hard_timeout(360)
     def test_two_process_bit_identity_all_four_drivers(self, tmp_path):
         """2 controllers x 2 CPU devices == 1 controller x 4 devices,
@@ -513,3 +518,67 @@ class TestTwoProcessPod:
         obs_msg = multihost.check_pod_observability(
             str(tmp_path), results, "host_loss")
         assert "host_losses" in obs_msg
+
+
+# ---------------------------------------------------------------------------
+# Fleet operations on the REAL 2-process pod (slow: each scenario is a
+# full jax.distributed spawn; the fast in-process siblings live in
+# tests/test_fleet.py and run in tier-1)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetPodScenarios:
+
+    @pytest.mark.slow
+    @pytest.mark.hard_timeout(360)
+    def test_two_process_elastic_grow(self, tmp_path):
+        """Scale-UP on the real pod: both controllers start on HALF
+        their devices, announce the rest as join candidates at block 2,
+        grow to the full mesh mid-run and finish bit-identically to the
+        full-geometry reference (journaled blocks replayed, zero
+        degradations)."""
+        results = multihost.spawn_local_pod("grow", str(tmp_path),
+                                            timeout_s=300)
+        reference = multihost.reference_host_loss_outputs()
+        msg = multihost.check_grow_results(results, reference)
+        assert "bit-identically" in msg
+
+    @pytest.mark.slow
+    @pytest.mark.hard_timeout(360)
+    def test_two_process_drain_and_migrate(self, tmp_path):
+        """Drain-and-migrate across pods: the 2-process pod's journaled
+        job is interrupted mid-run (both controllers persist their
+        odometer trails), then THIS process — a different pod at a
+        different geometry (8 devices) — adopts the records and resumes
+        bit-identically to an uninterrupted run."""
+        results = multihost.spawn_local_pod("migrate_source",
+                                            str(tmp_path), timeout_s=300)
+        journal_dir = str(tmp_path / "journal")
+        adopted, adopted_odo, resumed = multihost.run_migration_target(
+            journal_dir, n_devices=8)
+        reference = multihost.reference_host_loss_outputs()
+        msg = multihost.check_migration_results(
+            results, adopted, adopted_odo, resumed, reference)
+        assert "bit-identically" in msg
+
+    @pytest.mark.slow
+    @pytest.mark.hard_timeout(600)
+    def test_two_process_rolling_restart_drill(self, tmp_path):
+        """The pod rolling-restart drill: two full controller
+        generations over one shared ledger directory (a jax.distributed
+        world is fixed at init, so a bounced controller IS a respawned
+        process), generation 1 taking the scripted mid-persist kill on
+        p1. Gates: bit-identical traffic every generation, every
+        planned job charged exactly once on BOTH controller trails,
+        total spend bit-equal."""
+        state = tmp_path / "state"
+        out = tmp_path / "out"
+        state.mkdir()
+        out.mkdir()
+        all_results = multihost.run_pod_drill(str(state), str(out),
+                                              generations=2,
+                                              timeout_s=280)
+        reference = multihost.reference_drill_outputs()
+        msg = multihost.check_pod_drill_results(all_results, str(state),
+                                                reference)
+        assert "charged exactly once" in msg

@@ -503,6 +503,11 @@ class TestShardedBlockedLargeP:
             assert actual[pk].variance == pytest.approx(
                 expected[pk].variance, abs=0.05)
 
+    # `slow`: ~30s whole-path sweep. Exact-parity coverage stays in
+    # tier-1 via test_public_noise_free_exact_parity[1|8] and the
+    # single-device blocked parity tests; this adds the probabilistic-
+    # eps L0-not-binding regime on top.
+    @pytest.mark.slow
     def test_exact_parity_when_l0_not_binding(self):
         # Whole-path equivalence at probabilistic eps: when L0 sampling
         # never binds (the only per-shard randomness), per-partition
