@@ -741,6 +741,54 @@ def _bench_fleet(on_tpu):
         return {"fleet": {"error": f"{type(e).__name__}: {e}"}}
 
 
+def _bench_chaos(on_tpu):
+    """`chaos` receipt key: the chaos-campaign engine timed end to end.
+    A small seeded campaign (3 trials, intensity 0.6) runs composed
+    fault schedules through the service + journaled-driver workload
+    with the full invariant check per trial; the receipt reports the
+    wall time a trial costs, what fired, and the storage-seam counter
+    deltas. The correctness gates live in tier-1 (tests/test_chaos.py);
+    a receipt with invariants_hold=false flags the run loudly."""
+    import tempfile
+    import time
+
+    from pipelinedp_tpu.runtime import chaos as rt_chaos
+    from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+
+    try:
+        campaign = rt_chaos.ChaosCampaign(seed=3, trials=3,
+                                          intensity=0.6)
+        before = rt_telemetry.snapshot()
+        with tempfile.TemporaryDirectory() as tmp:
+            start = time.perf_counter()
+            report = rt_chaos.run_campaign(campaign, tmp)
+            chaos_s = time.perf_counter() - start
+        delta = rt_telemetry.delta(before)
+        return {"chaos": {
+            "campaign_seed": report["campaign_seed"],
+            "trials": report["trials"],
+            "intensity": report["intensity"],
+            "total_sec": round(chaos_s, 3),
+            "sec_per_trial": round(chaos_s / report["trials"], 3),
+            "total_firings": report["total_firings"],
+            "fired": report["fired"],
+            "bounces": report["bounces"],
+            "resubmissions": report["resubmissions"],
+            "storage_sheds": report["sheds"],
+            "jobs_completed": report["jobs_completed"],
+            "invariants_hold": report["invariants_hold"],
+            "counters": {
+                name: delta.get(name, 0)
+                for name in ("chaos_trials", "chaos_invariant_failures",
+                             "storage_disk_full",
+                             "storage_fsync_failures",
+                             "storage_io_errors", "storage_unavailable")
+            },
+        }}
+    except Exception as e:  # noqa: BLE001 - the receipt must survive chaos-bench breakage; tests/test_chaos.py owns failing on it
+        return {"chaos": {"error": f"{type(e).__name__}: {e}"}}
+
+
 def _bench_select_partitions(jax, on_tpu):
     """Standalone DP partition selection at P = 10^7 via the O(kept)
     blocked route (parallel/large_p.select_partitions_blocked): neither a
@@ -1409,6 +1457,10 @@ def main():
     # 2-wave rolling-restart drill (wall time + counter deltas). ---
     fleet_detail = _bench_fleet(on_tpu)
 
+    # --- Chaos campaign: composed-fault trials with the full invariant
+    # check (wall time per trial, what fired, storage-seam counters). ---
+    chaos_detail = _bench_chaos(on_tpu)
+
     # --- BASELINE configs 1-3 (LocalBackend ref, Gaussian+public,
     # compound combiner). ---
     baseline_detail = _bench_baseline_configs(jax, jnp, on_tpu)
@@ -1551,6 +1603,7 @@ def main():
                 **service_detail,
                 **megabatch_detail,
                 **fleet_detail,
+                **chaos_detail,
                 **baseline_detail,
                 "runtime_fault_counters": fault_counters,
                 "runtime_phase_timings": phase_timings,

@@ -370,6 +370,29 @@ def validate_drain_timeout_s(drain_timeout_s, obj_name: str) -> None:
             f"migration or rolling restart proceeds.")
 
 
+def validate_deadline_s(deadline_s, obj_name: str) -> None:
+    """Validates a job deadline: a positive finite number of seconds.
+
+    Raises:
+        ValueError: deadline_s is not a positive finite number (a
+        non-positive deadline would cancel every job at dequeue; an
+        infinite one is spelled deadline_s=None).
+    """
+    if (not isinstance(deadline_s, numbers.Number) or
+            isinstance(deadline_s, bool) or
+            math.isnan(deadline_s)):
+        raise ValueError(f"{obj_name}: deadline_s must be a number "
+                         f"of seconds, but {deadline_s!r} given.")
+    if deadline_s <= 0 or math.isinf(deadline_s):
+        raise ValueError(
+            f"{obj_name}: deadline_s must be positive and finite, but "
+            f"deadline_s={deadline_s} given — it bounds the job's total "
+            f"submit-to-finish wall time (queue wait included); a job "
+            f"past it settles CANCELLED with JobCancelledError, charges "
+            f"nothing and releases its reservation. Use deadline_s=None "
+            f"for no deadline.")
+
+
 def validate_shed_watermark_fraction(shed_watermark_fraction,
                                      obj_name: str) -> None:
     """Validates the load-shed memory threshold: a number in (0, 1].
@@ -550,3 +573,15 @@ def validate_retry_policy(retry, obj_name: str) -> None:
             raise ValueError(f"{obj_name}: retry.{field} must be a "
                              f"non-negative number of seconds, but "
                              f"{v!r} given.")
+    budget = getattr(retry, "max_total_retries", None)
+    if budget is not None and (
+            not isinstance(budget, numbers.Number) or
+            isinstance(budget, bool) or budget < 0 or
+            budget != int(budget)):
+        raise ValueError(
+            f"{obj_name}: retry.max_total_retries must be None (no "
+            f"per-job budget) or a non-negative integer, but "
+            f"{budget!r} given — it caps the job's TOTAL transient "
+            f"retries across every seam (dispatch retry, reshard "
+            f"fallback, host fetch), so composed faults cannot spiral "
+            f"one job into a retry storm.")

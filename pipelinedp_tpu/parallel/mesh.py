@@ -409,6 +409,10 @@ def host_fetch(arr, max_retries: Optional[int] = None) -> np.ndarray:
             except Exception as e:  # noqa: BLE001 - classified below
                 if not rt_retry.is_transient(e) or attempt >= max_retries:
                     raise
+                # Spend the job-wide retry budget (threaded by the entry
+                # wrapper): composed faults must not turn N cheap
+                # re-fetches per seam into an unbounded storm.
+                rt_retry.consume_retry_budget("host_fetch")
                 # Jittered bounded backoff: the exponential cap keeps the
                 # worst case at 1 s, the uniform scale decorrelates the
                 # lockstep retries of N hosts re-fetching the same table.

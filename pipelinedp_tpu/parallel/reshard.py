@@ -400,6 +400,10 @@ def stage_rows_to_mesh(mesh: Mesh, pid, pk, values, valid,
                     "needs every row addressable on one host, so the "
                     "failure propagates.", type(e).__name__)
                 raise
+            # The fallback is a transient-style recovery attempt and
+            # spends the job-wide retry budget (exhaustion raises typed
+            # instead of grinding through composed chaos faults).
+            rt_retry.consume_retry_budget("reshard host fallback")
             rt_telemetry.record("reshard_host_fallbacks")
             logging.warning(
                 "device collective reshard failed (%s: %s); gracefully "

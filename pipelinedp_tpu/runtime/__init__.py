@@ -39,6 +39,15 @@ semantics:
     turn over one durable ledger directory (one job killed between its
     ledger's fsync and rename) with zero lost jobs and every tenant's
     disk spend reconciling bit-exactly.
+  * chaos — randomized COMPOSED-fault campaigns over the same
+    machinery: a seeded stdlib RNG derives per-trial overlapping fault
+    schedules (replayable bit-exactly from (seed, trial) alone), each
+    trial runs the sustained service workload plus a journaled blocked
+    run under injection, a universal invariant checker asserts
+    exactly-once completion / bit-exact ledger reconciliation /
+    bit-identical results / counter consistency, and a delta-debugging
+    minimizer shrinks any failing schedule to a copy-pasteable
+    FaultSchedule literal.
   * watchdog — deadline/heartbeat monitoring of every block-stream step
     (dispatch, drain, collective reshard, control fetches): per-block
     deadlines (explicit timeout_s or a multiple of the pass-1 profiled
@@ -132,10 +141,11 @@ def __getattr__(name):
     # back through executor/combiners into this package — a module-level
     # import here would be circular. PEP 562 lazy attribute: the drill
     # loads on first access, after the package graph is complete.
-    if name == "drill":
+    if name in ("drill", "chaos"):
         import importlib
-        module = importlib.import_module("pipelinedp_tpu.runtime.drill")
-        globals()["drill"] = module
+        module = importlib.import_module(
+            f"pipelinedp_tpu.runtime.{name}")
+        globals()[name] = module
         return module
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")
@@ -157,6 +167,7 @@ __all__ = [
     "Watchdog",
     "announce_join",
     "aot",
+    "chaos",
     "clear_joins",
     "drill",
     "entry",

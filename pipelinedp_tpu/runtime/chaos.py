@@ -1,0 +1,915 @@
+"""Chaos campaigns: randomized composed-fault schedules with invariant
+checking and schedule minimization.
+
+The fault harness (runtime/faults.py) and the rolling-restart drill
+(runtime/drill.py) each prove ONE scripted adversity: a named fault at a
+named block, a kill in a named window. Real incidents are compositions —
+a slow block WHILE the disk is full, a worker OOM the same second an
+fsync fails — and nobody scripts those by hand. A chaos campaign samples
+them: a seeded stdlib RNG derives, per trial, a composed overlapping
+FaultSchedule over the full kind vocabulary, runs a sustained
+multi-tenant workload through DPAggregationService plus a journaled
+blocked driver run under that schedule, and asserts the UNIVERSAL
+invariants — the properties that must hold no matter which faults fired:
+
+  * every logical job completed exactly once, was shed, or failed with a
+    typed error — none lost, none duplicated, no worker wedged;
+  * every tenant's on-disk ledger trail reconciles BIT-EXACTLY with the
+    completed handles' spends and the odometer trails (zero epsilon
+    double-spend — the drill's audit, run cumulatively);
+  * deterministic jobs produce results bit-identical to their fault-free
+    baselines (a retry/resume is a replay of the same release, never a
+    second one);
+  * the telemetry counters are consistent with the faults that actually
+    fired (injected_faults == schedule firings consumed; every
+    StorageUnavailableError became exactly one storage shed; quarantines
+    are bounded by the corrupt/io_error firings).
+
+Determinism is the whole design: ChaosCampaign(seed).schedules_for(t)
+is a pure function of (seed, t) through a private ``random.Random`` —
+never the process-global RNG — so any trial replays bit-exactly from
+those two integers alone. When a trial DOES fail, minimize_schedule
+delta-debugs the schedule (drop faults, reduce times, widen blocks),
+re-running the invariant check per candidate, down to a locally-minimal
+reproducer emitted as a copy-pasteable ``faults.FaultSchedule([...])``
+literal plus the trial seed.
+
+Each trial runs two sub-phases, split by injection scope:
+
+  SERVICE PHASE (scope="process"): the drill's sustained submitter feeds
+  multi-tenant jobs to a DPAggregationService over the campaign's ONE
+  durable ledger directory. The schedule draws from the storage seams a
+  service must survive — disk_full / fsync_failure at the ledger's
+  odometer persist, and restart_during_persist in the fsync-to-rename
+  window. A fired restart bounces the service (the dead instance's
+  in-memory ledger diverged from disk, exactly like a real kill), and
+  the successor reloads only the durable truth. Process scope implies
+  max_concurrent_jobs=1 (faults._ProcessSchedule is single-consumer).
+
+  DRIVER PHASE (scope="thread"): a journaled blocked run absorbs the
+  composed kinds — dispatch/consume/oom/slow/hang/fatal/corrupt/
+  device_loss/collective/host_join_failure plus the storage kinds at the
+  block-record persist/read seams. Crash-class faults abort the pass and
+  the run re-enters over the same journal (a resume); a second pass over
+  a FRESH BlockJournal replays records from disk so read-path faults
+  (io_error, corrupt-record quarantine) get their shot; the final clean
+  run outside the injection scope must be bit-identical to the
+  fault-free baseline.
+
+Entry points:
+
+    campaign = chaos.ChaosCampaign(seed=7, trials=20, intensity=0.6)
+    report = chaos.run_campaign(campaign, base_dir)     # raises
+    chaos.ChaosInvariantError on the first violated invariant, with a
+    # copy-pasteable reproducer attached; otherwise returns the
+    # campaign receipt (fired-by-kind, resubmissions, bounces, spends).
+
+    minimized = chaos.minimize_trial(campaign, trial, base_dir)
+    print(minimized.literal)   # faults.FaultSchedule([...]) + seed
+"""
+
+import dataclasses
+import itertools
+import logging
+import os
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from pipelinedp_tpu import pipeline_backend
+from pipelinedp_tpu.runtime import drill as drill_lib
+from pipelinedp_tpu.runtime import faults
+from pipelinedp_tpu.runtime import journal as rt_journal
+from pipelinedp_tpu.runtime import retry as rt_retry
+from pipelinedp_tpu.runtime import telemetry
+from pipelinedp_tpu.runtime import watchdog as rt_watchdog
+from pipelinedp_tpu.service.service import (DPAggregationService, JobSpec,
+                                            JobStatus)
+
+
+class ChaosInvariantError(AssertionError):
+    """A universal invariant did not hold under an injected schedule.
+
+    Carries enough to replay: ``trial`` and ``campaign_seed`` (when the
+    failure surfaced through run_campaign), ``schedules`` (the
+    TrialSchedules that produced it) and ``reproducer`` (a
+    copy-pasteable ``faults.FaultSchedule([...])`` literal)."""
+
+    def __init__(self, message: str):
+        super().__init__(message)
+        self.trial: Optional[int] = None
+        self.campaign_seed: Optional[int] = None
+        self.schedules: Optional["TrialSchedules"] = None
+        self.reproducer: Optional[str] = None
+
+
+# The service phase's pool: the storage seams a resident service must
+# survive without losing a job or a spend record. corrupt/io_error are
+# deliberately NOT here — fired at the ledger trail they would
+# quarantine REAL spend records, i.e. inject data loss the invariants
+# correctly reject; the driver phase exercises them against block
+# records, where quarantine-and-redispatch is the designed recovery.
+SERVICE_POOL = ("disk_full", "fsync_failure", "restart_during_persist")
+
+# The driver phase's pool: every kind the blocked drivers' retry /
+# degradation / journal / quarantine machinery recovers from, including
+# the storage kinds at the block-record seams.
+DRIVER_POOL = ("dispatch", "consume", "oom", "slow", "hang", "fatal",
+               "corrupt", "device_loss", "collective",
+               "host_join_failure", "restart_during_persist",
+               "disk_full", "fsync_failure", "io_error")
+
+ALL_KINDS = tuple(sorted(set(SERVICE_POOL) | set(DRIVER_POOL)))
+
+# One blocked-run pass may legitimately end in any of these — each is a
+# TYPED, recoverable verdict the re-entry loop resumes past. Anything
+# else escaping the driver is an invariant violation (an untyped
+# failure), not adversity.
+_TYPED_DRIVER_ERRORS = (faults.InjectedFault,
+                        rt_watchdog.BlockTimeoutError,
+                        rt_journal.StorageUnavailableError,
+                        rt_retry.BlockOOMError,
+                        rt_retry.MeshDegradationError)
+
+# End-to-end ceiling on one service-phase attempt (mirrors the drill's
+# pacing handshake; generous — CPU attempts settle in seconds).
+_ATTEMPT_TIMEOUT_S = 120.0
+
+
+# ---------------------------------------------------------------------------
+# The campaign generator.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TrialSchedules:
+    """One trial's sampled fault schedules (immutable — FaultSchedules
+    are consumable, so the runner builds fresh ones from these)."""
+    trial: int
+    service: Tuple[faults.Fault, ...]
+    driver: Tuple[faults.Fault, ...]
+
+    def total_firings(self) -> int:
+        return sum(f.times for f in self.service + self.driver)
+
+
+class ChaosCampaign:
+    """A seeded family of composed-fault trials.
+
+    schedules_for(t) is a pure function of (seed, t): each trial seeds
+    its own private ``random.Random`` (stdlib string seeding is stable
+    across processes and platforms) — the process-global RNG is never
+    touched, so a campaign replays bit-exactly and any single trial
+    reconstructs from the two integers alone.
+
+    Args:
+        seed: the campaign seed (any int).
+        trials: how many trials the campaign runs.
+        intensity: (0, 1] — scales how many faults compose per trial
+            and how often a fault fires twice. 1.0 is the hostile end.
+        kinds: restrict sampling to these fault kinds (default: the
+            full vocabulary). Kinds outside a phase's pool are simply
+            never sampled for that phase.
+        n_blocks: the driver workload's block count — sampled block
+            indices stay in range so scheduled faults actually fire.
+    """
+
+    def __init__(self, seed: int, trials: int, intensity: float = 0.5,
+                 kinds: Sequence[str] = ALL_KINDS, n_blocks: int = 4):
+        if not isinstance(seed, int) or isinstance(seed, bool):
+            raise ValueError(f"ChaosCampaign: seed must be an int, got "
+                             f"{seed!r}")
+        if not isinstance(trials, int) or isinstance(trials, bool) or \
+                trials <= 0:
+            raise ValueError(f"ChaosCampaign: trials must be a positive "
+                             f"int, got {trials!r}")
+        if not 0.0 < float(intensity) <= 1.0:
+            raise ValueError(f"ChaosCampaign: intensity must be in "
+                             f"(0, 1], got {intensity!r}")
+        kinds = tuple(kinds)
+        unknown = sorted(set(kinds) - set(ALL_KINDS))
+        if unknown:
+            raise ValueError(f"ChaosCampaign: unknown fault kinds "
+                             f"{unknown}; known: {list(ALL_KINDS)}")
+        if not kinds:
+            raise ValueError("ChaosCampaign: kinds must be non-empty")
+        if not isinstance(n_blocks, int) or n_blocks <= 0:
+            raise ValueError(f"ChaosCampaign: n_blocks must be a "
+                             f"positive int, got {n_blocks!r}")
+        self.seed = seed
+        self.trials = trials
+        self.intensity = float(intensity)
+        self.kinds = kinds
+        self.n_blocks = n_blocks
+
+    def schedules_for(self, trial: int) -> TrialSchedules:
+        """The trial's composed schedules — bit-exact from (seed, trial)."""
+        if not 0 <= trial < self.trials:
+            raise ValueError(f"trial {trial} out of range "
+                             f"[0, {self.trials})")
+        rng = random.Random(f"chaos-campaign/{self.seed}/{trial}")
+        service: List[faults.Fault] = []
+        svc_pool = [k for k in SERVICE_POOL if k in self.kinds]
+        if svc_pool:
+            n = rng.randint(0, max(1, round(2 * self.intensity)))
+            for _ in range(n):
+                kind = rng.choice(svc_pool)
+                # times=2 on fsync_failure exhausts the one-rewrite
+                # discipline (a fail-closed shed); the other service
+                # kinds fire once per scheduled fault.
+                times = (2 if kind == "fsync_failure" and
+                         rng.random() < 0.5 * self.intensity else 1)
+                service.append(faults.Fault(kind, times=times,
+                                            point="odometer"))
+        driver: List[faults.Fault] = []
+        drv_pool = [k for k in DRIVER_POOL if k in self.kinds]
+        if drv_pool:
+            n = rng.randint(1, max(2, round(1 + 5 * self.intensity)))
+            for _ in range(n):
+                driver.append(self._driver_fault(rng.choice(drv_pool),
+                                                 rng))
+        return TrialSchedules(trial=trial, service=tuple(service),
+                              driver=tuple(driver))
+
+    def _driver_fault(self, kind: str, rng: random.Random) -> faults.Fault:
+        block: Optional[int] = (rng.randrange(self.n_blocks)
+                                if rng.random() < 0.7 else None)
+        # Capped at 2: the driver's FAST retry policy absorbs up to 3
+        # consecutive transient firings in-run; 2 leaves slack for
+        # composition with another transient at the same block.
+        times = 1 + int(rng.random() < 0.4 * self.intensity)
+        kwargs: Dict[str, Any] = {}
+        if kind == "slow":
+            kwargs["delay"] = round(rng.uniform(0.01, 0.05), 3)
+        elif kind == "hang":
+            # A small hard cap keeps chaos trials fast without a
+            # watchdog: the hook raises BlockTimeoutError (transient,
+            # retried in-run) when the cap elapses.
+            kwargs["delay"] = round(rng.uniform(0.05, 0.25), 3)
+            kwargs["point"] = rng.choice([None, "dispatch"])
+        elif kind == "corrupt":
+            kwargs["mode"] = rng.choice(["flip", "truncate"])
+        elif kind == "device_loss":
+            kwargs["point"] = rng.choice([None, "dispatch"])
+            times = 1
+        elif kind in ("fatal", "host_join_failure"):
+            times = 1
+        elif kind in faults.STORAGE_KINDS or \
+                kind == "restart_during_persist":
+            # Storage faults key on the persist/read target, not a
+            # block index (journal.put/get pass block=0).
+            kwargs["point"] = "block"
+            block = None
+        return faults.Fault(kind, block=block, times=times, **kwargs)
+
+    def __iter__(self):
+        for t in range(self.trials):
+            yield self.schedules_for(t)
+
+
+# ---------------------------------------------------------------------------
+# Reproducer literals.
+# ---------------------------------------------------------------------------
+
+_FAULT_DEFAULTS = {f.name: f.default for f in dataclasses.fields(faults.Fault)}
+
+
+def fault_literal(fault: faults.Fault) -> str:
+    """``faults.Fault(...)`` source with non-default fields only."""
+    args = [repr(fault.kind)]
+    for name in ("block", "times", "delay", "point", "mode", "device",
+                 "process"):
+        value = getattr(fault, name)
+        if value != _FAULT_DEFAULTS[name]:
+            args.append(f"{name}={value!r}")
+    return f"faults.Fault({', '.join(args)})"
+
+
+def schedule_literal(schedule_faults: Sequence[faults.Fault]) -> str:
+    """A runnable ``faults.FaultSchedule([...])`` literal."""
+    if not schedule_faults:
+        return "faults.FaultSchedule([])"
+    body = ",\n    ".join(fault_literal(f) for f in schedule_faults)
+    return f"faults.FaultSchedule([\n    {body},\n])"
+
+
+def reproducer(campaign_seed: Optional[int],
+               schedules: TrialSchedules) -> str:
+    """The copy-pasteable replay recipe of one trial's schedules."""
+    lines = [f"# chaos trial {schedules.trial}" +
+             (f" of ChaosCampaign(seed={campaign_seed})  — replay: "
+              f"ChaosCampaign(seed={campaign_seed}, trials="
+              f"{schedules.trial + 1}).schedules_for({schedules.trial})"
+              if campaign_seed is not None else ""),
+             "service_schedule = " + schedule_literal(schedules.service),
+             "driver_schedule = " + schedule_literal(schedules.driver)]
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# The workload.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ChaosWorkload:
+    """What a trial runs: fresh multi-tenant logical jobs for the
+    service phase, and a journaled blocked run for the driver phase.
+
+    jobs: () -> fresh LogicalJobs (fixed noise seeds in the specs, so
+        every trial's completions are bit-comparable to the baseline).
+    driver: (journal | None) -> the blocked run's result. Must be a
+        pure replay under a fixed key: same result whatever subset of
+        blocks the journal already holds.
+    service_kwargs: extra DPAggregationService kwargs (tenant budgets
+        etc.); max_concurrent_jobs is forced to 1 by the runner.
+    """
+    jobs: Callable[[], List[drill_lib.LogicalJob]]
+    driver: Callable[[Optional[rt_journal.BlockJournal]], Any]
+    service_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+def default_workload(meshed: bool = False,
+                     n_devices: int = 8) -> ChaosWorkload:
+    """The stock chaos workload: 3 tiny jobs across 2 tenants for the
+    service phase, and a 4-block COUNT+SUM private-selection aggregation
+    (P=256, block_partitions=64) for the driver phase — unsharded by
+    default; ``meshed=True`` runs it sharded over an n_devices mesh with
+    elastic=True so device_loss/collective faults exercise the mesh
+    machinery instead of plain crash-retry."""
+    import pipelinedp_tpu as pdp
+
+    def jobs() -> List[drill_lib.LogicalJob]:
+        params = pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+            max_partitions_contributed=2,
+            max_contributions_per_partition=3,
+            min_value=0.0, max_value=5.0)
+        ext = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                                 partition_extractor=lambda r: r[1],
+                                 value_extractor=lambda r: r[2])
+        rows_a = [("u1", "A", 1.0), ("u1", "B", 2.0), ("u2", "A", 1.0),
+                  ("u3", "B", 3.0)]
+        rows_b = [("v1", "X", 4.0), ("v2", "X", 2.0), ("v2", "Y", 2.0)]
+
+        def spec(seed, public):
+            return JobSpec(params=params, epsilon=1.0, delta=1e-6,
+                           data_extractors=ext, noise_seed=seed,
+                           public_partitions=public)
+
+        return [
+            drill_lib.LogicalJob("acme-j1", "acme", spec(11, ["A", "B"]),
+                                 rows_a),
+            drill_lib.LogicalJob("acme-j2", "acme", spec(13, ["A", "B"]),
+                                 rows_a),
+            drill_lib.LogicalJob("beta-j1", "beta", spec(17, ["X", "Y"]),
+                                 rows_b),
+        ]
+
+    state: Dict[str, Any] = {}
+
+    def driver(journal: Optional[rt_journal.BlockJournal]) -> Any:  # staticcheck: disable=key-hygiene — fixed literal harness key: every faulted re-run, the journal replay and the fault-free baseline must derive from the same key for the bit-identity invariant; not a product release
+        if not state:
+            import jax
+            from pipelinedp_tpu import combiners, executor
+            from pipelinedp_tpu.aggregate_params import MechanismType
+            from pipelinedp_tpu.ops import selection_ops
+            from pipelinedp_tpu.parallel import large_p, make_mesh
+            P, l0, linf = 256, 4, 8
+            params = pdp.AggregateParams(
+                metrics=[pdp.Metrics.COUNT, pdp.Metrics.SUM],
+                noise_kind=pdp.NoiseKind.LAPLACE,
+                max_partitions_contributed=l0,
+                max_contributions_per_partition=linf,
+                min_value=0.0, max_value=5.0)
+            accountant = pdp.NaiveBudgetAccountant(total_epsilon=1.0,
+                                                   total_delta=1e-6)
+            compound = combiners.create_compound_combiner(params,
+                                                          accountant)
+            budget = accountant.request_budget(MechanismType.GENERIC)
+            accountant.compute_budgets()
+            selection = selection_ops.selection_params_from_host(
+                params.partition_selection_strategy, budget.eps,
+                budget.delta, l0, None)
+            cfg = executor.make_kernel_config(
+                params, compound, P, private_selection=True,
+                selection_params=selection)
+            stds = np.asarray(executor.compute_noise_stds(compound,
+                                                          params))
+            rng = np.random.default_rng(7)
+            n, n_ids = 2000, 200
+            state.update(
+                large_p=large_p, P=P, cfg=cfg, stds=stds,
+                scalars=executor.kernel_scalars(params),
+                key=jax.random.PRNGKey(23),
+                pid=rng.integers(0, n_ids, n).astype(np.int32),
+                pk=rng.integers(0, P, n).astype(np.int32),
+                values=rng.uniform(0, 5, n),
+                valid=np.ones(n, bool),
+                retry=rt_retry.RetryPolicy(max_retries=3, base_delay=0.0,
+                                           max_delay=0.0),
+                mesh=make_mesh(n_devices=n_devices) if meshed else None)
+        min_v, max_v, min_s, max_s, mid = state["scalars"]
+        common = dict(block_partitions=64, retry=state["retry"],
+                      journal=journal, job_id="chaos-driver")
+        if meshed:
+            return state["large_p"].aggregate_blocked_sharded(
+                state["mesh"], state["pid"], state["pk"],
+                state["values"], state["valid"], min_v, max_v, min_s,
+                max_s, mid, state["stds"], state["key"], state["cfg"],
+                elastic=True, **common)
+        return state["large_p"].aggregate_blocked(
+            state["pid"], state["pk"], state["values"], state["valid"],
+            min_v, max_v, min_s, max_s, mid, state["stds"], state["key"],
+            state["cfg"], **common)
+
+    return ChaosWorkload(jobs=jobs, driver=driver)
+
+
+# ---------------------------------------------------------------------------
+# The universal invariant checker.
+# ---------------------------------------------------------------------------
+
+
+def _bit_equal(a: Any, b: Any) -> bool:
+    """Recursive bit-exact equality over the result shapes the drivers
+    and the service return (dicts, lists/tuples, numpy arrays,
+    scalars). Float comparison is exact — a replay IS the same bits."""
+    if isinstance(a, dict) and isinstance(b, dict):
+        return set(a) == set(b) and all(_bit_equal(a[k], b[k]) for k in a)
+    if isinstance(a, (list, tuple)) and isinstance(b, (list, tuple)):
+        return len(a) == len(b) and all(
+            _bit_equal(x, y) for x, y in zip(a, b))
+    if isinstance(a, np.ndarray) or isinstance(b, np.ndarray):
+        a, b = np.asarray(a), np.asarray(b)
+        return (a.shape == b.shape and a.dtype == b.dtype and
+                np.array_equal(a, b, equal_nan=True))
+    return bool(a == b)
+
+
+def _require(condition: bool, message: str) -> None:
+    if not condition:
+        raise ChaosInvariantError(message)
+
+
+def _fired_by_kind(schedule_faults: Sequence[faults.Fault],
+                   schedule: faults.FaultSchedule) -> Dict[str, int]:
+    totals: Dict[str, int] = {}
+    for f in schedule_faults:
+        totals[f.kind] = totals.get(f.kind, 0) + f.times
+    return {kind: total - schedule.pending(kind)
+            for kind, total in totals.items()}
+
+
+def _mk_service(factory: Callable[[], Any], ledger_dir: str,
+                workload: ChaosWorkload) -> DPAggregationService:
+    extra = dict(workload.service_kwargs)
+    extra.pop("max_concurrent_jobs", None)
+    return DPAggregationService(factory(), ledger_dir,
+                                max_concurrent_jobs=1, **extra)
+
+
+def service_baseline(workload: ChaosWorkload,
+                     backend_factory: Callable[[], Any],
+                     scratch_dir: str) -> Dict[str, Dict[str, Any]]:
+    """Fault-free reference results of the workload's logical jobs —
+    what every trial's completions must reproduce bit-identically."""
+    service = _mk_service(backend_factory, scratch_dir, workload)
+    out: Dict[str, Dict[str, Any]] = {}
+    try:
+        for job in workload.jobs():
+            handle = service.submit(job.tenant_id, job.spec, job.rows)
+            handle.wait(_ATTEMPT_TIMEOUT_S)
+            _require(handle.status == JobStatus.DONE,
+                     f"baseline job {job.name!r} did not complete "
+                     f"fault-free: {handle.exception(timeout=0)!r}")
+            out[job.name] = {"result": handle.result(timeout=0),
+                             "spent_epsilon": handle.spent_epsilon}
+    finally:
+        service.drain()
+    return out
+
+
+def run_trial(schedules: TrialSchedules,
+              workload: ChaosWorkload,
+              backend_factory: Callable[[], Any],
+              ledger_dir: str,
+              trial_dir: str,
+              cumulative_completed: Optional[Dict[str, Dict[str,
+                                                            Any]]] = None,
+              svc_baseline: Optional[Dict[str, Dict[str, Any]]] = None,
+              drv_baseline: Any = None) -> Dict[str, Any]:
+    """Runs ONE trial under its schedules and checks every invariant.
+
+    ledger_dir persists ACROSS trials (the campaign's one durable
+    ledger); cumulative_completed carries every prior trial's completion
+    map so the disk audit reconciles the whole history, not just this
+    trial. Baselines are optional — without them the bit-identity gates
+    are skipped (the exactly-once / reconciliation / counter gates still
+    run). Raises ChaosInvariantError; returns the trial report.
+    """
+    telemetry.record("chaos_trials")
+    try:
+        return _run_trial(schedules, workload, backend_factory,
+                          ledger_dir, trial_dir, cumulative_completed,
+                          svc_baseline, drv_baseline)
+    except ChaosInvariantError:
+        telemetry.record("chaos_invariant_failures")
+        raise
+
+
+def _run_trial(schedules, workload, backend_factory, ledger_dir,
+               trial_dir, cumulative_completed, svc_baseline,
+               drv_baseline) -> Dict[str, Any]:
+    trial = schedules.trial
+    os.makedirs(trial_dir, exist_ok=True)
+    completed_so_far = (cumulative_completed if cumulative_completed
+                        is not None else {})
+
+    # ---- service phase (scope="process") -------------------------------
+    jobs = [dataclasses.replace(j, name=f"t{trial}.{j.name}")
+            for j in workload.jobs()]
+    names = [j.name for j in jobs]
+    before = telemetry.snapshot()
+    svc_sched = faults.FaultSchedule(list(schedules.service))
+    total_service = sum(f.times for f in schedules.service)
+    submitter = drill_lib.Submitter(jobs)
+    service: Optional[DPAggregationService] = None
+    bounces = 0
+    try:
+        service = _mk_service(backend_factory, ledger_dir, workload)
+        submitter.point_at(service)
+        attempts, cap = 0, len(jobs) + 2 * total_service + 8
+        with faults.inject(svc_sched, scope="process"):
+            while submitter.pending_jobs() > 0:
+                attempts += 1
+                _require(
+                    attempts <= cap,
+                    f"trial {trial}: service phase livelocked — "
+                    f"{attempts} attempts for {len(jobs)} jobs under "
+                    f"{total_service} scheduled firing(s); a job is "
+                    f"being shed/killed without ever landing.")
+                injected = submitter.report()["injected_failures"]
+                submitter.run_one_attempt()
+                if submitter.report()["injected_failures"] > injected:
+                    # A mid-persist kill fired: the instance's in-memory
+                    # ledger now claims records the disk never saw.
+                    # Bounce it — the successor reloads durable truth.
+                    submitter.point_at(None)
+                    service.drain()
+                    bounces += 1
+                    service = _mk_service(backend_factory, ledger_dir,
+                                          workload)
+                    submitter.point_at(service)
+        submitter.point_at(None)
+        drain_counts = service.drain()
+        service = None
+    except drill_lib.DrillFailure as e:
+        raise ChaosInvariantError(
+            f"trial {trial}: service phase wedged — {e}") from e
+    finally:
+        if service is not None:
+            submitter.point_at(None)
+            try:
+                service.drain()
+            except Exception:  # noqa: BLE001 - teardown after a failed phase must not mask the invariant error
+                logging.exception("chaos: teardown drain failed")
+        joined = submitter.shutdown()
+    _require(joined, f"trial {trial}: the submitter thread never "
+                     f"joined — a wedged worker survived the phase.")
+    sreport = submitter.report()
+    svc_delta = telemetry.delta(before)
+    svc_fired = _fired_by_kind(schedules.service, svc_sched)
+
+    missing = sorted(set(names) - set(sreport["completed"]))
+    _require(not missing,
+             f"trial {trial}: jobs lost — {missing} never completed "
+             f"(every job must complete, shed, or fail typed; a shed "
+             f"or typed failure is resubmitted until it lands).")
+    _require(not sreport["unexpected_failures"],
+             f"trial {trial}: untyped job failures: "
+             + "; ".join(sreport["unexpected_failures"]))
+    _require(
+        sreport["injected_failures"] ==
+        svc_fired.get("restart_during_persist", 0),
+        f"trial {trial}: {sreport['injected_failures']} injected-restart "
+        f"job deaths but "
+        f"{svc_fired.get('restart_during_persist', 0)} restart "
+        f"firing(s) consumed — a kill was double-counted or lost.")
+    fired_service_total = sum(svc_fired.values())
+    _require(
+        svc_delta.get("injected_faults", 0) == fired_service_total,
+        f"trial {trial}: injected_faults counter moved by "
+        f"{svc_delta.get('injected_faults', 0)} but the service "
+        f"schedule consumed {fired_service_total} firing(s).")
+    _require(
+        svc_delta.get("storage_unavailable", 0) ==
+        svc_delta.get("service_jobs_shed", 0),
+        f"trial {trial}: {svc_delta.get('storage_unavailable', 0)} "
+        f"fail-closed persists but "
+        f"{svc_delta.get('service_jobs_shed', 0)} storage shed(s) — a "
+        f"sick store must shed exactly the job whose spend it refused.")
+
+    # Exactly-once + bit-exact reconciliation, over the WHOLE campaign's
+    # durable history: disk trails vs handles vs odometer sums.
+    for name in names:
+        _require(name not in completed_so_far,
+                 f"trial {trial}: job name {name!r} completed twice "
+                 f"across the campaign — duplicated completion.")
+        completed_so_far[name] = sreport["completed"][name]
+    try:
+        disk_spend = drill_lib.audit_disk(ledger_dir, completed_so_far)
+    except drill_lib.DrillFailure as e:
+        raise ChaosInvariantError(
+            f"trial {trial}: ledger reconciliation failed — {e}") from e
+
+    if svc_baseline is not None:
+        for job in jobs:
+            base_name = job.name.split(".", 1)[1]
+            done = sreport["completed"][job.name]
+            base = svc_baseline[base_name]
+            _require(
+                done["spent_epsilon"] == base["spent_epsilon"],
+                f"trial {trial}: job {job.name!r} spent "
+                f"{done['spent_epsilon']!r} but the fault-free baseline "
+                f"spent {base['spent_epsilon']!r} (must be bit-exact).")
+            _require(
+                _bit_equal(done["result"], base["result"]),
+                f"trial {trial}: job {job.name!r} result diverged from "
+                f"its fault-free baseline — a retry/resume redrew "
+                f"noise instead of replaying the same release.")
+
+    # ---- driver phase (scope="thread") ---------------------------------
+    mid = telemetry.snapshot()
+    drv_sched = faults.FaultSchedule(list(schedules.driver))
+    total_driver = sum(f.times for f in schedules.driver)
+    driver_dir = os.path.join(trial_dir, "driver")
+    typed_aborts: List[str] = []
+    with faults.inject(drv_sched):
+        # Two passes under the schedule: the first absorbs in-run faults
+        # (crash-class ones abort and re-enter over the same journal);
+        # the second opens a FRESH BlockJournal so records replay from
+        # DISK — the read seams (io_error, corrupt-record quarantine)
+        # only exist there.
+        for phase in ("run", "replay"):
+            journal = rt_journal.BlockJournal(driver_dir)
+            tries, cap = 0, total_driver + 3
+            while True:
+                tries += 1
+                _require(
+                    tries <= cap,
+                    f"trial {trial}: driver {phase} pass livelocked — "
+                    f"{tries} entries under {total_driver} scheduled "
+                    f"firing(s); the run is not converging past its "
+                    f"faults.")
+                try:
+                    workload.driver(journal)
+                    break
+                except _TYPED_DRIVER_ERRORS as e:
+                    typed_aborts.append(
+                        f"{phase}: {type(e).__name__}")
+                    continue
+                except Exception as e:  # noqa: BLE001 - ANY other escape is the invariant under test: failures must be typed
+                    raise ChaosInvariantError(
+                        f"trial {trial}: driver {phase} pass raised an "
+                        f"UNTYPED error under injection — "
+                        f"{type(e).__name__}: {e}") from e
+    # The clean run, outside the injection scope: resumes over the same
+    # journal directory and must reproduce the fault-free bits.
+    final = workload.driver(rt_journal.BlockJournal(driver_dir))
+    if drv_baseline is not None:
+        _require(
+            _bit_equal(final, drv_baseline),
+            f"trial {trial}: the driver run's final result diverged "
+            f"from the fault-free baseline — resume/replay is not "
+            f"bit-identical.")
+    drv_delta = telemetry.delta(mid)
+    drv_fired = _fired_by_kind(schedules.driver, drv_sched)
+    fired_driver_total = sum(drv_fired.values())
+    _require(
+        drv_delta.get("injected_faults", 0) == fired_driver_total,
+        f"trial {trial}: injected_faults counter moved by "
+        f"{drv_delta.get('injected_faults', 0)} in the driver phase but "
+        f"the schedule consumed {fired_driver_total} firing(s).")
+    _require(
+        drv_delta.get("journal_quarantined", 0) <=
+        drv_fired.get("corrupt", 0) + drv_fired.get("io_error", 0),
+        f"trial {trial}: {drv_delta.get('journal_quarantined', 0)} "
+        f"quarantine(s) but only {drv_fired.get('corrupt', 0)} corrupt "
+        f"+ {drv_fired.get('io_error', 0)} io_error firing(s) — "
+        f"healthy records are being quarantined.")
+
+    report = {
+        "trial": trial,
+        "service_faults": [fault_literal(f) for f in schedules.service],
+        "driver_faults": [fault_literal(f) for f in schedules.driver],
+        "fired": {**svc_fired,
+                  **{k: svc_fired.get(k, 0) + v
+                     for k, v in drv_fired.items()}},
+        "bounces": bounces,
+        "resubmissions": sreport["resubmissions"],
+        "sheds": svc_delta.get("service_jobs_shed", 0),
+        "typed_driver_aborts": typed_aborts,
+        "drain_counts": drain_counts,
+        "disk_spend_epsilon": disk_spend,
+    }
+    logging.info(
+        "chaos: trial %d survived %d firing(s) (%s); %d bounce(s), %d "
+        "resubmission(s), %d shed(s); invariants hold.", trial,
+        sum(report["fired"].values()), report["fired"], bounces,
+        sreport["resubmissions"], report["sheds"])
+    return report
+
+
+def run_campaign(campaign: ChaosCampaign,
+                 base_dir: str,
+                 *,
+                 workload: Optional[ChaosWorkload] = None,
+                 backend_factory: Optional[Callable[[], Any]] = None
+                 ) -> Dict[str, Any]:
+    """Runs every trial of the campaign and returns the receipt.
+
+    All trials share ONE durable ledger directory (base_dir/ledger) and
+    one cumulative completion map, so the reconciliation audit covers
+    the whole campaign history after every trial. On the first violated
+    invariant a ChaosInvariantError raises with .trial, .campaign_seed,
+    .schedules and a copy-pasteable .reproducer attached (also counted
+    in ``chaos_invariant_failures``).
+    """
+    workload = workload or default_workload()
+    factory = backend_factory or (lambda: pipeline_backend.TPUBackend())
+    ledger_dir = os.path.join(base_dir, "ledger")
+    svc_baseline = service_baseline(workload, factory,
+                                    os.path.join(base_dir, "baseline"))
+    drv_baseline = workload.driver(None)
+    completed: Dict[str, Dict[str, Any]] = {}
+    trial_reports: List[Dict[str, Any]] = []
+    fired: Dict[str, int] = {}
+    for schedules in campaign:
+        try:
+            rep = run_trial(
+                schedules, workload, factory, ledger_dir,
+                os.path.join(base_dir, f"trial{schedules.trial}"),
+                completed, svc_baseline, drv_baseline)
+        except ChaosInvariantError as e:
+            e.trial = schedules.trial
+            e.campaign_seed = campaign.seed
+            e.schedules = schedules
+            e.reproducer = reproducer(campaign.seed, schedules)
+            logging.error(
+                "chaos: trial %d of campaign seed %d violated an "
+                "invariant.\n%s", schedules.trial, campaign.seed,
+                e.reproducer)
+            raise
+        trial_reports.append(rep)
+        for kind, n in rep["fired"].items():
+            fired[kind] = fired.get(kind, 0) + n
+    report = {
+        "campaign_seed": campaign.seed,
+        "trials": campaign.trials,
+        "intensity": campaign.intensity,
+        "fired": fired,
+        "total_firings": sum(fired.values()),
+        "bounces": sum(r["bounces"] for r in trial_reports),
+        "resubmissions": sum(r["resubmissions"] for r in trial_reports),
+        "sheds": sum(r["sheds"] for r in trial_reports),
+        "jobs_completed": len(completed),
+        "invariants_hold": True,
+        "trial_reports": trial_reports,
+    }
+    logging.info(
+        "chaos: campaign seed %d — %d trial(s), %d firing(s) %s, %d "
+        "bounce(s), %d shed(s), %d job(s) landed exactly once; every "
+        "invariant holds.", campaign.seed, campaign.trials,
+        report["total_firings"], fired, report["bounces"],
+        report["sheds"], report["jobs_completed"])
+    return report
+
+
+# ---------------------------------------------------------------------------
+# The schedule minimizer.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MinimizedSchedule:
+    """A locally-minimal failing schedule and its replay recipe."""
+    service: Tuple[faults.Fault, ...]
+    driver: Tuple[faults.Fault, ...]
+    probes: int
+    literal: str
+
+
+def minimize_schedule(check: Callable[[Tuple[faults.Fault, ...],
+                                       Tuple[faults.Fault, ...]], bool],
+                      service_faults: Sequence[faults.Fault],
+                      driver_faults: Sequence[faults.Fault] = (),
+                      *,
+                      max_probes: int = 128) -> MinimizedSchedule:
+    """Delta-debugs a failing schedule to a locally-minimal reproducer.
+
+    ``check(service, driver) -> True`` iff the candidate STILL fails the
+    invariants (each call re-runs the full invariant check — greedy
+    first-improvement over three shrinking moves: drop a fault, reduce
+    its times to 1, widen its block to None). Stops at a schedule no
+    single move can shrink, or at max_probes checks. Raises ValueError
+    if the input schedule does not fail to begin with.
+    """
+    service = list(service_faults)
+    driver = list(driver_faults)
+    probes = 0
+
+    def still_fails(s: List[faults.Fault], d: List[faults.Fault]) -> bool:
+        nonlocal probes
+        probes += 1
+        return bool(check(tuple(s), tuple(d)))
+
+    if not still_fails(service, driver):
+        raise ValueError(
+            "minimize_schedule: the input schedule does not fail the "
+            "check — nothing to minimize")
+
+    def candidates():
+        # Simplest-first: dropping a fault beats weakening one.
+        for i in range(len(service)):
+            yield service[:i] + service[i + 1:], list(driver)
+        for j in range(len(driver)):
+            yield list(service), driver[:j] + driver[j + 1:]
+        for i, f in enumerate(service):
+            if f.times > 1:
+                yield (service[:i] +
+                       [dataclasses.replace(f, times=1)] +
+                       service[i + 1:]), list(driver)
+        for j, f in enumerate(driver):
+            if f.times > 1:
+                yield list(service), (driver[:j] +
+                                      [dataclasses.replace(f, times=1)] +
+                                      driver[j + 1:])
+            if f.block is not None:
+                yield list(service), (driver[:j] +
+                                      [dataclasses.replace(f,
+                                                           block=None)] +
+                                      driver[j + 1:])
+
+    while probes < max_probes:
+        for cand_s, cand_d in candidates():
+            if probes >= max_probes:
+                break
+            if still_fails(cand_s, cand_d):
+                service, driver = cand_s, cand_d
+                break  # restart the moves on the smaller schedule
+        else:
+            break  # no single move shrinks it: locally minimal
+    literal = ("# minimal chaos reproducer (%d probe(s))\n"
+               "service_schedule = %s\n"
+               "driver_schedule = %s"
+               % (probes, schedule_literal(service),
+                  schedule_literal(driver)))
+    logging.info("chaos: minimized schedule to %d service + %d driver "
+                 "fault(s) in %d probe(s).\n%s", len(service),
+                 len(driver), probes, literal)
+    return MinimizedSchedule(service=tuple(service),
+                             driver=tuple(driver), probes=probes,
+                             literal=literal)
+
+
+def minimize_trial(campaign: ChaosCampaign,
+                   trial: int,
+                   base_dir: str,
+                   *,
+                   workload: Optional[ChaosWorkload] = None,
+                   backend_factory: Optional[Callable[[], Any]] = None,
+                   max_probes: int = 24) -> MinimizedSchedule:
+    """Minimizes a failing trial of this campaign: every candidate
+    re-runs the FULL invariant check on a fresh ledger/journal directory
+    (probe runs never pollute the campaign's durable state). The
+    returned literal includes the (seed, trial) replay recipe."""
+    workload = workload or default_workload()
+    factory = backend_factory or (lambda: pipeline_backend.TPUBackend())
+    schedules = campaign.schedules_for(trial)
+    svc_baseline = service_baseline(
+        workload, factory, os.path.join(base_dir, "minimize-baseline"))
+    drv_baseline = workload.driver(None)
+    probe_ids = itertools.count()
+
+    def check(service, driver) -> bool:
+        probe_dir = os.path.join(base_dir,
+                                 f"minimize-probe{next(probe_ids)}")
+        try:
+            run_trial(TrialSchedules(trial, tuple(service),
+                                     tuple(driver)),
+                      workload, factory,
+                      os.path.join(probe_dir, "ledger"), probe_dir,
+                      None, svc_baseline, drv_baseline)
+        except ChaosInvariantError:
+            return True
+        return False
+
+    minimized = minimize_schedule(check, schedules.service,
+                                  schedules.driver,
+                                  max_probes=max_probes)
+    return dataclasses.replace(
+        minimized,
+        literal=(f"# campaign seed {campaign.seed}, trial {trial}\n" +
+                 minimized.literal))

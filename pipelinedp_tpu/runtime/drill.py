@@ -127,10 +127,14 @@ class _Submitter:
     def pending_jobs(self) -> int:
         return self._pending.qsize()
 
-    def shutdown(self) -> None:
+    def shutdown(self) -> bool:
+        """Stops the submit loop. Returns True when the thread joined —
+        False means a wedged submitter survived its workload, which the
+        chaos invariant checker treats as a failed trial."""
         self._stop.set()
         self._permits.release()
         self._thread.join(timeout=30.0)
+        return not self._thread.is_alive()
 
     def report(self) -> Dict[str, Any]:
         with self._lock:
@@ -252,6 +256,13 @@ def _audit_disk(ledger_dir: str,
                 f"tenant {tenant_id!r}: duplicate seq numbers in the "
                 f"disk trail — a record was charged twice.")
     return disk_spend
+
+
+# Public names for the chaos engine (runtime/chaos.py): the sustained
+# permit-paced submitter and the disk reconciliation audit are the
+# invariant checker's building blocks, not drill-private machinery.
+Submitter = _Submitter
+audit_disk = _audit_disk
 
 
 def rolling_restart_drill(

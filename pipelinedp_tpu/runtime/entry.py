@@ -118,6 +118,12 @@ def runtime_entry(kind: str, fallback: Optional[Callable] = None):
             from pipelinedp_tpu.parallel import mesh as mesh_lib
             fetch_retries = getattr(kwargs.get("retry"), "max_retries",
                                     None)
+            # The job-wide transient-retry budget (None = uncapped):
+            # scoped here so every retry seam the run passes through —
+            # dispatch retry, reshard host fallback, host fetch — draws
+            # from ONE per-job pool.
+            total_retries = getattr(kwargs.get("retry"),
+                                    "max_total_retries", None)
             span_attrs = {"job": job}
             if meshed and not mesh_lib.is_fully_addressable(args[0]):
                 # Multi-controller mesh: per-process coordination. The
@@ -140,6 +146,7 @@ def runtime_entry(kind: str, fallback: Optional[Callable] = None):
             t0 = time.perf_counter()
             with rt_health.job_scope(job), rt_watchdog.activate(wd), \
                     mesh_lib.fetch_retry_scope(fetch_retries), \
+                    rt_retry.retry_budget_scope(total_retries), \
                     rt_trace.span(kind, **span_attrs):
                 if meshed and (elastic or elastic_grow):
                     # elastic_grow implies shrink tolerance: the full-

@@ -83,14 +83,40 @@ Fault kinds and the exception they raise:
                                       trail persists) | block (block
                                       records); None fires on whichever
                                       persist reaches it first.
+  disk_full   InjectedDiskFullError   ENOSPC on the journal's tmp-file
+                                      write: the store is out of space.
+                                      No rewrite can succeed, so
+                                      journal.put fails closed
+                                      immediately (StorageUnavailable-
+                                      Error) — the previous record, or
+                                      none, stays the durable truth.
+                                      `point`: odometer | block.
+  fsync_failure
+              InjectedFsyncError      os.fsync refused the journal's
+                                      tmp fd (EIO-class). Fsyncgate
+                                      discipline: the fd's page state
+                                      is unknown, so the tmp is
+                                      unlinked and rewritten ONCE on a
+                                      fresh fd; a second failure fails
+                                      closed. `point`: odometer | block.
+  io_error    InjectedIOError         EIO on a journal record READ: the
+                                      half-read record routes through
+                                      the quarantine path (never a
+                                      replay of a torn read) and the
+                                      block re-dispatches under the
+                                      same key. `point`: odometer |
+                                      block.
 
 Most schedules are thread-local (inject()); the rolling-restart drill
 injects with scope="process" so faults scheduled from the drill thread
-fire inside service worker threads' persist paths too.
+fire inside service worker threads' persist paths too. Chaos campaigns
+(runtime/chaos.py) sample composed schedules over this whole vocabulary
+from a seeded stdlib RNG and replay them bit-exactly.
 """
 
 import contextlib
 import dataclasses
+import errno as errno_lib
 import logging
 import os
 import threading
@@ -151,6 +177,44 @@ class InjectedRestartError(InjectedFault):
     exercises against the ledger persist path."""
 
 
+# The storage faults subclass OSError too so the journal's fail-closed
+# handler treats them exactly like the real kernel errors they model —
+# including errno classification (ENOSPC vs EIO). OSError's automatic
+# errno population only applies to direct two-argument OSError
+# construction, not to this diamond, so each class pins its errno
+# explicitly.
+
+
+class InjectedDiskFullError(InjectedFault, OSError):
+    """ENOSPC from the journal's tmp-file write: the disk is full. A
+    rewrite cannot succeed, so the persist fails closed immediately."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.errno = errno_lib.ENOSPC
+
+
+class InjectedFsyncError(InjectedFault, OSError):
+    """os.fsync failed on the journal's tmp fd. After a failed fsync the
+    fd's page-cache state is UNKNOWN (fsyncgate): the only sound move is
+    to unlink the tmp and rewrite once on a fresh fd, never to re-fsync
+    the same fd."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.errno = errno_lib.EIO
+
+
+class InjectedIOError(InjectedFault, OSError):
+    """EIO on a journal record read — a torn/unreadable sector. The
+    record must quarantine, never replay half-read bytes as released
+    truth."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.errno = errno_lib.EIO
+
+
 _RAISES = {
     "dispatch": InjectedDispatchError,
     "consume": InjectedConsumeError,
@@ -160,7 +224,14 @@ _RAISES = {
     "device_loss": InjectedDeviceLossError,
     "host_join_failure": InjectedHostJoinError,
     "restart_during_persist": InjectedRestartError,
+    "disk_full": InjectedDiskFullError,
+    "fsync_failure": InjectedFsyncError,
+    "io_error": InjectedIOError,
 }
+
+# Fault kinds that fire inside the journal/ledger storage seams; their
+# `point` vocabulary is the persist/read target, not a dispatch site.
+STORAGE_KINDS = ("disk_full", "fsync_failure", "io_error")
 
 
 @dataclasses.dataclass
@@ -171,10 +242,11 @@ class Fault:
     delay: seconds — the sleep of a "slow" fault, or the hard cap of a
         "hang" fault (0 = the 30 s default cap).
     point: "hang" (dispatch | drain | collective), "device_loss"
-        (dispatch | collective) and "restart_during_persist"
-        (odometer | block — which journal persist the kill targets)
-        only — restrict to one hook site; None fires at whichever site
-        reaches it first.
+        (dispatch | collective), "restart_during_persist" and the
+        storage kinds disk_full/fsync_failure/io_error (odometer |
+        block — which journal persist/read the fault targets) only —
+        restrict to one hook site; None fires at whichever site reaches
+        it first.
     mode: "corrupt" only — "flip" (default) flips one payload byte,
         "truncate" cuts the file in half.
     device: "device_loss" only — global jax device id of the lost chip.
@@ -202,6 +274,9 @@ class Fault:
         allowed_points = {
             "device_loss": ("dispatch", "collective"),
             "restart_during_persist": ("odometer", "block"),
+            "disk_full": ("odometer", "block"),
+            "fsync_failure": ("odometer", "block"),
+            "io_error": ("odometer", "block"),
         }.get(self.kind, ("dispatch", "drain", "collective"))
         if self.point is not None and self.point not in allowed_points:
             raise ValueError(f"unknown {self.kind} point {self.point!r}")
@@ -278,9 +353,12 @@ class FaultSchedule:
             return fault
         return None
 
-    def pending(self) -> int:
-        """Number of fault firings not yet consumed."""
-        return sum(left for _, left in self._remaining)
+    def pending(self, kind: Optional[str] = None) -> int:
+        """Number of fault firings not yet consumed (optionally of one
+        kind — the chaos invariant checker reconciles per-kind firing
+        counts against the telemetry deltas)."""
+        return sum(left for fault, left in self._remaining
+                   if kind is None or fault.kind == kind)
 
 
 _active = threading.local()

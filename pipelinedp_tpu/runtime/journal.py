@@ -47,6 +47,7 @@ meshed driver runs on a multi-controller mesh (scoped_to_process).
 """
 
 import dataclasses
+import errno as errno_lib
 import logging
 import os
 import re
@@ -76,6 +77,32 @@ _ODOMETER_KEY = "__odometer__"
 
 class JournalCorruptionError(RuntimeError):
     """A journal record failed its integrity check."""
+
+
+class StorageUnavailableError(OSError):
+    """The journal's backing store cannot durably persist a record.
+
+    Raised by put() after the fail-closed storage discipline is
+    exhausted: ENOSPC on the tmp write (no rewrite can succeed), or a
+    write/fsync failure that persisted through one fresh-fd rewrite.
+    The tmp file has been unlinked — the previous record, or none,
+    remains the durable truth, exactly as after a mid-persist crash.
+
+    Callers must treat this as "the store is sick right now", not as
+    data loss: the service converts it into a shed with retry_after_s
+    (reservation released, zero odometer records — see
+    TenantLedger.charge's rollback), never into a wedged worker or a
+    spend trail that memory claims and disk denies.
+    """
+
+
+# Fsyncgate discipline: after a failed fsync the fd's page-cache state
+# is UNKNOWN — dirty pages may have been dropped, so a second fsync on
+# the SAME fd can report success without the bytes ever reaching disk.
+# put() therefore never re-fsyncs a failed fd: it unlinks the tmp,
+# reopens a fresh fd and rewrites the full payload at most this many
+# times before failing closed with StorageUnavailableError.
+_STORAGE_REWRITES = 1
 
 
 @dataclasses.dataclass
@@ -314,32 +341,85 @@ class BlockJournal:
         # npz that poisons the resume. The span attributes the
         # fsync-bound journal-write time (a real cost of journaled runs)
         # on the trace timeline, with the payload byte volume.
+        from pipelinedp_tpu.runtime import faults as rt_faults
+        from pipelinedp_tpu.runtime import telemetry
         from pipelinedp_tpu.runtime import trace as rt_trace
+        point = "odometer" if str(key) == _ODOMETER_KEY else "block"
         with rt_trace.span(
                 "journal.put", key=str(key),
                 bytes=int(sum(np.asarray(a).nbytes
                               for a in payload.values()))):
-            fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp")
-            try:
-                with os.fdopen(fd, "wb") as f:
-                    np.savez(f, **payload)
-                    f.flush()
-                    os.fsync(f.fileno())
-                # Fault-injection hook: 'restart_during_persist' kills
-                # the writer in the window between durability (fsync)
-                # and nameability (rename) — the previous record, or
-                # none, stays the durable truth, exactly as a real
-                # mid-persist process death would leave it.
-                from pipelinedp_tpu.runtime import faults as rt_faults
-                rt_faults.maybe_fail(
-                    "restart_during_persist", 0,
-                    point=("odometer" if str(key) == _ODOMETER_KEY
-                           else "block"))
-                os.replace(tmp, self._path(job_id, key))
-            except BaseException:
-                if os.path.exists(tmp):
-                    os.unlink(tmp)
-                raise
+            rewrites = 0
+            while True:
+                fd, tmp = tempfile.mkstemp(dir=self._dir, suffix=".tmp")
+                stage = "write"
+                try:
+                    with os.fdopen(fd, "wb") as f:
+                        # Fault-injection hook: 'disk_full' — ENOSPC on
+                        # the tmp write.
+                        rt_faults.maybe_fail("disk_full", 0, point=point)
+                        np.savez(f, **payload)
+                        f.flush()
+                        stage = "fsync"
+                        # Fault-injection hook: 'fsync_failure' — the
+                        # kernel refused to make the tmp durable.
+                        rt_faults.maybe_fail("fsync_failure", 0,
+                                             point=point)
+                        os.fsync(f.fileno())
+                    # Fault-injection hook: 'restart_during_persist'
+                    # kills the writer in the window between durability
+                    # (fsync) and nameability (rename) — the previous
+                    # record, or none, stays the durable truth, exactly
+                    # as a real mid-persist process death would leave it.
+                    rt_faults.maybe_fail("restart_during_persist", 0,
+                                         point=point)
+                    stage = "rename"
+                    os.replace(tmp, self._path(job_id, key))
+                    break
+                except OSError as e:
+                    # Fail-closed storage discipline. The tmp is always
+                    # unlinked: after a failed write or fsync its
+                    # content is untrustworthy (fsyncgate — the page
+                    # cache may have silently dropped the dirty pages),
+                    # so it must never become nameable.
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+                    full = getattr(e, "errno", None) == errno_lib.ENOSPC
+                    if full:
+                        telemetry.record("storage_disk_full",
+                                         key=str(key))
+                    elif stage == "fsync":
+                        telemetry.record("storage_fsync_failures",
+                                         key=str(key))
+                    else:
+                        telemetry.record("storage_io_errors",
+                                         key=str(key))
+                    rewrites += 1
+                    if full or rewrites > _STORAGE_REWRITES:
+                        telemetry.record("storage_unavailable",
+                                         key=str(key))
+                        raise StorageUnavailableError(
+                            f"journal record {str(key)!r} for job "
+                            f"{job_id!r} could not be persisted "
+                            f"({type(e).__name__}: {e}); " +
+                            ("the disk is full (ENOSPC) — a rewrite "
+                             "cannot succeed"
+                             if full else
+                             f"{rewrites - 1} fresh-fd rewrite(s) were "
+                             f"attempted and the store stayed sick") +
+                            ". The tmp file was unlinked; the previous "
+                            "record (or none) remains the durable "
+                            "truth.") from e
+                    logging.warning(
+                        "journal: %s failed for record %r of job %r "
+                        "(%s); fsyncgate discipline — tmp unlinked, "
+                        "rewriting once on a fresh fd (never re-fsync "
+                        "the same fd: its page state is unknown).",
+                        stage, str(key), job_id, e)
+                except BaseException:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+                    raise
         # Fault-injection hook: 'corrupt' faults damage the record that
         # was just durably written (bit-flip / truncation between write
         # and replay — the integrity machinery's test case).
@@ -425,12 +505,26 @@ class BlockJournal:
         if not os.path.exists(path):
             return None
         try:
+            # Fault-injection hook: 'io_error' — EIO on the record read
+            # (a torn/unreadable sector). Routed through the quarantine
+            # below like every other unreadable record: never a replay
+            # of half-read bytes, the block re-dispatches under the
+            # same key.
+            from pipelinedp_tpu.runtime import faults as rt_faults
+            rt_faults.maybe_fail(
+                "io_error", 0,
+                point=("odometer" if str(key) == _ODOMETER_KEY
+                       else "block"))
             record = self._load_verified(path)
         except Exception as e:  # noqa: BLE001 - any load/verify failure
             # Truncated zip central directories raise zipfile/OSError,
             # flipped bytes raise JournalCorruptionError or ValueError
             # from within np.load — every one of them means the same
             # thing: this record cannot be trusted as released truth.
+            if isinstance(e, OSError) and \
+                    getattr(e, "errno", None) == errno_lib.EIO:
+                from pipelinedp_tpu.runtime import telemetry
+                telemetry.record("storage_io_errors", key=str(key))
             self._quarantine(job_id, key, path, e)
             return None
         with self._lock:

@@ -315,11 +315,23 @@ def is_timeout(exc: BaseException) -> bool:
 
 @dataclasses.dataclass(frozen=True)
 class RetryPolicy:
-    """Bounded exponential backoff: base * multiplier^attempt, capped."""
+    """Bounded exponential backoff: base * multiplier^attempt, capped.
+
+    max_retries bounds retries PER OPERATION (one block dispatch, one
+    host fetch); max_total_retries additionally caps the job's TOTAL
+    transient retries across every seam — dispatch retries, reshard
+    host-path fallbacks, host-fetch retries — so composed faults (a
+    chaos campaign's specialty) cannot spiral one job into an unbounded
+    retry storm of individually-within-budget retries. None disables
+    the job-wide cap. The budget is threaded through the entry wrapper
+    (retry_budget_scope) rather than stored here mutably: the policy
+    stays frozen and shareable across jobs.
+    """
     max_retries: int = 3
     base_delay: float = 0.05
     multiplier: float = 2.0
     max_delay: float = 2.0
+    max_total_retries: Optional[int] = None
 
     def delay(self, attempt: int) -> float:
         return min(self.base_delay * self.multiplier**attempt,
@@ -327,6 +339,60 @@ class RetryPolicy:
 
 
 DEFAULT_POLICY = RetryPolicy()
+
+
+class RetryBudgetExhaustedError(RuntimeError):
+    """The job's total transient-retry budget (RetryPolicy.
+    max_total_retries) is spent. NOT transient — is_transient never
+    matches it, so it propagates straight out of every retry loop and
+    fails the job with a typed error instead of letting composed faults
+    grind on. Recovery is a resume (journaled blocks replay; block keys
+    are fold_in(final_key, b), so the resumed run is a replay of the
+    same release)."""
+
+
+# Per-job retry-budget scope, threaded by runtime/entry.py from the
+# retry policy's max_total_retries. Thread-local like the fetch-retry
+# scope (parallel/mesh.fetch_retry_scope): the driver thread owns the
+# job, so its transient retries all decrement one counter.
+_budget = threading.local()
+
+
+@contextlib.contextmanager
+def retry_budget_scope(max_total_retries: Optional[int]):
+    """Scopes the job's total transient-retry budget onto this thread
+    (None = unlimited, the default). Nesting restores the outer budget
+    on exit."""
+    if max_total_retries is not None:
+        max_total_retries = int(max_total_retries)
+        if max_total_retries < 0:
+            raise ValueError(
+                f"retry_budget_scope: max_total_retries must be "
+                f"non-negative or None, got {max_total_retries}")
+    prev = getattr(_budget, "left", None)
+    _budget.left = max_total_retries
+    try:
+        yield
+    finally:
+        _budget.left = prev
+
+
+def consume_retry_budget(what: str = "operation") -> None:
+    """Decrements the job's total retry budget before a transient retry
+    is attempted; raises RetryBudgetExhaustedError when it hits zero.
+    Called at every transient-retry decision point (retry_call, the
+    reshard host fallback, host_fetch) — a no-op without a scope."""
+    left = getattr(_budget, "left", None)
+    if left is None:
+        return
+    if left <= 0:
+        telemetry.record("retry_budget_exhausted", what=what)
+        raise RetryBudgetExhaustedError(
+            f"retry budget exhausted: the job's max_total_retries cap "
+            f"is spent and {what} wants another transient retry. The "
+            f"job fails typed instead of retry-storming; resume replays "
+            f"journaled blocks under the same keys.")
+    _budget.left = left - 1
 
 
 def retry_call(fn: Callable,
@@ -365,6 +431,9 @@ def retry_call(fn: Callable,
         except Exception as e:  # noqa: BLE001 - classified below
             if not is_transient(e) or attempt >= policy.max_retries:
                 raise
+            # The job-wide budget is spent LAST, once this retry is
+            # otherwise certain: exhaustion raises typed from here.
+            consume_retry_budget(what)
             delay = policy.delay(attempt)
             attempt += 1
             if is_timeout(e):

@@ -176,6 +176,42 @@ REGISTRY: Dict[str, Metric] = {
                  "controller/service bounces performed under the rolling-"
                  "restart discipline (each bounce reloads persisted "
                  "ledgers and resumes journaled work)"),
+        _counter("service_jobs_cancelled",
+                 "jobs settled CANCELLED (JobHandle.cancel() or a "
+                 "deadline_s expiry): reservation released, nothing "
+                 "charged, result withheld at the service boundary "
+                 "(typed JobCancelledError)"),
+        _counter("storage_disk_full",
+                 "journal persists refused with ENOSPC (disk full): the "
+                 "tmp write failed closed — no rewrite attempted, the "
+                 "previous record stays the durable truth"),
+        _counter("storage_fsync_failures",
+                 "journal fsyncs the kernel refused: fsyncgate "
+                 "discipline unlinked the tmp and rewrote once on a "
+                 "fresh fd (never re-fsync a failed fd)"),
+        _counter("storage_io_errors",
+                 "EIO-class I/O failures at the journal's storage seams "
+                 "(record reads routed to quarantine, tmp writes that "
+                 "failed before fsync)"),
+        _counter("storage_unavailable",
+                 "journal persists that failed CLOSED after the storage "
+                 "discipline was exhausted (StorageUnavailableError: "
+                 "ENOSPC, or a rewrite that stayed sick) — each one "
+                 "surfaces as a typed shed, never a lost trail"),
+        _counter("retry_budget_exhausted",
+                 "jobs whose total transient-retry budget "
+                 "(RetryPolicy.max_total_retries) ran out: the next "
+                 "would-be retry raised RetryBudgetExhaustedError "
+                 "instead of spiralling into a retry storm"),
+        _counter("chaos_trials",
+                 "chaos-campaign trials executed (runtime/chaos.py: one "
+                 "seeded composed-fault schedule run under the full "
+                 "invariant suite per trial)"),
+        _counter("chaos_invariant_failures",
+                 "chaos trials that FAILED an invariant (lost/duplicated "
+                 "jobs, ledger mismatch, double-spend, nondeterminism, "
+                 "wedged threads, unexplained counters) — nonzero means "
+                 "a reproducer schedule was minimized and reported"),
         _gauge("pipeline_queue_depth",
                "encoded chunks currently staged between the host encode "
                "pool and the device accumulator (bounded by "

@@ -284,6 +284,27 @@ class Watchdog:
                     now - g.started, g.timeout_s)
             time.sleep(self.poll_interval_s)
 
+    def cancel_all(self, detail: str = "cancelled") -> int:
+        """Cancels every in-flight guard NOW (cooperative): marks each
+        guard expired and sets its cancel event, exactly as a deadline
+        expiry would — the guarded operation raises BlockTimeoutError at
+        its next cooperative point (the injected hang's poll loop, a
+        check() call, guard exit via raise_if_expired). The service's
+        JobHandle.cancel()/deadline path rides this to interrupt a
+        RUNNING job without preempting native calls. Returns the number
+        of guards cancelled."""
+        with self._lock:
+            guards = list(self._guards.values())
+        for g in guards:
+            g.expired = True
+            g.cancel.set()
+        if guards:
+            logging.info(
+                "watchdog: cancel_all (%s) cancelled %d in-flight "
+                "guard(s); each raises at its next cooperative point.",
+                detail, len(guards))
+        return len(guards)
+
     def close(self) -> None:
         self._closed = True
 

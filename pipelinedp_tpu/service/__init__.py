@@ -28,6 +28,7 @@ examples/service_demo.py.
 from pipelinedp_tpu.service.batching import BatchCoalescer
 from pipelinedp_tpu.service.errors import (
     AdmissionRejectedError,
+    JobCancelledError,
     TenantBudgetExceededError,
 )
 from pipelinedp_tpu.service.ledger import TenantLedger
@@ -42,6 +43,7 @@ __all__ = [
     "AdmissionRejectedError",
     "BatchCoalescer",
     "DPAggregationService",
+    "JobCancelledError",
     "JobHandle",
     "JobSpec",
     "JobStatus",

@@ -33,3 +33,18 @@ class TenantBudgetExceededError(AdmissionRejectedError):
 
     def __init__(self, message: str):
         super().__init__(message, retry_after_s=None)
+
+
+class JobCancelledError(RuntimeError):
+    """The job was cancelled (JobHandle.cancel()) or its ``deadline_s``
+    elapsed before completion.
+
+    A cancelled job charges NOTHING: its result is withheld at the
+    service boundary (never handed to the caller), so no release left
+    the process and returning the reservation is privacy-sound — even
+    when mechanisms had already registered. ``reason`` is "cancelled"
+    or "deadline"."""
+
+    def __init__(self, message: str, reason: str = "cancelled"):
+        super().__init__(message)
+        self.reason = reason

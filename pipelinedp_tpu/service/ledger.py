@@ -41,6 +41,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 from pipelinedp_tpu import input_validators
+from pipelinedp_tpu.runtime import journal as journal_lib
 from pipelinedp_tpu.runtime import observability
 from pipelinedp_tpu.runtime.concurrency import guarded_by
 from pipelinedp_tpu.service.errors import TenantBudgetExceededError
@@ -227,7 +228,26 @@ class TenantLedger:
                 "without appending (migrated/replayed completion).",
                 self.tenant_id, job_id)
             return self.job_spent_epsilon(job_id)
-        self._persist_latest()
+        try:
+            self._persist_latest()
+        except journal_lib.StorageUnavailableError:
+            # Fail-closed: the store refused the trail (ENOSPC, sick
+            # fsync). A spend memory claims but disk denies would
+            # resurrect on the next successful persist AND vanish on a
+            # restart — so the in-memory append rolls back and the
+            # charge fails. The caller (service) withholds the job's
+            # result and sheds; nothing was released, so not charging
+            # is privacy-sound.
+            with self._lock:
+                self._records = [r for r in self._records
+                                 if r.get("job_id") != job_id]
+                self._version += 1
+            logging.warning(
+                "tenant %r: job %r charge rolled back — the ledger "
+                "store cannot persist the trail right now; the job's "
+                "result is withheld and the reservation returns.",
+                self.tenant_id, job_id)
+            raise
         return self.job_spent_epsilon(job_id)
 
     def charge_forfeit(self, job_id: str, epsilon: float,

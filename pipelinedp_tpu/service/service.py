@@ -62,10 +62,13 @@ from pipelinedp_tpu.parallel import sharded
 from pipelinedp_tpu.runtime import health as rt_health
 from pipelinedp_tpu.runtime import observability as rt_observability
 from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+from pipelinedp_tpu.runtime import watchdog as rt_watchdog
 from pipelinedp_tpu.runtime.concurrency import guarded_by
 from pipelinedp_tpu.runtime.journal import BlockJournal
+from pipelinedp_tpu.runtime.journal import StorageUnavailableError
 from pipelinedp_tpu.service.batching import BatchCoalescer
 from pipelinedp_tpu.service.errors import AdmissionRejectedError
+from pipelinedp_tpu.service.errors import JobCancelledError
 from pipelinedp_tpu.service.ledger import TenantLedger
 
 
@@ -118,16 +121,25 @@ class JobStatus:
     DONE = "DONE"
     FAILED = "FAILED"
     SHED = "SHED"
+    CANCELLED = "CANCELLED"
 
 
 class JobHandle:
-    """Future-like handle of one submitted job."""
+    """Future-like handle of one submitted job.
+
+    deadline_s bounds the job's total submit-to-finish time (queue wait
+    included); cancel() requests cooperative cancellation. Either way
+    the job settles CANCELLED with a typed JobCancelledError, releases
+    its reservation and charges nothing — its result is withheld at the
+    service boundary, so no release ever left the process.
+    """
 
     _GUARDED_BY = guarded_by("_lock", "_status", "_result", "_error",
                              "_spent_epsilon", "_jit_cache_misses",
-                             "_started_at", "_finished_at")
+                             "_started_at", "_finished_at", "_watchdog")
 
-    def __init__(self, job_id: str, tenant_id: str, spec: JobSpec):
+    def __init__(self, job_id: str, tenant_id: str, spec: JobSpec,
+                 deadline_s: Optional[float] = None):
         self.job_id = job_id
         self.tenant_id = tenant_id
         self.spec = spec
@@ -141,6 +153,10 @@ class JobHandle:
         self._queued_at = time.monotonic()
         self._started_at: Optional[float] = None
         self._finished_at: Optional[float] = None
+        self._cancel = threading.Event()
+        self._deadline_at = (None if deadline_s is None
+                             else self._queued_at + float(deadline_s))
+        self._watchdog: Optional[rt_watchdog.Watchdog] = None
 
     # -- worker-side transitions ----------------------------------------
 
@@ -159,12 +175,48 @@ class JobHandle:
             self._finished_at = time.monotonic()
         self._done.set()
 
-    def _fail(self, error: BaseException, shed: bool = False) -> None:
+    def _fail(self, error: BaseException, shed: bool = False,
+              cancelled: bool = False) -> None:
         with self._lock:
-            self._status = JobStatus.SHED if shed else JobStatus.FAILED
+            self._status = (JobStatus.CANCELLED if cancelled else
+                            JobStatus.SHED if shed else JobStatus.FAILED)
             self._error = error
             self._finished_at = time.monotonic()
         self._done.set()
+
+    def _attach_watchdog(self,
+                         wd: "Optional[rt_watchdog.Watchdog]") -> None:
+        """Publishes the RUNNING job's per-job watchdog so cancel() can
+        interrupt in-flight guarded operations (None detaches it when
+        the run leaves the guarded region)."""
+        with self._lock:
+            self._watchdog = wd
+
+    def _deadline_exceeded(self) -> bool:
+        return (self._deadline_at is not None and
+                time.monotonic() > self._deadline_at)
+
+    # -- caller-side cancellation ----------------------------------------
+
+    @property
+    def cancel_requested(self) -> bool:
+        return self._cancel.is_set()
+
+    def cancel(self) -> bool:
+        """Requests cooperative cancellation; returns False when the job
+        already finished (nothing to cancel). A QUEUED job cancels at
+        dequeue; a RUNNING job's in-flight guarded operations are
+        cancelled through its watchdog token (deadline_s jobs always
+        carry one) and the job settles CANCELLED at the service's next
+        cooperative checkpoint — native calls are never preempted."""
+        if self._done.is_set():
+            return False
+        self._cancel.set()
+        with self._lock:
+            wd = self._watchdog
+        if wd is not None:
+            wd.cancel_all(detail=f"job {self.job_id} cancelled")
+        return True
 
     # -- caller-side queries ---------------------------------------------
 
@@ -449,7 +501,8 @@ class DPAggregationService:
         spending).
 
         Returns counts: {"completed": jobs that finished DONE,
-        "cancelled": queued jobs cancelled for resubmission,
+        "cancelled": queued jobs cancelled for resubmission (plus jobs
+        cancelled via JobHandle.cancel()/deadline_s),
         "failed": jobs that failed for any other reason,
         "shed": submissions shed before the drain}.
         """
@@ -463,6 +516,8 @@ class DPAggregationService:
                 counts["completed"] += 1
             elif status == JobStatus.SHED:
                 counts["shed"] += 1
+            elif status == JobStatus.CANCELLED:
+                counts["cancelled"] += 1
             elif status == JobStatus.FAILED:
                 error = handle.exception(timeout=0)
                 if isinstance(error, AdmissionRejectedError):
@@ -516,13 +571,19 @@ class DPAggregationService:
     # -- admission -------------------------------------------------------
 
     def submit(self, tenant_id: str, spec: JobSpec,
-               source: Any) -> JobHandle:
+               source: Any, *,
+               deadline_s: Optional[float] = None) -> JobHandle:
         """Admits one job for a tenant, or raises.
 
         Raises AdmissionRejectedError (with retry_after_s) when the
         memory watermark sheds the submission, TenantBudgetExceededError
         when the tenant's lifetime budget cannot cover spec.epsilon —
         both BEFORE any accountant or mechanism exists for the job.
+
+        deadline_s bounds the job's total submit-to-finish wall time
+        (queue wait included): a job past its deadline settles
+        CANCELLED with JobCancelledError — reservation released,
+        nothing charged, result withheld (see JobHandle.cancel).
         """
         input_validators.validate_job_id(tenant_id,
                                          "DPAggregationService.submit")
@@ -532,6 +593,9 @@ class DPAggregationService:
                 f"but {type(spec).__name__} given.")
         input_validators.validate_epsilon_delta(spec.epsilon, spec.delta,
                                                 "JobSpec")
+        if deadline_s is not None:
+            input_validators.validate_deadline_s(
+                deadline_s, "DPAggregationService.submit")
         with self._lock:
             stopped = self._stopped
         if stopped:
@@ -552,7 +616,8 @@ class DPAggregationService:
         # The admission grant: raises TenantBudgetExceededError while
         # the job still consists of nothing but this reservation.
         ledger.reserve(job_id, spec.epsilon)
-        handle = JobHandle(job_id, tenant_id, spec)
+        handle = JobHandle(job_id, tenant_id, spec,
+                           deadline_s=deadline_s)
         job = _Job(job_id=job_id, tenant_id=tenant_id, spec=spec,
                    source=source, ledger=ledger, handle=handle,
                    enqueued_at=time.monotonic())
@@ -630,6 +695,13 @@ class DPAggregationService:
                         retry_after_s=self._queue_timeout_s),
                     shed=True)
                 continue
+            if (job.handle.cancel_requested or
+                    job.handle._deadline_exceeded()):
+                # Cancelled (or past its deadline) while still queued:
+                # settle before anything runs — the cheapest possible
+                # cancellation, nothing to unwind.
+                self._settle_cancelled(job)
+                continue
             rt_telemetry.record("service_jobs_admitted")
             with self._lock:
                 self._active_jobs += 1
@@ -651,6 +723,58 @@ class DPAggregationService:
                     active = self._active_jobs
                 rt_telemetry.set_gauge("service_active_jobs", active,
                                        job_id=None)
+
+    def _settle_cancelled(self, job: _Job,
+                          accountant: Any = None) -> None:
+        """Settles a cancelled / deadline-exceeded job: reservation
+        released, NOTHING charged, result withheld. Privacy-sound even
+        after mechanisms registered, because the result never crosses
+        the service boundary — handle.result() raises, so no noised
+        value this job computed is ever released to the caller."""
+        reason = ("cancelled" if job.handle.cancel_requested
+                  else "deadline")
+        job.ledger.release(job.job_id)
+        if accountant is not None:
+            rt_observability.prune_odometer(accountant=accountant)
+        rt_telemetry.record("service_jobs_cancelled")
+        job.handle._fail(
+            JobCancelledError(
+                f"job {job.job_id!r} {reason} "
+                f"({'JobHandle.cancel() requested' if reason == 'cancelled' else 'deadline_s elapsed before completion'}); "
+                f"nothing was charged — the result was withheld at the "
+                f"service boundary and the reservation returned to the "
+                f"tenant's budget.", reason=reason),
+            cancelled=True)
+        logging.info("service: job %s for tenant %s %s; reservation "
+                     "released, nothing charged.", job.job_id,
+                     job.tenant_id, reason)
+
+    def _storage_shed(self, job: _Job, accountant: Any,
+                      error: BaseException) -> None:
+        """Fail-closed storage shed: the job's spend could not be made
+        durable (StorageUnavailableError survived the journal's rewrite
+        discipline), so the result is withheld, the reservation returns
+        and the tenant retries after the store recovers. Zero odometer
+        records remain for the job — TenantLedger.charge rolled back
+        its in-memory append, so memory and disk agree that this job
+        never charged."""
+        job.ledger.release(job.job_id)
+        if accountant is not None:
+            rt_observability.prune_odometer(accountant=accountant)
+        rt_telemetry.record("service_jobs_shed")
+        job.handle._fail(
+            AdmissionRejectedError(
+                f"job {job.job_id!r} shed: the ledger store cannot "
+                f"persist its spend ({type(error).__name__}: "
+                f"{(str(error).splitlines() or [''])[0][:200]}); the "
+                f"result was withheld and nothing was charged — retry "
+                f"after {self._queue_timeout_s}s.",
+                retry_after_s=self._queue_timeout_s),
+            shed=True)
+        logging.warning(
+            "service: job %s for tenant %s shed — ledger store "
+            "unavailable; result withheld, reservation released.",
+            job.job_id, job.tenant_id)
 
     def _run_job(self, job: _Job) -> None:
         """Runs one admitted job on this worker thread, inside its own
@@ -674,8 +798,19 @@ class DPAggregationService:
         intercept = (executor.launch_interceptor(self._coalescer.offer)
                      if self._coalescer is not None
                      else contextlib.nullcontext())
+        # A deadline_s job runs under its own per-job watchdog whose
+        # deadline is the time the job has LEFT: expiry (or an explicit
+        # cancel()) cancels in-flight guarded operations cooperatively,
+        # and the checkpoints below settle the job CANCELLED.
+        wd = None
+        if job.handle._deadline_at is not None:
+            remaining = max(job.handle._deadline_at - time.monotonic(),
+                            0.01)
+            wd = rt_watchdog.Watchdog(timeout_s=remaining)
+        job.handle._attach_watchdog(wd)
         try:
-            with rt_health.job_scope(job.job_id), intercept:
+            with rt_health.job_scope(job.job_id), intercept, \
+                    rt_watchdog.activate(wd):
                 if spec.is_select_partitions:
                     lazy = engine.select_partitions(job.source, spec.params,
                                                     extractors)
@@ -695,13 +830,36 @@ class DPAggregationService:
                         result = list(lazy)
                     else:
                         result = dict(lazy)
+        except StorageUnavailableError as e:
+            # The mid-run journal/ledger persist path failed closed
+            # (ENOSPC / sick fsync): shed, don't forfeit — the result
+            # is withheld below the boundary, so nothing was released.
+            job.handle._attach_watchdog(None)
+            self._storage_shed(job, accountant, e)
+            return
         except Exception as e:  # noqa: BLE001 - the worker must survive ANY job failure: the error re-raises to the caller through handle.result(), and the ledger settles conservatively below
+            job.handle._attach_watchdog(None)
+            if (job.handle.cancel_requested or
+                    job.handle._deadline_exceeded()):
+                # The failure is the cancellation surfacing (the
+                # watchdog token cancelled an in-flight operation):
+                # settle CANCELLED — result withheld, nothing charged.
+                self._settle_cancelled(job, accountant)
+                return
             if accountant.mechanism_count:
                 # Mechanisms registered: releases may have left the
                 # process before the failure — forfeit the full grant
                 # (over-counting is privacy-safe).
-                job.ledger.charge_forfeit(job.job_id, spec.epsilon,
-                                          reason=type(e).__name__)
+                try:
+                    job.ledger.charge_forfeit(job.job_id, spec.epsilon,
+                                              reason=type(e).__name__)
+                except StorageUnavailableError as storage_err:
+                    # Even the forfeit could not be made durable. The
+                    # rollback kept memory and disk agreeing (no trail);
+                    # shed with the storage error — the result (if any)
+                    # is withheld either way.
+                    self._storage_shed(job, accountant, storage_err)
+                    return
             else:
                 job.ledger.release(job.job_id)
             rt_observability.prune_odometer(accountant=accountant)
@@ -717,10 +875,26 @@ class DPAggregationService:
                 "forfeited" if accountant.mechanism_count else
                 "released")
             return
+        job.handle._attach_watchdog(None)
+        if (job.handle.cancel_requested or
+                job.handle._deadline_exceeded()):
+            # Cancelled (or deadline elapsed) while the execution was
+            # finishing: the result is withheld HERE, before any charge
+            # and before it could ever reach the caller — which is what
+            # makes charging nothing privacy-sound.
+            self._settle_cancelled(job, accountant)
+            return
         records = rt_observability.odometer_report(
             accountant=accountant)["records"]
         spent = accountant.spent_epsilon()
-        job.ledger.charge(job.job_id, records)
+        try:
+            job.ledger.charge(job.job_id, records)
+        except StorageUnavailableError as e:
+            # The charge's persist failed closed and rolled back: shed
+            # with retry_after_s, result withheld, zero odometer
+            # records for the job.
+            self._storage_shed(job, accountant, e)
+            return
         # The trail is charged to the tenant's ledger of record — drop
         # it from the process-global odometer, or a resident service
         # grows that trail (and every odometer_report scan) without
@@ -778,6 +952,7 @@ class DPAggregationService:
             "jobs_admitted": counters.get("service_jobs_admitted", 0),
             "jobs_queued": counters.get("service_jobs_queued", 0),
             "jobs_shed": counters.get("service_jobs_shed", 0),
+            "jobs_cancelled": counters.get("service_jobs_cancelled", 0),
             "active_jobs": active,
             "queue_depth": self._queue.qsize(),
             "jobs_by_status": by_status,

@@ -846,6 +846,11 @@ KNOB_VALIDATORS: Dict[str, str] = {
     # during a rolling restart), so both go through the validators.
     "elastic_grow": "validate_elastic_grow",
     "drain_timeout_s": "validate_drain_timeout_s",
+    # Chaos/robustness knobs (PR 18): the per-job deadline is failure
+    # semantics by definition — it decides which jobs settle CANCELLED —
+    # and is validated at its own API boundary
+    # (DPAggregationService.submit).
+    "deadline_s": "validate_deadline_s",
 }
 
 # Data-plane parameters: configuration, not failure semantics — adding
@@ -1011,6 +1016,17 @@ def knob_validation(modules: List[Module]) -> Iterator[Finding]:
                 "DPAggregationService",
                 _invoked_validators(init, service_mod),
                 "DPAggregationService.__init__")
+        # submit() is a second service boundary: its keyword-only
+        # knobs (deadline_s) gate per-job failure semantics and must
+        # be vetted before the job is ever queued.
+        submit = _find_funcdef(service_mod, "submit",
+                               cls="DPAggregationService")
+        if submit is not None:
+            yield from check_knobs(
+                _keyword_knobs(submit), service_mod.rel,
+                "DPAggregationService.submit",
+                _invoked_validators(submit, service_mod),
+                "DPAggregationService.submit")
 
     # Reverse direction: a mapping whose knob no longer exists anywhere
     # is stale — it would silently pass while guarding nothing.

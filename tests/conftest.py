@@ -94,6 +94,13 @@ def pytest_configure(config):
         "fleet: fleet operations — elastic scale-UP, journal-based "
         "job migration, and the zero-loss rolling-restart drill "
         "(tier-1, NOT slow; select alone with -m fleet)")
+    config.addinivalue_line(
+        "markers",
+        "chaos: randomized composed-fault campaigns — seeded schedule "
+        "generation, the universal invariant checker (exactly-once "
+        "jobs, bit-exact ledgers, bit-identical results), "
+        "storage-fault hardening and the delta-debugging schedule "
+        "minimizer (tier-1, NOT slow; select alone with -m chaos)")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,577 @@
+"""Chaos campaigns: randomized composed-fault schedules with invariant
+checking, schedule minimization, and storage-fault hardening.
+
+The contracts under test:
+
+  * **Generator determinism** — ChaosCampaign(seed).schedules_for(t) is
+    a pure function of (seed, t) through a private random.Random: two
+    fresh campaign instances produce identical schedules, the
+    process-global RNG is never touched, and the pinned tier-1 campaign
+    (seed=3, 20 trials, intensity=0.6) covers the FULL fault-kind
+    vocabulary.
+  * **The campaign gate** — the pinned 20-trial campaign runs composed
+    overlapping faults through the service + driver workload and every
+    universal invariant holds: exactly-once completion, bit-exact
+    ledger reconciliation over the whole campaign history, results
+    bit-identical to fault-free baselines, counters consistent with the
+    firings.
+  * **The checker catches real bugs** — mutation tests: a double-charge
+    planted in the completion map fails the disk audit; a duplicated
+    completion across trials fails the exactly-once gate (and bumps
+    ``chaos_invariant_failures``).
+  * **The minimizer** — a planted two-fault bug buried in a six-fault
+    composed schedule shrinks to exactly those two faults at their
+    weakest strength, and the emitted FaultSchedule literal is runnable
+    and still reproduces.
+  * **Storage-fault hardening** — ENOSPC / failed fsync / EIO at the
+    journal and ledger seams fail CLOSED: disk_full never retries a
+    hopeless write, a failed fsync gets exactly one fresh-fd rewrite
+    (fsyncgate — never re-fsync the same fd), an unreadable record
+    quarantines instead of replaying, and the service converts a sick
+    store into a typed shed with retry_after_s — reservation released,
+    zero odometer records, never a lost job or a wedged worker.
+  * **Deadline / cancel / retry budget** — submit(deadline_s=) and
+    JobHandle.cancel() settle CANCELLED with a typed JobCancelledError
+    and charge nothing; RetryPolicy.max_total_retries caps a job's
+    TOTAL transient retries across every seam with a typed exhaustion.
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+import pipelinedp_tpu as pdp
+from pipelinedp_tpu import pipeline_backend
+from pipelinedp_tpu.runtime import chaos
+from pipelinedp_tpu.runtime import drill as drill_lib
+from pipelinedp_tpu.runtime import faults
+from pipelinedp_tpu.runtime import journal as journal_lib
+from pipelinedp_tpu.runtime import retry as retry_lib
+from pipelinedp_tpu.runtime import telemetry
+from pipelinedp_tpu.parallel import make_mesh
+from pipelinedp_tpu.service import (AdmissionRejectedError,
+                                    DPAggregationService,
+                                    JobCancelledError, JobSpec, JobStatus)
+
+from test_elastic import _blocked_agg_runner
+
+pytestmark = pytest.mark.chaos
+
+# The pinned tier-1 campaign: seed 3 at intensity 0.6 covers every kind
+# in the vocabulary across its 20 trials (pinned by
+# test_pinned_campaign_covers_full_vocabulary below — pick a new seed if
+# the sampler changes).
+SEED, TRIALS, INTENSITY = 3, 20, 0.6
+
+
+def _pinned_campaign() -> chaos.ChaosCampaign:
+    return chaos.ChaosCampaign(seed=SEED, trials=TRIALS,
+                               intensity=INTENSITY)
+
+
+def _small_spec(noise_seed=29):
+    params = pdp.AggregateParams(
+        metrics=[pdp.Metrics.COUNT],
+        max_partitions_contributed=1,
+        max_contributions_per_partition=1,
+        min_value=0.0, max_value=1.0)
+    ext = pdp.DataExtractors(privacy_id_extractor=lambda r: r[0],
+                             partition_extractor=lambda r: r[1],
+                             value_extractor=lambda r: r[2])
+    return JobSpec(params=params, epsilon=1.0, delta=1e-6,
+                   data_extractors=ext, noise_seed=noise_seed,
+                   public_partitions=["A"])
+
+
+_ROWS = [("u1", "A", 1.0), ("u2", "A", 1.0)]
+
+
+class TestGenerator:
+
+    def test_schedules_replay_bit_exact_across_instances(self):
+        """(seed, trial) alone reconstructs any trial: two fresh
+        campaign objects agree on every Fault of every schedule."""
+        a, b = _pinned_campaign(), _pinned_campaign()
+        for t in range(TRIALS):
+            assert a.schedules_for(t) == b.schedules_for(t)
+
+    def test_generator_never_touches_the_global_rng(self):
+        state = random.getstate()
+        list(_pinned_campaign())
+        assert random.getstate() == state
+
+    def test_distinct_seeds_sample_distinct_schedules(self):
+        other = chaos.ChaosCampaign(seed=SEED + 1, trials=TRIALS,
+                                    intensity=INTENSITY)
+        mine = _pinned_campaign()
+        assert any(mine.schedules_for(t) != other.schedules_for(t)
+                   for t in range(TRIALS))
+
+    def test_pinned_campaign_covers_full_vocabulary(self):
+        """The tier-1 campaign is not a partial probe: every fault kind
+        — including all three storage kinds — appears in some trial."""
+        kinds = set()
+        for sched in _pinned_campaign():
+            kinds.update(f.kind for f in sched.service + sched.driver)
+        assert kinds == set(chaos.ALL_KINDS)
+
+    def test_kind_restriction_is_honored(self):
+        campaign = chaos.ChaosCampaign(seed=5, trials=10,
+                                       kinds=("dispatch", "oom"))
+        for sched in campaign:
+            assert not sched.service  # no service-pool kinds allowed in
+            assert {f.kind for f in sched.driver} <= {"dispatch", "oom"}
+
+    def test_campaign_validates_its_inputs(self):
+        with pytest.raises(ValueError, match="seed"):
+            chaos.ChaosCampaign(seed="3", trials=5)
+        with pytest.raises(ValueError, match="trials"):
+            chaos.ChaosCampaign(seed=3, trials=0)
+        with pytest.raises(ValueError, match="intensity"):
+            chaos.ChaosCampaign(seed=3, trials=5, intensity=0.0)
+        with pytest.raises(ValueError, match="intensity"):
+            chaos.ChaosCampaign(seed=3, trials=5, intensity=1.5)
+        with pytest.raises(ValueError, match="unknown fault kinds"):
+            chaos.ChaosCampaign(seed=3, trials=5, kinds=("meteor",))
+        with pytest.raises(ValueError, match="n_blocks"):
+            chaos.ChaosCampaign(seed=3, trials=5, n_blocks=0)
+        with pytest.raises(ValueError, match="out of range"):
+            _pinned_campaign().schedules_for(TRIALS)
+
+    def test_fault_literal_round_trips(self):
+        for sched in _pinned_campaign():
+            for fault in sched.service + sched.driver:
+                rebuilt = eval(chaos.fault_literal(fault),  # noqa: S307 - the literal IS the contract under test
+                               {"faults": faults})
+                assert rebuilt == fault
+
+    def test_schedule_literal_is_runnable(self):
+        sched = _pinned_campaign().schedules_for(0)
+        namespace = {"faults": faults}
+        rebuilt = eval(chaos.schedule_literal(sched.driver),  # noqa: S307
+                       namespace)
+        assert isinstance(rebuilt, faults.FaultSchedule)
+        assert rebuilt.pending() == sum(f.times for f in sched.driver)
+
+
+class TestCampaign:
+
+    @pytest.mark.hard_timeout(120)
+    def test_pinned_campaign_every_invariant_holds(self, tmp_path):
+        """The acceptance gate: 20 trials of composed overlapping
+        faults (every kind in the vocabulary fires somewhere) and every
+        universal invariant holds — all jobs land exactly once, the
+        campaign-long disk trail reconciles bit-exactly, every result
+        is bit-identical to its fault-free baseline."""
+        before = telemetry.snapshot()
+        report = chaos.run_campaign(_pinned_campaign(), str(tmp_path))
+        assert report["invariants_hold"]
+        assert report["trials"] == TRIALS
+        assert report["jobs_completed"] == 3 * TRIALS
+        assert report["total_firings"] > TRIALS  # composed, not sparse
+        # Every kind the generator sampled actually fired somewhere.
+        assert set(chaos.ALL_KINDS) == set(report["fired"])
+        delta = telemetry.delta(before)
+        assert delta.get("chaos_trials", 0) == TRIALS
+        assert delta.get("chaos_invariant_failures", 0) == 0
+
+    @pytest.mark.slow
+    @pytest.mark.hard_timeout(300)
+    def test_high_intensity_campaign(self, tmp_path):
+        """The hostile end of the dial: intensity 1.0 composes up to 6
+        driver faults + 2 service faults per trial."""
+        campaign = chaos.ChaosCampaign(seed=11, trials=30, intensity=1.0)
+        report = chaos.run_campaign(campaign, str(tmp_path))
+        assert report["invariants_hold"]
+        assert report["jobs_completed"] == 3 * 30
+
+
+class TestCheckerCatchesBugs:
+    """Mutation tests: the invariant checker must FAIL when fed the
+    bugs it claims to catch — otherwise a green campaign proves
+    nothing."""
+
+    @pytest.mark.hard_timeout(120)
+    def test_double_charge_and_duplicate_completion_fail(self, tmp_path):
+        workload = chaos.default_workload()
+        factory = pipeline_backend.TPUBackend
+        ledger_dir = str(tmp_path / "ledger")
+        completed = {}
+        empty = chaos.TrialSchedules(trial=0, service=(), driver=())
+        rep = chaos.run_trial(empty, workload, factory, ledger_dir,
+                              str(tmp_path / "t0"), completed)
+        assert rep["fired"] == {}
+        # Plant a double-charge: the completion map claims one job spent
+        # twice what the disk trail recorded — the bit-exact
+        # reconciliation must refuse.
+        tampered = {name: dict(done) for name, done in completed.items()}
+        first = next(iter(tampered))
+        tampered[first]["spent_epsilon"] = \
+            2 * tampered[first]["spent_epsilon"]
+        with pytest.raises(drill_lib.DrillFailure,
+                           match="must be bit-exact"):
+            drill_lib.audit_disk(ledger_dir, tampered)
+        # Plant a duplicated completion: re-running trial 0 over the
+        # same cumulative map re-lands the same logical names — the
+        # exactly-once gate must refuse (and the failure counts).
+        before = telemetry.snapshot()
+        with pytest.raises(chaos.ChaosInvariantError,
+                           match="completed twice"):
+            chaos.run_trial(empty, workload, factory, ledger_dir,
+                            str(tmp_path / "t0b"), completed)
+        delta = telemetry.delta(before)
+        assert delta.get("chaos_invariant_failures", 0) == 1
+
+
+class TestMinimizer:
+
+    # The planted bug: the run "fails" iff a dispatch fault AND an oom
+    # fault are BOTH present — a genuine two-fault composition, buried
+    # in a six-fault schedule below.
+    @staticmethod
+    def _planted_check(service, driver):
+        kinds = {f.kind for f in service + driver}
+        return "dispatch" in kinds and "oom" in kinds
+
+    _COMPOSED = dict(
+        service_faults=(faults.Fault("fsync_failure", point="odometer"),),
+        driver_faults=(faults.Fault("dispatch", block=2, times=2),
+                       faults.Fault("slow", block=1, delay=0.02),
+                       faults.Fault("oom", block=1),
+                       faults.Fault("hang", delay=0.1),
+                       faults.Fault("corrupt", block=3, mode="flip")))
+
+    def test_planted_two_fault_bug_shrinks_to_exactly_those_two(self):
+        minimized = chaos.minimize_schedule(self._planted_check,
+                                            **self._COMPOSED)
+        assert minimized.service == ()
+        assert {f.kind for f in minimized.driver} == {"dispatch", "oom"}
+        # Locally minimal means weakest strength too: single firings,
+        # block wildcards.
+        assert all(f.times == 1 and f.block is None
+                   for f in minimized.driver)
+
+    def test_minimized_literal_is_runnable_and_still_fails(self):
+        minimized = chaos.minimize_schedule(self._planted_check,
+                                            **self._COMPOSED)
+        namespace = {"faults": faults}
+        exec(minimized.literal, namespace)  # noqa: S102 - the emitted reproducer IS the contract under test
+        assert isinstance(namespace["service_schedule"],
+                          faults.FaultSchedule)
+        assert isinstance(namespace["driver_schedule"],
+                          faults.FaultSchedule)
+        assert namespace["driver_schedule"].pending() == len(
+            minimized.driver)
+        # ...and the minimized schedule still reproduces the bug.
+        assert self._planted_check(minimized.service, minimized.driver)
+
+    def test_minimizer_rejects_a_passing_schedule(self):
+        with pytest.raises(ValueError, match="does not fail"):
+            chaos.minimize_schedule(
+                lambda s, d: False,
+                (faults.Fault("dispatch"),), ())
+
+    def test_minimizer_respects_probe_cap(self):
+        calls = []
+
+        def check(service, driver):
+            calls.append(1)
+            return True  # everything "fails": shrinks to nothing
+
+        minimized = chaos.minimize_schedule(
+            check, (), tuple(faults.Fault("dispatch", block=b)
+                             for b in range(4)), max_probes=5)
+        assert minimized.probes <= 5
+        assert len(calls) <= 5
+
+
+class TestStorageFaultsJournalSeam:
+    """ENOSPC / fsyncgate / EIO contracts at the block-record store."""
+
+    RECORD = journal_lib.BlockRecord(
+        ids=np.arange(3, dtype=np.int64),
+        outputs={"count": np.ones(3, dtype=np.float64)})
+    RECORD2 = journal_lib.BlockRecord(
+        ids=np.arange(4, dtype=np.int64),
+        outputs={"count": np.full(4, 2.0)})
+
+    def test_disk_full_fails_closed_without_retry(self, tmp_path):
+        journal = journal_lib.BlockJournal(str(tmp_path))
+        journal.put("job", "0:64", self.RECORD)
+        before = telemetry.snapshot()
+        sched = faults.FaultSchedule(
+            [faults.Fault("disk_full", point="block")])
+        with faults.inject(sched):
+            with pytest.raises(journal_lib.StorageUnavailableError,
+                               match="ENOSPC"):
+                journal.put("job", "0:64", self.RECORD2)
+        delta = telemetry.delta(before)
+        # ENOSPC is hopeless: exactly one attempt, no rewrite.
+        assert delta.get("storage_disk_full", 0) == 1
+        assert delta.get("storage_unavailable", 0) == 1
+        assert delta.get("storage_fsync_failures", 0) == 0
+        # The tmp was unlinked and the PRIOR record remains the durable
+        # truth — a fresh journal (disk-only view) proves it.
+        assert not [n for n in os.listdir(tmp_path)
+                    if n.endswith(".tmp")]
+        replayed = journal_lib.BlockJournal(str(tmp_path)).get("job",
+                                                               "0:64")
+        assert np.array_equal(replayed.ids, self.RECORD.ids)
+
+    def test_fsync_failure_gets_exactly_one_fresh_fd_rewrite(
+            self, tmp_path):
+        journal = journal_lib.BlockJournal(str(tmp_path))
+        before = telemetry.snapshot()
+        sched = faults.FaultSchedule(
+            [faults.Fault("fsync_failure", point="block")])
+        with faults.inject(sched):
+            journal.put("job", "0:64", self.RECORD)  # survives: 1 rewrite
+        delta = telemetry.delta(before)
+        assert delta.get("storage_fsync_failures", 0) == 1
+        assert delta.get("storage_unavailable", 0) == 0
+        replayed = journal_lib.BlockJournal(str(tmp_path)).get("job",
+                                                               "0:64")
+        assert np.array_equal(replayed.ids, self.RECORD.ids)
+
+    def test_persistent_fsync_failure_fails_closed(self, tmp_path):
+        journal = journal_lib.BlockJournal(str(tmp_path))
+        before = telemetry.snapshot()
+        sched = faults.FaultSchedule(
+            [faults.Fault("fsync_failure", point="block", times=2)])
+        with faults.inject(sched):
+            with pytest.raises(journal_lib.StorageUnavailableError,
+                               match="stayed sick"):
+                journal.put("job", "0:64", self.RECORD)
+        delta = telemetry.delta(before)
+        assert delta.get("storage_fsync_failures", 0) == 2
+        assert delta.get("storage_unavailable", 0) == 1
+        assert not [n for n in os.listdir(tmp_path)
+                    if n.endswith(".tmp")]
+        assert journal_lib.BlockJournal(str(tmp_path)).get(
+            "job", "0:64") is None
+
+    def test_eio_read_quarantines_never_replays(self, tmp_path):
+        journal_lib.BlockJournal(str(tmp_path)).put("job", "0:64",
+                                                    self.RECORD)
+        before = telemetry.snapshot()
+        sched = faults.FaultSchedule(
+            [faults.Fault("io_error", point="block")])
+        with faults.inject(sched):
+            # A FRESH instance reads from disk (the in-memory cache of
+            # the writer never touches the read seam).
+            got = journal_lib.BlockJournal(str(tmp_path)).get("job",
+                                                              "0:64")
+        assert got is None
+        delta = telemetry.delta(before)
+        assert delta.get("storage_io_errors", 0) == 1
+        assert delta.get("journal_quarantined", 0) == 1
+        names = os.listdir(tmp_path)
+        assert any(n.endswith(".corrupt") for n in names)
+        assert not any(n.endswith(".npz") for n in names)
+
+
+class TestStorageFaultsLedgerSeam:
+    """The service converts a sick ledger store into a typed shed —
+    reservation released, zero odometer records, worker alive."""
+
+    @pytest.mark.hard_timeout(120)
+    def test_disk_full_at_charge_sheds_then_recovers(self, tmp_path):
+        ledger_dir = str(tmp_path / "ledger")
+        service = DPAggregationService(pipeline_backend.TPUBackend(),
+                                       ledger_dir, max_concurrent_jobs=1)
+        try:
+            before = telemetry.snapshot()
+            sched = faults.FaultSchedule(
+                [faults.Fault("disk_full", point="odometer")])
+            with faults.inject(sched, scope="process"):
+                handle = service.submit("acme", _small_spec(), _ROWS)
+                assert handle.wait(60)
+            assert handle.status == JobStatus.SHED
+            error = handle.exception(timeout=0)
+            assert isinstance(error, AdmissionRejectedError)
+            assert error.retry_after_s is not None
+            assert handle.spent_epsilon is None
+            delta = telemetry.delta(before)
+            assert delta.get("service_jobs_shed", 0) == 1
+            assert delta.get("storage_unavailable", 0) == 1
+            # The store recovers; the SAME logical work resubmits and
+            # lands — and the disk trail holds exactly the one
+            # completed job's spend (the shed charged nothing).
+            retry = service.submit("acme", _small_spec(), _ROWS)
+            assert retry.wait(60) and retry.status == JobStatus.DONE
+            drill_lib.audit_disk(
+                ledger_dir,
+                {"j": {"job_id": retry.job_id, "tenant_id": "acme",
+                       "spent_epsilon": retry.spent_epsilon}})
+        finally:
+            service.drain()
+
+    @pytest.mark.hard_timeout(120)
+    def test_fsync_exhaustion_at_charge_sheds_cleanly(self, tmp_path):
+        ledger_dir = str(tmp_path / "ledger")
+        service = DPAggregationService(pipeline_backend.TPUBackend(),
+                                       ledger_dir, max_concurrent_jobs=1)
+        try:
+            sched = faults.FaultSchedule(
+                [faults.Fault("fsync_failure", point="odometer",
+                              times=2)])
+            with faults.inject(sched, scope="process"):
+                handle = service.submit("acme", _small_spec(), _ROWS)
+                assert handle.wait(60)
+            assert handle.status == JobStatus.SHED
+            # Zero odometer records for the tenant: a fresh submit is
+            # the FIRST charge the disk ever sees.
+            good = service.submit("acme", _small_spec(), _ROWS)
+            assert good.wait(60) and good.status == JobStatus.DONE
+            spend = drill_lib.audit_disk(
+                ledger_dir,
+                {"j": {"job_id": good.job_id, "tenant_id": "acme",
+                       "spent_epsilon": good.spent_epsilon}})
+            assert spend["acme"] == good.spent_epsilon
+        finally:
+            service.drain()
+
+
+class TestDeadlineAndCancel:
+
+    @pytest.mark.hard_timeout(120)
+    def test_expired_deadline_settles_cancelled_charges_nothing(
+            self, tmp_path):
+        ledger_dir = str(tmp_path / "ledger")
+        service = DPAggregationService(pipeline_backend.TPUBackend(),
+                                       ledger_dir, max_concurrent_jobs=1)
+        try:
+            handle = service.submit("acme", _small_spec(), _ROWS,
+                                    deadline_s=1e-6)
+            assert handle.wait(60)
+            assert handle.status == JobStatus.CANCELLED
+            error = handle.exception(timeout=0)
+            assert isinstance(error, JobCancelledError)
+            assert error.reason == "deadline"
+            with pytest.raises(JobCancelledError):
+                handle.result(timeout=0)
+            assert handle.spent_epsilon is None
+            # Nothing charged: the tenant's next job is the ledger's
+            # first and only record.
+            good = service.submit("acme", _small_spec(), _ROWS)
+            assert good.wait(60) and good.status == JobStatus.DONE
+            drill_lib.audit_disk(
+                ledger_dir,
+                {"j": {"job_id": good.job_id, "tenant_id": "acme",
+                       "spent_epsilon": good.spent_epsilon}})
+        finally:
+            service.drain()
+
+    @pytest.mark.hard_timeout(120)
+    def test_cancel_settles_cancelled_with_typed_error(self, tmp_path):
+        service = DPAggregationService(pipeline_backend.TPUBackend(),
+                                       str(tmp_path / "ledger"),
+                                       max_concurrent_jobs=1)
+        try:
+            handle = service.submit("acme", _small_spec(), _ROWS)
+            requested = handle.cancel()
+            assert handle.wait(60)
+            if requested and handle.status == JobStatus.CANCELLED:
+                error = handle.exception(timeout=0)
+                assert isinstance(error, JobCancelledError)
+                assert error.reason == "cancelled"
+                assert handle.spent_epsilon is None
+            else:
+                # The job won the race and finished first — then
+                # cancel() must have reported there was nothing to do.
+                assert handle.status == JobStatus.DONE
+                assert not handle.cancel()
+        finally:
+            service.drain()
+
+    @pytest.mark.hard_timeout(120)
+    def test_cancel_after_done_returns_false(self, tmp_path):
+        service = DPAggregationService(pipeline_backend.TPUBackend(),
+                                       str(tmp_path / "ledger"))
+        try:
+            handle = service.submit("acme", _small_spec(), _ROWS)
+            assert handle.wait(60) and handle.status == JobStatus.DONE
+            assert handle.cancel() is False
+            assert handle.status == JobStatus.DONE  # unchanged
+        finally:
+            service.drain()
+
+    def test_counters_track_cancellations(self, tmp_path):
+        before = telemetry.snapshot()
+        service = DPAggregationService(pipeline_backend.TPUBackend(),
+                                       str(tmp_path / "ledger"),
+                                       max_concurrent_jobs=1)
+        try:
+            handle = service.submit("acme", _small_spec(), _ROWS,
+                                    deadline_s=1e-6)
+            assert handle.wait(60)
+            assert handle.status == JobStatus.CANCELLED
+        finally:
+            service.drain()
+        delta = telemetry.delta(before)
+        assert delta.get("service_jobs_cancelled", 0) == 1
+        assert service.stats()["jobs_cancelled"] >= 1
+
+
+class TestRetryBudget:
+
+    def test_exhaustion_is_typed_and_counted(self):
+        policy = retry_lib.RetryPolicy(max_retries=10, base_delay=0.0,
+                                       max_delay=0.0)
+        sched = faults.FaultSchedule([faults.Fault("dispatch", times=5)])
+        before = telemetry.snapshot()
+        with faults.inject(sched):
+            with retry_lib.retry_budget_scope(2):
+                with pytest.raises(
+                        retry_lib.RetryBudgetExhaustedError):
+                    retry_lib.retry_call(lambda: "ok", policy,
+                                         sleep=lambda _: None)
+        delta = telemetry.delta(before)
+        assert delta.get("retry_budget_exhausted", 0) == 1
+        # Per-operation retries stayed within max_retries: the BUDGET
+        # stopped the job, not the per-op cap.
+        assert delta.get("block_retries", 0) == 2
+
+    def test_budget_none_is_unlimited(self):
+        policy = retry_lib.RetryPolicy(max_retries=10, base_delay=0.0,
+                                       max_delay=0.0)
+        sched = faults.FaultSchedule([faults.Fault("dispatch", times=4)])
+        with faults.inject(sched):
+            with retry_lib.retry_budget_scope(None):
+                assert retry_lib.retry_call(lambda: "ok", policy,
+                                            sleep=lambda _: None) == "ok"
+
+    def test_budget_scope_validates(self):
+        with pytest.raises(ValueError, match="non-negative"):
+            with retry_lib.retry_budget_scope(-1):
+                pass
+
+    @pytest.mark.hard_timeout(120)
+    def test_driver_exhausts_budget_typed_then_resumes(self, tmp_path):
+        """End-to-end through the entry wrapper: a driver run whose
+        max_total_retries is 0 fails TYPED on the first transient
+        fault; lifting the cap over the same journal completes
+        bit-identically to the fault-free run."""
+        mesh = make_mesh(n_devices=2)
+        key = jax.random.PRNGKey(5)
+        journal = journal_lib.BlockJournal(str(tmp_path))
+        want = _blocked_agg_runner(mesh, key)
+        strict = retry_lib.RetryPolicy(max_retries=3, base_delay=0.0,
+                                       max_delay=0.0,
+                                       max_total_retries=0)
+        sched = faults.FaultSchedule([faults.Fault("dispatch", block=1)])
+        with faults.inject(sched):
+            with pytest.raises(retry_lib.RetryBudgetExhaustedError):
+                _blocked_agg_runner(mesh, key, journal=journal,
+                                    retry=strict)
+        relaxed = retry_lib.RetryPolicy(max_retries=3, base_delay=0.0,
+                                        max_delay=0.0,
+                                        max_total_retries=8)
+        kept, out = _blocked_agg_runner(mesh, key, journal=journal,
+                                        retry=relaxed)
+        assert np.array_equal(kept, want[0])
+        assert np.array_equal(out, want[1])

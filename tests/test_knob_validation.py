@@ -188,6 +188,40 @@ class TestKnobRejection:
         assert any("brand_new_service_knob" in f.message and
                    "no validator mapping" in f.message for f in found)
 
+    def test_submit_rejects_bad_deadline(self):
+        """deadline_s is vetted at its own boundary
+        (DPAggregationService.submit) before the job is ever queued."""
+        from pipelinedp_tpu.service import DPAggregationService, JobSpec
+        import pipelinedp_tpu as pdp
+        backend = pipeline_backend.TPUBackend()
+        service = DPAggregationService(backend)
+        spec = JobSpec(params=pdp.AggregateParams(
+            metrics=[pdp.Metrics.COUNT], max_partitions_contributed=1,
+            max_contributions_per_partition=1), epsilon=1.0, delta=1e-6)
+        try:
+            for bad in (0.0, -1.0, float("nan"), float("inf"), True,
+                        "soon"):
+                with pytest.raises(ValueError, match="deadline_s"):
+                    service.submit("t", spec, [("u", "A", 1.0)],
+                                   deadline_s=bad)
+        finally:
+            service.drain()
+
+    def test_submit_knob_without_validation_is_flagged(self):
+        """submit() is a second service boundary: a new keyword-only
+        submit knob with no validator mapping drifts loudly."""
+        found = _findings({
+            "pipelinedp_tpu/service/service.py": (
+                "class DPAggregationService:\n"
+                "    def __init__(self, backend, ledger_dir=None):\n"
+                "        self._backend = backend\n"
+                "    def submit(self, tenant_id, spec, source, *,\n"
+                "               brand_new_submit_knob=None):\n"
+                "        return None\n"),
+        })
+        assert any("brand_new_submit_knob" in f.message and
+                   "no validator mapping" in f.message for f in found)
+
     def test_driver_rejects_bad_elastic_and_min_devices(self):
         import numpy as np
         from pipelinedp_tpu.parallel import large_p, make_mesh, sharded
