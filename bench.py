@@ -789,6 +789,104 @@ def _bench_chaos(on_tpu):
         return {"chaos": {"error": f"{type(e).__name__}: {e}"}}
 
 
+def _bench_numeric(on_tpu):
+    """`numeric` receipt key: the numeric-armor arc priced.
+
+    Three figures: the warm fused-release cost of numeric_mode="safe"
+    relative to the default path on identical rows (what compensated
+    accumulation charges); the accumulation error against a float64
+    oracle on a 1M-row integer-valued stream — sequential f32, XLA's
+    log-depth f32 scan, and the compensated scan, in f32 ULPs at the
+    oracle; and the per-draw cost of the floating-point-safe noise
+    (snapped Laplace + geometric). Correctness gates live in tier-1
+    (tests/test_numeric_armor.py); this receipt says what the armor
+    costs."""
+    import dataclasses
+    import time
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks import _common
+    from pipelinedp_tpu import dp_computations as dp
+    from pipelinedp_tpu import executor
+    from pipelinedp_tpu.ops import segment_ops
+
+    try:
+        # --- safe vs fast: the dense fused release, warm. ---
+        n = 2**20 if on_tpu else 2**17
+        n_partitions = 1 << 12
+        _, cfg, stds, (min_v, max_v, min_s, max_s, mid) = \
+            _common.build_spec(n_partitions)
+        pid, pk, values, valid = _common.zipfish_data(n, n_partitions)
+        key = jax.random.PRNGKey(3)
+
+        def run(cfg_):
+            out = executor.aggregate_release_kernel(
+                pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
+                stds, key, cfg_)
+            return jax.block_until_ready(out)
+
+        def timed(cfg_):
+            run(cfg_)  # compile
+            start = time.perf_counter()
+            run(cfg_)
+            return time.perf_counter() - start
+
+        fast_s = timed(cfg)
+        safe_s = timed(dataclasses.replace(cfg, numeric_mode="safe"))
+
+        # --- accumulation error vs a float64 oracle at 1M rows:
+        # sequential f32 (the classic running accumulator), XLA's
+        # log-depth f32 scan (the fast path's shape), and the
+        # compensated scan (the safe path). ULPs at the oracle. ---
+        m = 1 << 20
+        rng = np.random.default_rng(7)
+        x = rng.integers(0, 1 << 22, m).astype(np.float32)
+        xj = jnp.asarray(x)
+        oracle = float(np.cumsum(x.astype(np.float64))[-1])
+        seq = float(np.cumsum(x)[-1])
+        xla = float(np.asarray(jnp.cumsum(xj, dtype=xj.dtype))[-1])
+        hi, lo = segment_ops.compensated_cumsum(xj)
+        starts = jnp.asarray([0, m], dtype=jnp.int32)
+        comp = float(np.asarray(
+            segment_ops.compensated_segment_diff(hi, lo, starts))[0])
+        ulp = float(np.spacing(np.float32(oracle)))
+
+        # --- floating-point-safe noise draw cost (threefry-keyed,
+        # scalar release path — the per-draw price the host pays). ---
+        draws = 500
+        snap = dp.SnappedLaplaceMechanism(1.0, 1.0,
+                                          key=jax.random.PRNGKey(9))
+        start = time.perf_counter()
+        for v in range(draws):
+            snap.add_noise(float(v))
+        snap_s = time.perf_counter() - start
+        geo = dp.GeometricMechanism(1.0, 1, key=jax.random.PRNGKey(10))
+        start = time.perf_counter()
+        for v in range(draws):
+            geo.add_noise(v)
+        geo_s = time.perf_counter() - start
+
+        return {"numeric": {
+            "rows": n,
+            "fast_sec": round(fast_s, 4),
+            "safe_sec": round(safe_s, 4),
+            "safe_vs_fast": round(safe_s / fast_s, 3),
+            "cumsum_rows": m,
+            "sequential_f32_error_ulps": round(abs(seq - oracle) / ulp, 1),
+            "xla_scan_f32_error_ulps": round(abs(xla - oracle) / ulp, 2),
+            "compensated_error_ulps": round(abs(comp - oracle) / ulp, 2),
+            "snap_grid": snap.grid,
+            "snapped_laplace_draws_per_sec": round(draws / snap_s),
+            "geometric_draws_per_sec": round(draws / geo_s),
+        }}
+    except Exception as e:  # noqa: BLE001 - the receipt must survive numeric-bench breakage; tests/test_numeric_armor.py owns failing on it
+        return {"numeric": {"error": f"{type(e).__name__}: {e}"}}
+
+
 def _bench_select_partitions(jax, on_tpu):
     """Standalone DP partition selection at P = 10^7 via the O(kept)
     blocked route (parallel/large_p.select_partitions_blocked): neither a
@@ -1461,6 +1559,10 @@ def main():
     # check (wall time per trial, what fired, storage-seam counters). ---
     chaos_detail = _bench_chaos(on_tpu)
 
+    # --- Numeric armor: safe-vs-fast release cost, compensated-vs-naive
+    # accumulation error in ULPs, snapped/geometric noise draw rates. ---
+    numeric_detail = _bench_numeric(on_tpu)
+
     # --- BASELINE configs 1-3 (LocalBackend ref, Gaussian+public,
     # compound combiner). ---
     baseline_detail = _bench_baseline_configs(jax, jnp, on_tpu)
@@ -1604,6 +1706,7 @@ def main():
                 **megabatch_detail,
                 **fleet_detail,
                 **chaos_detail,
+                **numeric_detail,
                 **baseline_detail,
                 "runtime_fault_counters": fault_counters,
                 "runtime_phase_timings": phase_timings,

@@ -708,6 +708,372 @@ def create_mean_mechanism(
                                   normalized_sum_sensitivities))
 
 
+# ---------------------------------------------------------------------------
+# Discrete / snapped mechanisms: floating-point-safe noise.
+#
+# The continuous mechanisms above sample IEEE doubles, whose uneven value
+# grid leaks information (Mironov, CCS 2012): the set of reachable outputs
+# depends on the true value, so an attacker observing the low-order bits of
+# a release can distinguish neighbors the epsilon claims are indistinguishable.
+# The mechanisms below release ONLY values on a declared grid:
+#
+#   * GeometricMechanism — integer-valued two-sided geometric noise (the
+#     discrete Laplace) for counts; every release is an exact integer.
+#   * SnappedLaplaceMechanism / SnappedGaussianMechanism — clamp -> noise ->
+#     round to a power-of-two grid g for real-valued sums. Snapping moves a
+#     release by at most g/2, so two neighbors' snapped outputs can differ
+#     by up to Delta + g; calibration therefore widens the sensitivity to
+#     Delta + g (the same conservative accounting ops/secure_noise.py applies
+#     to the on-device tables), so the MechanismSpec's granted epsilon stays
+#     a sound upper bound — the snap costs a ~g/Delta utility factor, never
+#     budget.
+#
+# Determinism: bound to a threefry key (the same key family the device
+# kernels use, executor.make_noise_key), draws come from counter-folded
+# jax.random.bits u32 words assembled to 64-bit uniforms on the host —
+# bit-identical per (seed, job, draw index) with or without jax_enable_x64,
+# replayable after resume. Unbound mechanisms fall back to mechanism_rng().
+# ---------------------------------------------------------------------------
+
+# Default snapping grid: pow2_ceil(noise scale) * 2**-_SNAP_FRACTION_BITS —
+# a relative snap displacement of ~2**-17 of the noise scale, so the
+# Delta + g widening is invisible at common budgets unless snap_grid_bits
+# explicitly coarsens the grid.
+_SNAP_FRACTION_BITS = 16
+
+# Clamp bound for snapped releases: the largest magnitude at which
+# round-to-grid is still exact in float64 (53-bit significand). Releases
+# beyond it would leave the declared grid silently; clamping is the
+# fail-closed alternative.
+_SNAP_CLAMP_GRID_UNITS = float(1 << 52)
+
+
+def _pow2_round_up(x: float) -> float:
+    return 2.0 ** math.ceil(math.log2(x))
+
+
+def _threefry_uniforms(key, n: int, draw_index: int) -> np.ndarray:
+    """n uniforms in (0, 1) from a threefry key and a draw counter.
+
+    64 bits per uniform, assembled from two u32 words on the host so the
+    stream is identical whether or not jax_enable_x64 is on. The +0.5
+    offset keeps draws strictly inside (0, 1) — the inverse CDFs below
+    take logs.
+    """
+    import jax
+    sub = jax.random.fold_in(key, draw_index)
+    words = np.asarray(jax.random.bits(sub, (2 * n,), np.uint32)).astype(
+        np.uint64)  # staticcheck: disable=host-transfer — O(draws) scalar noise words, the host mechanism path
+    u64 = (words[0::2] << np.uint64(32)) | words[1::2]
+    return (u64.astype(np.float64) + 0.5) * (2.0 ** -64)
+
+
+class _KeyedDrawMixin:
+    """Counter-folded deterministic uniforms shared by the discrete
+    mechanisms. bind_key() makes every later draw a pure function of
+    (key, draw index); unbound, draws come from mechanism_rng()."""
+
+    _key = None
+    _draws = 0
+
+    def bind_key(self, key) -> None:
+        self._key = key
+        self._draws = 0
+
+    def _uniforms(self, n: int) -> np.ndarray:
+        if self._key is not None:
+            u = _threefry_uniforms(self._key, n, self._draws)
+            self._draws += 1
+            return u
+        return mechanism_rng().random(n)
+
+
+class GeometricMechanism(_KeyedDrawMixin, AdditiveMechanism):
+    """Two-sided geometric (discrete Laplace) mechanism for counts.
+
+    P(Z = z) proportional to alpha**|z| with alpha = exp(-eps / Delta):
+    the integer-valued analogue of Laplace, eps-DP for integer-valued
+    queries with (integer) l1 sensitivity Delta. Sampled as the
+    difference of two iid geometric variables on {0, 1, ...} via exact
+    inverse CDF — every release is an exact integer, grid step 1.
+    """
+
+    def __init__(self, epsilon: float, l1_sensitivity: float, key=None):
+        self._epsilon = epsilon
+        # A fractional l1 is rounded UP: alpha = exp(-eps/ceil(Delta))
+        # over-noises rather than under-noises.
+        self._l1_sensitivity = float(math.ceil(l1_sensitivity))
+        if key is not None:
+            self.bind_key(key)
+
+    @classmethod
+    def create_from_epsilon(cls, epsilon: float, l1_sensitivity: float,
+                            key=None) -> 'GeometricMechanism':
+        return GeometricMechanism(epsilon, l1_sensitivity, key=key)
+
+    @property
+    def alpha(self) -> float:
+        return math.exp(-self._epsilon / self._l1_sensitivity)
+
+    def add_noise(self, value: Union[int, float]) -> float:
+        a = self.alpha
+        u1, u2 = self._uniforms(2)
+        if a <= 0.0:
+            g1 = g2 = 0  # eps/Delta past exp underflow: noise is 0 w.p. ~1
+        else:
+            log_a = math.log(a)
+            g1 = int(math.floor(math.log(u1) / log_a))
+            g2 = int(math.floor(math.log(u2) / log_a))
+        from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+        rt_telemetry.record("snapped_releases")
+        return float(int(round(value)) + g1 - g2)
+
+    @property
+    def epsilon(self) -> float:
+        return self._epsilon
+
+    @property
+    def grid(self) -> float:
+        return 1.0
+
+    @property
+    def noise_kind(self) -> NoiseKind:
+        return NoiseKind.LAPLACE
+
+    @property
+    def noise_parameter(self) -> float:
+        return self.alpha
+
+    @property
+    def std(self) -> float:
+        a = self.alpha
+        return math.sqrt(2.0 * a) / (1.0 - a)
+
+    @property
+    def sensitivity(self) -> float:
+        return self._l1_sensitivity
+
+    def describe(self) -> str:
+        return (f"Geometric (discrete Laplace) mechanism:  alpha="
+                f"{self.alpha}  eps={self._epsilon}  l1_sensitivity="
+                f"{self.sensitivity}  grid=1")
+
+
+class _SnappedMechanism(_KeyedDrawMixin, AdditiveMechanism):
+    """Shared clamp -> noise -> round-to-grid release path."""
+
+    _grid: float
+
+    def _snap(self, noisy: float) -> float:
+        g = self._grid
+        bound = _SNAP_CLAMP_GRID_UNITS * g
+        clamped = min(max(noisy, -bound), bound)
+        # g is a power of two, so x/g and the re-multiply are exact: the
+        # release lands EXACTLY on the declared grid.
+        snapped = round(clamped / g) * g
+        from pipelinedp_tpu.runtime import telemetry as rt_telemetry
+        rt_telemetry.record("snapped_releases")
+        return snapped
+
+    @property
+    def grid(self) -> float:
+        return self._grid
+
+
+class SnappedLaplaceMechanism(_SnappedMechanism):
+    """Snapped Laplace: clamp -> Laplace noise -> round to power-of-two grid.
+
+    The grid g = pow2_ceil(b) * 2**-16 (floored at 2**snap_grid_bits when
+    given); the scale is calibrated against the widened sensitivity
+    Delta + g, so the granted epsilon bounds the snapped release's
+    privacy loss.
+    """
+
+    def __init__(self, epsilon: float, l1_sensitivity: float,
+                 snap_grid_bits: Optional[int] = None, key=None):
+        self._epsilon = epsilon
+        self._raw_sensitivity = l1_sensitivity
+        base_b = l1_sensitivity / epsilon
+        g = _pow2_round_up(base_b) * 2.0 ** -_SNAP_FRACTION_BITS
+        if snap_grid_bits is not None:
+            g = max(g, 2.0 ** int(snap_grid_bits))
+        self._grid = g
+        self._l1_sensitivity = l1_sensitivity + g  # snap widening
+        self._b = self._l1_sensitivity / epsilon
+        if key is not None:
+            self.bind_key(key)
+
+    def add_noise(self, value: Union[int, float]) -> float:
+        (u,) = self._uniforms(1)
+        # Laplace inverse CDF on one uniform in (0, 1).
+        if u < 0.5:
+            noise = self._b * math.log(2.0 * u)
+        else:
+            noise = -self._b * math.log(2.0 * (1.0 - u))
+        return self._snap(float(value) + noise)
+
+    @property
+    def epsilon(self) -> float:
+        return self._epsilon
+
+    @property
+    def noise_kind(self) -> NoiseKind:
+        return NoiseKind.LAPLACE
+
+    @property
+    def noise_parameter(self) -> float:
+        return self._b
+
+    @property
+    def std(self) -> float:
+        return self._b * math.sqrt(2)
+
+    @property
+    def sensitivity(self) -> float:
+        return self._l1_sensitivity
+
+    def describe(self) -> str:
+        return (f"Snapped Laplace mechanism:  parameter={self._b}  eps="
+                f"{self._epsilon}  l1_sensitivity={self._l1_sensitivity} "
+                f"(raw {self._raw_sensitivity} + grid)  grid={self._grid}")
+
+
+class SnappedGaussianMechanism(_SnappedMechanism):
+    """Snapped Gaussian: clamp -> Gaussian noise -> round to power-of-two
+    grid, sigma calibrated (analytic Gaussian mechanism) against the
+    widened sensitivity Delta + g."""
+
+    def __init__(self, epsilon: float, delta: float, l2_sensitivity: float,
+                 snap_grid_bits: Optional[int] = None, key=None):
+        self._epsilon = epsilon
+        self._delta = delta
+        self._raw_sensitivity = l2_sensitivity
+        base_sigma = gaussian_sigma(epsilon, delta, l2_sensitivity)
+        g = _pow2_round_up(base_sigma) * 2.0 ** -_SNAP_FRACTION_BITS
+        if snap_grid_bits is not None:
+            g = max(g, 2.0 ** int(snap_grid_bits))
+        self._grid = g
+        self._l2_sensitivity = l2_sensitivity + g  # snap widening
+        self._sigma = gaussian_sigma(epsilon, delta, self._l2_sensitivity)
+        if key is not None:
+            self.bind_key(key)
+
+    @classmethod
+    def create_from_std_deviation(cls, normalized_stddev: float,
+                                  l2_sensitivity: float,
+                                  snap_grid_bits: Optional[int] = None,
+                                  key=None) -> 'SnappedGaussianMechanism':
+        """normalized_stddev = stddev / l2_sensitivity (PLD accounting).
+
+        Sigma is widened by the same Delta -> Delta + g factor the
+        eps/delta path gets from recalibration, so the PLD-accounted
+        noise-to-sensitivity ratio is preserved for the snapped query.
+        """
+        sigma = normalized_stddev * l2_sensitivity
+        mech = cls.__new__(cls)
+        mech._epsilon = 0.0
+        mech._delta = 0.0
+        mech._raw_sensitivity = l2_sensitivity
+        g = _pow2_round_up(sigma) * 2.0 ** -_SNAP_FRACTION_BITS
+        if snap_grid_bits is not None:
+            g = max(g, 2.0 ** int(snap_grid_bits))
+        mech._grid = g
+        mech._l2_sensitivity = l2_sensitivity + g
+        mech._sigma = sigma * mech._l2_sensitivity / l2_sensitivity
+        if key is not None:
+            mech.bind_key(key)
+        return mech
+
+    def add_noise(self, value: Union[int, float]) -> float:
+        u1, u2 = self._uniforms(2)
+        # Box-Muller on two uniforms in (0, 1): exact standard normal.
+        z = math.sqrt(-2.0 * math.log(u1)) * math.cos(2.0 * math.pi * u2)
+        return self._snap(float(value) + self._sigma * z)
+
+    @property
+    def epsilon(self) -> float:
+        return self._epsilon
+
+    @property
+    def delta(self) -> float:
+        return self._delta
+
+    @property
+    def noise_kind(self) -> NoiseKind:
+        return NoiseKind.GAUSSIAN
+
+    @property
+    def noise_parameter(self) -> float:
+        return self._sigma
+
+    @property
+    def std(self) -> float:
+        return self._sigma
+
+    @property
+    def sensitivity(self) -> float:
+        return self._l2_sensitivity
+
+    def describe(self) -> str:
+        return (f"Snapped Gaussian mechanism:  parameter={self._sigma}  eps="
+                f"{self._epsilon}  delta={self._delta}  l2_sensitivity="
+                f"{self._l2_sensitivity} (raw {self._raw_sensitivity} + "
+                f"grid)  grid={self._grid}")
+
+
+def create_discrete_mechanism(mechanism_spec: budget_accounting.MechanismSpec,
+                              sensitivities: Sensitivities,
+                              *,
+                              value_is_integer: bool = False,
+                              snap_grid_bits: Optional[int] = None,
+                              key=None) -> AdditiveMechanism:
+    """Floating-point-safe AdditiveMechanism from a budget-finalized spec.
+
+    The discrete counterpart of create_additive_mechanism: same
+    MechanismSpec/Sensitivities inputs, same budget accounting (the
+    spec's granted epsilon/delta remain sound upper bounds — the snap
+    widening is absorbed into the noise scale, not charged as extra
+    budget), but every release lands on a declared grid. Integer-valued
+    Laplace queries (value_is_integer=True, e.g. COUNT) get the
+    geometric mechanism on grid 1; real-valued queries get the snapped
+    mechanism of the spec's noise kind. `key` (a threefry PRNGKey) makes
+    the draw stream deterministic per (seed, job); snap_grid_bits floors
+    the snapping grid at 2**snap_grid_bits.
+    """
+    noise_kind = mechanism_spec.mechanism_type.to_noise_kind()
+    if noise_kind == NoiseKind.LAPLACE:
+        if sensitivities.l1 is None:
+            raise ValueError("L1 or (L0 and Linf) sensitivities must be set "
+                             "for the geometric/snapped Laplace mechanism.")
+        if mechanism_spec.standard_deviation_is_set:
+            # normalized_stddev = std / Delta and b = Delta/eps, so
+            # eps = sqrt(2) / normalized_stddev (same inversion as
+            # LaplaceMechanism.create_from_std_deviation).
+            eps = math.sqrt(2.0) / mechanism_spec.noise_standard_deviation
+        else:
+            eps = mechanism_spec.eps
+        if value_is_integer:
+            return GeometricMechanism(eps, sensitivities.l1, key=key)
+        return SnappedLaplaceMechanism(eps, sensitivities.l1,
+                                       snap_grid_bits=snap_grid_bits, key=key)
+
+    if noise_kind == NoiseKind.GAUSSIAN:
+        if sensitivities.l2 is None:
+            raise ValueError("L2 or (L0 and Linf) sensitivities must be set "
+                             "for the snapped Gaussian mechanism.")
+        if mechanism_spec.standard_deviation_is_set:
+            return SnappedGaussianMechanism.create_from_std_deviation(
+                mechanism_spec.noise_standard_deviation, sensitivities.l2,
+                snap_grid_bits=snap_grid_bits, key=key)
+        return SnappedGaussianMechanism(mechanism_spec.eps,
+                                        mechanism_spec.delta,
+                                        sensitivities.l2,
+                                        snap_grid_bits=snap_grid_bits,
+                                        key=key)
+
+    raise AssertionError(f"{noise_kind} not supported.")
+
+
 class ExponentialMechanism:
     """Exponential mechanism for DP parameter choice (reference :662-716)."""
 

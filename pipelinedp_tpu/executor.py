@@ -49,6 +49,7 @@ import numpy as np
 from pipelinedp_tpu import columnar
 from pipelinedp_tpu import combiners as dp_combiners
 from pipelinedp_tpu import dp_computations
+from pipelinedp_tpu import numeric as rt_numeric
 from pipelinedp_tpu.aggregate_params import (AggregateParams, MechanismType,
                                              Metrics, NoiseKind, NormKind)
 from pipelinedp_tpu.ops import noise as noise_ops
@@ -56,6 +57,7 @@ from pipelinedp_tpu.ops import secure_noise
 from pipelinedp_tpu.ops import segment_ops
 from pipelinedp_tpu.ops import selection_ops
 from pipelinedp_tpu.runtime import aot as rt_aot
+from pipelinedp_tpu.runtime import faults as rt_faults
 from pipelinedp_tpu.runtime import observability as rt_observability
 from pipelinedp_tpu.runtime import pipeline as rt_pipeline
 from pipelinedp_tpu.runtime import telemetry as rt_telemetry
@@ -111,6 +113,14 @@ class KernelConfig:
     # counterpart of the reference's PyDP snapped mechanisms
     # (dp_computations.py:131-152).
     secure: bool = False
+    # Accumulation discipline: "fast" is the historical f32
+    # chunked-cumsum path (bit-identical to every pre-existing release);
+    # "safe" accumulates segment sums through a compensated double-word
+    # scan (ops/segment_ops.compensated_cumsum) — exact for
+    # integer-valued contributions up to ~2^48 per partition — and arms
+    # the release sentinel's overflow classification
+    # (pipelinedp_tpu/numeric.py).
+    numeric_mode: str = "fast"
 
 
 SUPPORTED_COLUMNAR_METRICS = (Metrics.COUNT, Metrics.PRIVACY_ID_COUNT,
@@ -444,7 +454,8 @@ def reduce_column_names(cfg: KernelConfig) -> List[str]:
 
 def reduce_rows_to_partitions(spk, keep_row, pair_start, reduce_cols,
                               n_partitions: int, vector_size: int,
-                              presorted: bool = False):
+                              presorted: bool = False,
+                              numeric_mode: str = "fast"):
     """Phase 1b: dense [0, n_partitions) partition columns from the bounded
     row stream.
 
@@ -474,11 +485,20 @@ def reduce_rows_to_partitions(spk, keep_row, pair_start, reduce_cols,
     starts = jnp.searchsorted(spk2, jnp.arange(P + 1, dtype=i32),
                               side='left').astype(i32)
 
-    def seg_reduce(col):
-        cpad = jnp.concatenate(
-            [jnp.zeros(1, col.dtype),
-             segment_ops.chunked_cumsum(col)])
-        return (cpad[starts[1:]] - cpad[starts[:-1]]).astype(f)
+    if numeric_mode == "safe":
+        # Compensated double-word prefixes: segment sums exact for
+        # integer-valued contributions to ~2^48 (vs 2^24 for plain f32),
+        # ~1-2 ulp of a double accumulation for float contributions.
+        def seg_reduce(col):
+            hi, lo = segment_ops.compensated_cumsum(col)
+            return segment_ops.compensated_segment_diff(
+                hi, lo, starts).astype(f)
+    else:
+        def seg_reduce(col):
+            cpad = jnp.concatenate(
+                [jnp.zeros(1, col.dtype),
+                 segment_ops.chunked_cumsum(col)])
+            return (cpad[starts[1:]] - cpad[starts[:-1]]).astype(f)
 
     part_count = (starts[1:] - starts[:-1]).astype(f)
     part_pid_count = seg_reduce(pay2[0])
@@ -509,7 +529,8 @@ def partial_columns(pid: jnp.ndarray, pk: jnp.ndarray, values: jnp.ndarray,
         pid, pk, values, valid, min_v, max_v, min_s, max_s, mid, rows_key,
         cfg)
     cols = reduce_rows_to_partitions(spk, keep_row, pair_start, reduce_cols,
-                                     cfg.n_partitions, cfg.vector_size)
+                                     cfg.n_partitions, cfg.vector_size,
+                                     numeric_mode=cfg.numeric_mode)
     return cols, qrows
 
 
@@ -1515,7 +1536,8 @@ def make_kernel_config(
         n_partitions: int,
         private_selection: bool,
         selection_params: Optional[selection_ops.SelectionParams],
-        secure: bool = False) -> KernelConfig:
+        secure: bool = False,
+        numeric_mode: str = "fast") -> KernelConfig:
     """Builds the static kernel config from aggregation parameters."""
     vector = Metrics.VECTOR_SUM in (params.metrics or [])
     clip_per_value = params.bounds_per_contribution_are_set and not vector
@@ -1567,7 +1589,8 @@ def make_kernel_config(
         tree_height=tree_height,
         branching=branching,
         quantile_chunk=quantile_chunk,
-        secure=secure)
+        secure=secure,
+        numeric_mode=numeric_mode)
 
 
 def kernel_scalars(params: AggregateParams):
@@ -1688,6 +1711,13 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
 
     def generator():
         encoded = _encode_input(backend, rows, data_extractors, public_list)
+        # Chaos ingest seam: the extreme_values fault kind poisons the
+        # encoded value column here — AFTER encoding (so partition/pid
+        # structure is untouched) and BEFORE any driver dispatch (so all
+        # four driver routes see the same poisoned rows).
+        poisoned = rt_faults.maybe_extreme_rows(encoded.values, encoded.pk)
+        if poisoned is not None:
+            encoded = dataclasses.replace(encoded, values=poisoned)
         if Metrics.VECTOR_SUM in (params.metrics or []):
             expected = (params.vector_size,)
             got = encoded.values.shape[1:]
@@ -1701,14 +1731,19 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
                 params.pre_threshold)
         n_partitions = resolve_n_partitions(backend, encoded.n_partitions)
         secure = bool(getattr(backend, "secure_noise", False))
+        numeric_mode = str(getattr(backend, "numeric_mode", "fast"))
         cfg = make_kernel_config(params, compound, n_partitions, private,
-                                 selection_params, secure=secure)
+                                 selection_params, secure=secure,
+                                 numeric_mode=numeric_mode)
         stds = compute_noise_stds(compound, params)
         secure_tables = None
         if secure:
+            snap_bits = getattr(backend, "snap_grid_bits", None)
             thr_hi, thr_lo, gran = secure_noise.build_tables(
                 stds, params.noise_kind,
-                sensitivities=compute_noise_sensitivities(compound, params))
+                sensitivities=compute_noise_sensitivities(compound, params),
+                grid_floor=(None if snap_bits is None
+                            else 2.0 ** int(snap_bits)))
             secure_tables = (jnp.asarray(thr_hi), jnp.asarray(thr_lo),
                              jnp.asarray(gran, dtype=_ftype()))
         key = noise_ops.make_noise_key(getattr(backend, "noise_seed", None))
@@ -1793,12 +1828,20 @@ def lazy_aggregate(backend, col, params: AggregateParams, data_extractors,
         with rt_trace.span("post_process"):
             if fused:
                 n_kept, order, outputs, _ = result
+                # Fail-closed numeric sentinel: one scalar reduction over
+                # the kept released columns BEFORE any value is decoded.
+                rt_numeric.check_release(outputs, n_kept=n_kept,
+                                         numeric_mode=numeric_mode,
+                                         context="dense release")
                 # staticcheck: disable=release-taint — sanctioned release: the compacted ids/columns are the fused kernel's DP-selected partitions and its noised outputs, reordered kept-first inside the program
                 yield from decode_release_results(n_kept, order, outputs,
                                                   encoded.partition_vocab,
                                                   compound)
             else:
                 outputs, keep, _ = result
+                rt_numeric.check_release(outputs, keep=keep,
+                                         numeric_mode=numeric_mode,
+                                         context="dense release (unfused)")
                 # staticcheck: disable=release-taint — sanctioned release: decode_results emits only partitions the fused kernel's DP selection kept, and the output columns carry the kernel's noise
                 yield from decode_results(outputs, keep,
                                           encoded.partition_vocab,

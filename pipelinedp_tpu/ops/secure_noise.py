@@ -51,7 +51,8 @@ def _pow2_ceil(x: float) -> float:
 
 def build_table(std: float, noise_kind: NoiseKind,
                 max_atoms: int = DEFAULT_MAX_ATOMS,
-                sensitivity: float = None
+                sensitivity: float = None,
+                grid_floor: float = None
                 ) -> Tuple[np.ndarray, np.ndarray, float]:
     """Builds the 64-bit fixed-point inverse-CDF table for one noise slot.
 
@@ -82,6 +83,13 @@ def build_table(std: float, noise_kind: NoiseKind,
     if noise_kind not in (NoiseKind.LAPLACE, NoiseKind.GAUSSIAN):
         raise ValueError(f"Unsupported noise kind {noise_kind}")
     g = _pow2_ceil(span * scale / K)
+    if grid_floor is not None and grid_floor > g:
+        # snap_grid_bits knob: a declared power-of-two floor on the
+        # snapping grid. Coarser than the tail-span rule is allowed
+        # (the compensation below re-widens the scale for it); finer is
+        # ignored — the tail-span rule is a soundness bound, not a
+        # preference.
+        g = _pow2_ceil(grid_floor)
     t = scale / g  # noise scale in grid units
     if sensitivity is not None and sensitivity > 0:
         # Snapping-compensated calibration; if the widened scale no longer
@@ -112,7 +120,8 @@ def build_table(std: float, noise_kind: NoiseKind,
 
 
 def build_tables(stds, noise_kind: NoiseKind,
-                 max_atoms: int = DEFAULT_MAX_ATOMS, sensitivities=None):
+                 max_atoms: int = DEFAULT_MAX_ATOMS, sensitivities=None,
+                 grid_floor: float = None):
     """Stacked tables for all noise slots: (S, 2K+1) u32 x2 and (S,) f32."""
     stds = np.asarray(stds, dtype=np.float64)  # staticcheck: disable=host-transfer — graph-build-time table construction on host scalars, O(slots)
     if sensitivities is None:
@@ -120,7 +129,7 @@ def build_tables(stds, noise_kind: NoiseKind,
     his, los, grans = [], [], []
     for std, sens in zip(stds, sensitivities):
         hi, lo, g = build_table(float(std), noise_kind, max_atoms,
-                                sensitivity=sens)
+                                sensitivity=sens, grid_floor=grid_floor)
         his.append(hi)
         los.append(lo)
         grans.append(g)

@@ -83,15 +83,95 @@ def chunked_cumsum(x):
     at O(n/B + B). Exact passthrough on integer or f64 inputs.
     """
     if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.float64:
-        return jnp.cumsum(x)
+        return jnp.cumsum(x, dtype=x.dtype)
     n = x.shape[0]
     chunks = 1
     while chunks < 256 and (n % (chunks * 2) == 0) and n // (chunks * 2) >= 64:
         chunks *= 2
     if chunks == 1:
-        return jnp.cumsum(x)
+        return jnp.cumsum(x, dtype=x.dtype)
     xr = x.reshape(chunks, -1)
-    cs = jnp.cumsum(xr, axis=1)
+    cs = jnp.cumsum(xr, axis=1, dtype=x.dtype)
     totals = cs[:, -1]
-    offsets = jnp.cumsum(totals) - totals
+    offsets = jnp.cumsum(totals, dtype=x.dtype) - totals
     return (cs + offsets[:, None]).reshape(n)
+
+
+def _two_sum(a, b):
+    """Knuth TwoSum: s + e == a + b exactly (s = fl(a+b), e the residue)."""
+    s = a + b
+    bv = s - a
+    av = s - bv
+    e = (a - av) + (b - bv)
+    return s, e
+
+
+def _comp_combine(x, y):
+    """Associative combiner over compensated (hi, lo) partial sums.
+
+    (h, e) = TwoSum(h1, h2) carries the exact rounding residue of the
+    high-word addition into the low word; the low words add in plain
+    float (their own rounding is second-order: O(u^2) per combine).
+    """
+    h1, l1 = x
+    h2, l2 = y
+    h, e = _two_sum(h1, h2)
+    return h, e + (l1 + l2)
+
+
+def compensated_cumsum(x):
+    """Compensated (double-word) cumulative sum: (hi, lo) prefix arrays.
+
+    hi[i] + lo[i] tracks sum(x[:i+1]) to ~2 ulps of a double-precision
+    accumulation — in particular EXACT for integer-valued f32 inputs up
+    to ~2^48 per prefix, where a plain f32 cumsum silently loses
+    low-order contributions past 2^24. O(n log n) work as an
+    associative scan; the fused kernels' "safe" numeric mode builds
+    segment sums from these prefixes (executor.reduce_rows_to_partitions).
+    """
+    if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.float64:
+        return jnp.cumsum(x, dtype=x.dtype), jnp.zeros_like(x)
+    hi, lo = jax.lax.associative_scan(_comp_combine, (x, jnp.zeros_like(x)))
+    return hi, lo
+
+
+def compensated_segment_diff(hi, lo, starts):
+    """Segment sums from compensated prefixes: hi/lo at starts boundaries.
+
+    TwoSum of (hi_end, -hi_start) recovers the high-word difference
+    exactly; adding the residue and the low-word difference keeps
+    segment sums exact wherever the prefixes were (integer-valued
+    segments up to ~2^48).
+    """
+    zero = jnp.zeros((1,), hi.dtype)
+    hp = jnp.concatenate([zero, hi])
+    lp = jnp.concatenate([zero, lo])
+    h_end, h_start = hp[starts[1:]], hp[starts[:-1]]
+    d, e = _two_sum(h_end, -h_start)
+    comp = d + (e + (lp[starts[1:]] - lp[starts[:-1]]))
+    # An overflowed prefix turns the TwoSum residues into Inf - Inf =
+    # NaN; fall back to the plain high-word difference there so overflow
+    # reaches the release sentinel as Inf (a typed overflow), not as
+    # manufactured NaN.
+    plain = h_end - h_start
+    return jnp.where(jnp.isfinite(comp), comp, plain)
+
+
+def compensated_psum(x, axis_name):
+    """Compensated cross-shard sum of per-shard float partials.
+
+    A plain lax.psum combines shard partials in arbitrary tree order at
+    working precision — re-introducing exactly the rounding error the
+    safe-mode segment sums just removed (a +1.0 partial on one shard
+    vanishes next to a 2**24 partial on another). Gathers the partials
+    and folds them through the TwoSum combiner over the shard axis
+    instead: one [n_shards, ...] all_gather replaces the psum, and the
+    result is the correctly-rounded sum of the partials. Integer and f64
+    partials keep the plain psum (already exact / already wide).
+    """
+    if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.float64:
+        return jax.lax.psum(x, axis_name)
+    g = jax.lax.all_gather(x, axis_name, axis=0)
+    hi, lo = jax.lax.associative_scan(_comp_combine,
+                                      (g, jnp.zeros_like(g)), axis=0)
+    return hi[-1] + lo[-1]

@@ -74,6 +74,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from pipelinedp_tpu import executor
+from pipelinedp_tpu import numeric as rt_numeric
+from pipelinedp_tpu.ops import segment_ops
 # Canonical shape arithmetic lives with the mesh helpers; re-exported here
 # because the blocked path made the name public first.
 from pipelinedp_tpu.parallel.mesh import host_fetch, round_capacity
@@ -181,9 +183,16 @@ def _block_trace(spk_s, pair_s, cols_s, leaf_s, lo, length, base, min_v,
     dense = executor.reduce_rows_to_partitions(spk_rel, valid, pair, cols,
                                                cfg.n_partitions,
                                                cfg.vector_size,
-                                               presorted=True)
+                                               presorted=True,
+                                               numeric_mode=cfg.numeric_mode)
     if psum_axis is not None:
-        dense = jax.tree.map(lambda x: jax.lax.psum(x, psum_axis), dense)
+        if cfg.numeric_mode == "safe":
+            # Compensated cross-shard combine: a plain psum would re-round
+            # away what the compensated segment sums just preserved.
+            dense = jax.tree.map(
+                lambda x: segment_ops.compensated_psum(x, psum_axis), dense)
+        else:
+            dense = jax.tree.map(lambda x: jax.lax.psum(x, psum_axis), dense)
     outputs, keep, _ = executor.finalize(dense, min_v, mid, stds, key, cfg,
                                          secure_tables)
     if cfg.quantiles:
@@ -870,6 +879,11 @@ def aggregate_blocked_sharded(mesh,
     """
     from pipelinedp_tpu.parallel.reshard import stage_rows_to_mesh
 
+    # Chaos ingest seam (no-op without an active extreme_values fault).
+    _poisoned = rt_faults.maybe_extreme_rows(values, pk)
+    if _poisoned is not None:
+        values = _poisoned
+
     P = cfg.n_partitions
     n_shards = mesh.devices.size
     pid, pk, values, valid = stage_rows_to_mesh(
@@ -930,6 +944,13 @@ def aggregate_blocked_sharded(mesh,
                 drain.end_block()
                 return
             n_kept, ids_sorted, outputs_sorted = result
+            # Fail-closed sentinel BEFORE the journal persist: a
+            # numerically poisoned block must never become a durable
+            # record a later replay would release.
+            rt_numeric.check_release(
+                outputs_sorted, n_kept=n_kept,
+                numeric_mode=cfg.numeric_mode,
+                context=f"blocked meshed release (base {b_base})")
             k = int(n_kept)  # sync; gates O(kept) transfers
             if journal is not None:
                 record = _materialize_block_record(ids_sorted,
@@ -1395,6 +1416,10 @@ def aggregate_blocked(pid,
     """
     profiling = phase_times is not None
     t0 = time.perf_counter()
+    # Chaos ingest seam (no-op without an active extreme_values fault).
+    _poisoned = rt_faults.maybe_extreme_rows(values, pk)
+    if _poisoned is not None:
+        values = _poisoned
     P = cfg.n_partitions
     device_resident = isinstance(pid, jax.Array)
     if device_resident:
@@ -1504,6 +1529,13 @@ def aggregate_blocked(pid,
                 drain.end_block()
                 return
             n_kept, ids_sorted, outputs_sorted = result
+            # Fail-closed sentinel BEFORE the journal persist: a
+            # numerically poisoned block must never become a durable
+            # record a later replay would release.
+            rt_numeric.check_release(
+                outputs_sorted, n_kept=n_kept,
+                numeric_mode=cfg.numeric_mode,
+                context=f"blocked release (base {b_base})")
             ts = time.perf_counter()
             k = int(n_kept)  # sync; gates O(kept) transfers
             ta = time.perf_counter()

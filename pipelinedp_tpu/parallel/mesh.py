@@ -162,7 +162,7 @@ def collective_heartbeat(devices: Sequence) -> set:
         NamedSharding(mesh, PartitionSpec(SHARD_AXIS)))
 
     def per_shard(x):
-        return jax.lax.psum(jnp.sum(x), SHARD_AXIS)
+        return jax.lax.psum(jnp.sum(x, dtype=x.dtype), SHARD_AXIS)
 
     fn = shard_map(per_shard, mesh=mesh,
                    in_specs=PartitionSpec(SHARD_AXIS),

@@ -36,11 +36,13 @@ from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
 from pipelinedp_tpu import executor
+from pipelinedp_tpu.ops import segment_ops
 from pipelinedp_tpu.ops import selection_ops
 from pipelinedp_tpu.parallel.mesh import SHARD_AXIS, round_capacity, shard_map
 from pipelinedp_tpu.parallel.reshard import stage_rows_to_mesh
 from pipelinedp_tpu.runtime import aot as rt_aot
 from pipelinedp_tpu.runtime import entry as rt_entry
+from pipelinedp_tpu.runtime import faults as rt_faults
 from pipelinedp_tpu.runtime import retry as rt_retry
 from pipelinedp_tpu.runtime import trace as rt_trace
 
@@ -156,6 +158,18 @@ def shard_rows_by_pid(pid: np.ndarray, pk: np.ndarray, values: np.ndarray,
     return out_pid, out_pk, out_values, out_valid
 
 
+def _combine_partials(cols, cfg):
+    """One psum combines the shards' partial columns; numeric_mode="safe"
+    routes float partials through the compensated cross-shard sum so the
+    combine cannot re-introduce the rounding the compensated segment
+    sums removed. cfg.numeric_mode is static, so the default mode
+    compiles the identical psum program it always has."""
+    if cfg.numeric_mode == "safe":
+        return jax.tree.map(
+            lambda x: segment_ops.compensated_psum(x, SHARD_AXIS), cols)
+    return jax.tree.map(lambda x: jax.lax.psum(x, SHARD_AXIS), cols)
+
+
 @partial(jax.jit, static_argnames=("cfg", "mesh"))
 def _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
                     stds, rng_key, cfg: executor.KernelConfig, mesh: Mesh,
@@ -169,7 +183,7 @@ def _sharded_kernel(pid, pk, values, valid, min_v, max_v, min_s, max_s, mid,
         cols, qrows = executor.partial_columns(pid_s, pk_s, values_s, valid_s,
                                                min_v, max_v, min_s, max_s,
                                                mid, shard_rows_key, cfg)
-        cols = jax.tree.map(lambda x: jax.lax.psum(x, SHARD_AXIS), cols)
+        cols = _combine_partials(cols, cfg)
         outputs, keep, row_count = executor.finalize(cols, min_v, mid, stds_r,
                                                      final_key, cfg, tables_r)
         if cfg.quantiles:
@@ -209,7 +223,7 @@ def _sharded_release_kernel(pid, pk, values, valid, min_v, max_v, min_s,
         cols, qrows = executor.partial_columns(pid_s, pk_s, values_s, valid_s,
                                                min_v, max_v, min_s, max_s,
                                                mid, shard_rows_key, cfg)
-        cols = jax.tree.map(lambda x: jax.lax.psum(x, SHARD_AXIS), cols)
+        cols = _combine_partials(cols, cfg)
         outputs, keep, row_count = executor.finalize(cols, min_v, mid, stds_r,
                                                      final_key, cfg, tables_r)
         if cfg.quantiles:
@@ -312,8 +326,7 @@ def _sharded_batched_release_kernel(pid, pk, values, valid, min_v, max_v,
             cols, qrows = executor.partial_columns(
                 pid_l, pk_l, values_l, valid_l, min_v, max_v, min_s,
                 max_s, mid, shard_rows_key, cfg)
-            cols = jax.tree.map(lambda x: jax.lax.psum(x, SHARD_AXIS),
-                                cols)
+            cols = _combine_partials(cols, cfg)
             outputs, keep, row_count = executor.finalize(
                 cols, min_v, mid, stds_r, final_key, cfg, tables_r)
             if cfg.quantiles:
@@ -515,6 +528,10 @@ def sharded_aggregate_arrays(mesh: Mesh, pid, pk, values, valid, min_v, max_v,
     single-device fused kernel (the finalize/noise key is replicated, so
     every geometry releases the same noise).
     """
+    # Chaos ingest seam (no-op without an active extreme_values fault).
+    _poisoned = rt_faults.maybe_extreme_rows(values, pk)
+    if _poisoned is not None:
+        values = _poisoned
     pid, pk, values, valid = stage_rows_to_mesh(
         mesh, pid, pk, values, valid, reshard,
         values_dtype=np.dtype(executor._ftype()))

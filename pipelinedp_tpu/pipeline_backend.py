@@ -499,6 +499,22 @@ class TPUBackend(LocalBackend):
             atomically (write-then-rename, never torn) to this file
             every ~250ms. Combinable with metrics_port; None (default)
             writes nothing.
+        numeric_mode: accumulation arithmetic discipline for the fused
+            release kernels (pipelinedp_tpu/numeric.py). "fast" (the
+            default) keeps the historical f32 segment reduction —
+            bit-identical programs, the release sentinel only refuses
+            NaN/Inf. "safe" switches segment sums to a compensated
+            (TwoSum hi/lo) associative scan — exact for integer-valued
+            workloads to ~2**48 — and arms the sentinel's overflow
+            classification: saturation raises a typed
+            NumericOverflowError, the release fails closed (nothing
+            decoded, nothing journaled, budget settled conservatively).
+        snap_grid_bits: floor exponent for the power-of-two snapping
+            grid used by the discrete/snapped mechanisms and the
+            secure-noise tables: releases land on multiples of
+            max(mechanism grid, 2**snap_grid_bits). None (default)
+            leaves the mechanism-chosen grid alone; coarser grids cost
+            sensitivity (the snap widens Δ by one grid unit).
     """
 
     def __init__(self,
@@ -527,7 +543,9 @@ class TPUBackend(LocalBackend):
                  coordinator_address: Optional[str] = None,
                  num_processes: Optional[int] = None,
                  metrics_port: Optional[int] = None,
-                 metrics_path: Optional[str] = None):
+                 metrics_path: Optional[str] = None,
+                 numeric_mode: str = "fast",
+                 snap_grid_bits: Optional[int] = None):
         super().__init__(seed=noise_seed)
         if reshard not in ("auto", "host", "device"):
             raise ValueError(
@@ -571,6 +589,10 @@ class TPUBackend(LocalBackend):
         if metrics_path is not None:
             input_validators.validate_metrics_path(
                 metrics_path, "TPUBackend")
+        input_validators.validate_numeric_mode(numeric_mode, "TPUBackend")
+        if snap_grid_bits is not None:
+            input_validators.validate_snap_grid_bits(
+                snap_grid_bits, "TPUBackend")
         if (coordinator_address is None) != (num_processes is None):
             raise ValueError(
                 "TPUBackend: coordinator_address and num_processes must "
@@ -610,6 +632,8 @@ class TPUBackend(LocalBackend):
         self.num_processes = num_processes
         self.metrics_port = metrics_port
         self.metrics_path = metrics_path
+        self.numeric_mode = numeric_mode
+        self.snap_grid_bits = snap_grid_bits
         if trace:
             from pipelinedp_tpu.runtime import trace as rt_trace
             rt_trace.enable()
@@ -673,7 +697,9 @@ class TPUBackend(LocalBackend):
             overlap_drain=self.overlap_drain,
             pipeline_depth=self.pipeline_depth,
             encode_threads=self.encode_threads,
-            encode_mode=self.encode_mode)
+            encode_mode=self.encode_mode,
+            numeric_mode=self.numeric_mode,
+            snap_grid_bits=self.snap_grid_bits)
 
     def dump_trace(self, path: str, job_id: Optional[str] = None) -> str:
         """Writes the recorded trace as Chrome/Perfetto trace-event JSON

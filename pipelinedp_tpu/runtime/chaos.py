@@ -77,6 +77,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from pipelinedp_tpu import numeric as rt_numeric
 from pipelinedp_tpu import pipeline_backend
 from pipelinedp_tpu.runtime import drill as drill_lib
 from pipelinedp_tpu.runtime import faults
@@ -118,7 +119,8 @@ SERVICE_POOL = ("disk_full", "fsync_failure", "restart_during_persist")
 DRIVER_POOL = ("dispatch", "consume", "oom", "slow", "hang", "fatal",
                "corrupt", "device_loss", "collective",
                "host_join_failure", "restart_during_persist",
-               "disk_full", "fsync_failure", "io_error")
+               "disk_full", "fsync_failure", "io_error",
+               "extreme_values")
 
 ALL_KINDS = tuple(sorted(set(SERVICE_POOL) | set(DRIVER_POOL)))
 
@@ -130,7 +132,8 @@ _TYPED_DRIVER_ERRORS = (faults.InjectedFault,
                         rt_watchdog.BlockTimeoutError,
                         rt_journal.StorageUnavailableError,
                         rt_retry.BlockOOMError,
-                        rt_retry.MeshDegradationError)
+                        rt_retry.MeshDegradationError,
+                        rt_numeric.ReleaseIntegrityError)
 
 # End-to-end ceiling on one service-phase attempt (mirrors the drill's
 # pacing handshake; generous — CPU attempts settle in seconds).
@@ -261,6 +264,20 @@ class ChaosCampaign:
             # block index (journal.put/get pass block=0).
             kwargs["point"] = "block"
             block = None
+        elif kind == "extreme_values":
+            # Ingest-seam fault, consumed once before any block exists
+            # (hooks pass block=0). Campaigns inject NaN only: NaN
+            # survives value clipping, so the poisoned partition either
+            # trips the release sentinel (typed, pre-journal — nothing
+            # durable diverges) or is dropped unkept by selection with a
+            # record identical to the baseline's. Finite "magnitude"
+            # poison would clip to the workload bounds and release a
+            # finite-but-divergent value, breaking the final-clean-run
+            # bit-identity invariant by construction — pinned trials
+            # exercise it without a baseline comparison instead.
+            kwargs["mode"] = "nan"
+            block = None
+            times = 1
         return faults.Fault(kind, block=block, times=times, **kwargs)
 
     def __iter__(self):

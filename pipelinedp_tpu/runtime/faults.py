@@ -106,6 +106,17 @@ Fault kinds and the exception they raise:
                                       block re-dispatches under the
                                       same key. `point`: odometer |
                                       block.
+  extreme_values
+              (no exception)          silently poisons the encoded value
+                                      column at the ingest seam — every
+                                      row of one partition becomes NaN
+                                      (`mode`: "nan", default) or a
+                                      ±1e38/denormal near-overflow
+                                      pattern ("magnitude") — the
+                                      release-sentinel test case: the
+                                      drivers must fail CLOSED with a
+                                      typed ReleaseIntegrityError, never
+                                      release a poisoned column.
 
 Most schedules are thread-local (inject()); the rolling-restart drill
 injects with scope="process" so faults scheduled from the drill thread
@@ -247,8 +258,10 @@ class Fault:
         block — which journal persist/read the fault targets) only —
         restrict to one hook site; None fires at whichever site reaches
         it first.
-    mode: "corrupt" only — "flip" (default) flips one payload byte,
-        "truncate" cuts the file in half.
+    mode: "corrupt" — "flip" (default) flips one payload byte,
+        "truncate" cuts the file in half. "extreme_values" — "nan"
+        (default) poisons one partition's rows with NaN, "magnitude"
+        injects a ±3e38/1e38/denormal near-overflow pattern.
     device: "device_loss" only — global jax device id of the lost chip.
         None = the liveness probe marks the highest-id still-live device
         of the probed mesh as dead (deterministic without naming ids).
@@ -267,10 +280,15 @@ class Fault:
     process: Optional[int] = None  # kind == "device_loss" only
 
     def __post_init__(self):
-        if self.kind not in set(_RAISES) | {"slow", "hang", "corrupt"}:
+        if self.kind not in set(_RAISES) | {"slow", "hang", "corrupt",
+                                            "extreme_values"}:
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.times <= 0:
             raise ValueError("times must be positive")
+        if self.kind == "extreme_values" and self.mode == "flip":
+            # The shared default ("flip") belongs to corrupt; this
+            # kind's own default poison is NaN.
+            self.mode = "nan"
         allowed_points = {
             "device_loss": ("dispatch", "collective"),
             "restart_during_persist": ("odometer", "block"),
@@ -280,8 +298,11 @@ class Fault:
         }.get(self.kind, ("dispatch", "drain", "collective"))
         if self.point is not None and self.point not in allowed_points:
             raise ValueError(f"unknown {self.kind} point {self.point!r}")
-        if self.mode not in ("flip", "truncate"):
-            raise ValueError(f"unknown corrupt mode {self.mode!r}")
+        allowed_modes = (("nan", "magnitude")
+                         if self.kind == "extreme_values" else
+                         ("flip", "truncate"))
+        if self.mode not in allowed_modes:
+            raise ValueError(f"unknown {self.kind} mode {self.mode!r}")
         if self.process is not None:
             if self.kind != "device_loss":
                 raise ValueError("process= is a device_loss field")
@@ -527,3 +548,49 @@ def maybe_corrupt(path: str, block: int = 0) -> None:
             f.write(bytes([byte[0] ^ 0xFF]) if byte else b"\xff")
     logging.warning("injected %s corruption into journal record %s",
                     fault.mode, path)
+
+
+# Near-overflow poison pattern for extreme_values mode="magnitude":
+# values inside the f32 range whose bounded sums overflow to Inf, plus a
+# denormal that stresses low-order accumulation. (NaN mode is the
+# campaign default: NaN survives clipping, so the sentinel—not a silently
+# divergent clipped release—catches the poison.)
+_EXTREME_PATTERN = (3e38, -3e38, 1e38, 1e-40)
+
+
+def maybe_extreme_rows(values, pk, block: int = 0):
+    """Hook point for 'extreme_values' faults at the ingest seam.
+
+    Returns a poisoned COPY of the value column (never mutates the
+    input — callers may cache the original across re-entries), or None
+    when nothing is scheduled. Poison targets every row of the first
+    real partition (pk >= 0): "nan" mode writes NaN, "magnitude" cycles
+    a ±3e38/1e38/denormal near-overflow pattern.
+    """
+    schedule = active()
+    if schedule is None:
+        return None
+    fault = schedule.take("extreme_values", block)
+    if fault is None:
+        return None
+    telemetry.record("injected_faults")
+    import numpy as np
+    is_device = type(values).__module__.startswith("jax")
+    pk_np = np.asarray(pk)
+    vals = np.array(values, copy=True)
+    rows = np.nonzero(pk_np >= 0)[0]
+    if rows.size:
+        target = np.nonzero(pk_np == pk_np[rows[0]])[0]
+        if fault.mode == "magnitude":
+            pat = np.asarray(_EXTREME_PATTERN, dtype=vals.dtype)[
+                np.arange(target.size) % len(_EXTREME_PATTERN)]
+            vals[target] = pat if vals.ndim == 1 else pat[:, None]
+        else:
+            vals[target] = np.nan
+    logging.warning("injected extreme_values (%s) into partition of %d "
+                    "row(s) at block %d", fault.mode, rows.size and
+                    int((pk_np == pk_np[rows[0]]).sum()), block)
+    if is_device:
+        import jax.numpy as jnp
+        return jnp.asarray(vals)
+    return vals

@@ -207,6 +207,21 @@ REGISTRY: Dict[str, Metric] = {
                  "chaos-campaign trials executed (runtime/chaos.py: one "
                  "seeded composed-fault schedule run under the full "
                  "invariant suite per trial)"),
+        _counter("release_sentinel_trips",
+                 "releases refused by the fail-closed numeric sentinel "
+                 "(pipelinedp_tpu/numeric.check_release): a released "
+                 "column carried NaN/Inf/saturation and the job failed "
+                 "typed (ReleaseIntegrityError) with nothing released"),
+        _counter("numeric_overflows",
+                 "sentinel trips classified as accumulator overflow in "
+                 "numeric_mode='safe' (Inf or near-dtype-max saturation "
+                 "-> typed NumericOverflowError instead of a wrapped or "
+                 "rounded release)"),
+        _counter("snapped_releases",
+                 "values released through the floating-point-safe "
+                 "discrete/snapped host mechanisms (geometric counts, "
+                 "snapped Laplace/Gaussian sums — "
+                 "dp_computations.create_discrete_mechanism)"),
         _counter("chaos_invariant_failures",
                  "chaos trials that FAILED an invariant (lost/duplicated "
                  "jobs, ledger mismatch, double-spend, nondeterminism, "

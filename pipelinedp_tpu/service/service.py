@@ -56,6 +56,7 @@ from pipelinedp_tpu import budget_accounting
 from pipelinedp_tpu import dp_engine
 from pipelinedp_tpu import executor
 from pipelinedp_tpu import input_validators
+from pipelinedp_tpu import numeric as rt_numeric
 from pipelinedp_tpu import pipeline_backend
 from pipelinedp_tpu.data_extractors import DataExtractors
 from pipelinedp_tpu.parallel import sharded
@@ -863,10 +864,19 @@ class DPAggregationService:
             else:
                 job.ledger.release(job.job_id)
             rt_observability.prune_odometer(accountant=accountant)
+            # A numeric-sentinel refusal surfaces through the shed path
+            # (handle.was_shed + service_jobs_shed) so callers and
+            # dashboards see "refused before release" rather than an
+            # anonymous failure — but unlike a storage shed the grant
+            # settles conservatively above (mechanisms were registered;
+            # forfeiting over-counts, which is privacy-safe).
+            shed = isinstance(e, rt_numeric.ReleaseIntegrityError)
+            if shed:
+                rt_telemetry.record("service_jobs_shed")
             # Fail the handle BEFORE formatting the log line: a
             # formatting surprise must never leave the caller blocked
             # in result() with the ledger already settled.
-            job.handle._fail(e)
+            job.handle._fail(e, shed=shed)
             logging.warning(
                 "service: job %s for tenant %s failed (%s: %s); "
                 "admission grant %s.", job.job_id, job.tenant_id,

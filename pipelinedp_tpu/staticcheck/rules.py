@@ -11,6 +11,7 @@ all of them.
 
 import ast
 import collections
+import math
 import re
 from typing import Callable, Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
@@ -400,6 +401,105 @@ def host_transfer(modules: List[Module]) -> Iterator[Finding]:
                 yield from visit(child)
 
         yield from visit(mod.tree)
+
+
+# ---------------------------------------------------------------------------
+# (3b) dtype-discipline
+# ---------------------------------------------------------------------------
+
+# Reductions whose accumulator dtype defaults to the input dtype: on an
+# f32 column that is an implicit f32 accumulator — the exact overflow /
+# precision-loss channel the numeric-armor sentinel exists to catch.
+_ACCUM_REDUCTIONS = frozenset({
+    "jax.numpy.sum", "jax.numpy.cumsum", "jax.numpy.prod",
+})
+_NARROW_INT_DTYPES = frozenset({
+    "int8", "int16", "int32", "uint8", "uint16", "uint32",
+})
+
+
+def _astype_target_leaf(call: ast.Call, mod: Module) -> Optional[str]:
+    """The dtype leaf name of an ``.astype(X)`` call, if determinable."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    name = mod.dotted(arg)
+    if name:
+        return name.rsplit(".", 1)[-1]
+    return None
+
+
+@rule(
+    "dtype-discipline",
+    "Numeric dtype discipline in device-resident modules (parallel/, "
+    "ops/, runtime/pipeline.py): reductions (jnp.sum/jnp.cumsum/"
+    "jnp.prod) must declare their accumulator — dtype= or an explicit "
+    ".astype on the operand — because an implicit f32 accumulator "
+    "silently loses integer exactness past 2**24 and wraps at scale; "
+    "fractional float literals must not be ==/!= compared against "
+    "computed values (an accumulated or noised float is never reliably "
+    "equal to a decimal literal — compare integers or use a tolerance); "
+    "and a reduction must not be .astype-narrowed to an integer dtype "
+    "in the same expression (probe or clip the accumulator first, or "
+    "suppress with the proven range).")
+def dtype_discipline(modules: List[Module]) -> Iterator[Finding]:
+    for mod in modules:
+        if not _is_device_resident(mod):
+            continue
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                name = mod.dotted(node.func) or ""
+                if name in _ACCUM_REDUCTIONS:
+                    has_dtype = any(kw.arg == "dtype"
+                                    for kw in node.keywords)
+                    operand_cast = bool(node.args) and (
+                        isinstance(node.args[0], ast.Call) and
+                        isinstance(node.args[0].func, ast.Attribute) and
+                        node.args[0].func.attr == "astype")
+                    if not has_dtype and not operand_cast:
+                        leaf = name.rsplit(".", 1)[-1]
+                        yield Finding(
+                            "dtype-discipline", mod.rel, node.lineno,
+                            f"jnp.{leaf}() without an explicit accumulator "
+                            f"dtype in a device-resident module — an "
+                            f"implicit f32 accumulator loses integer "
+                            f"exactness past 2**24; pass dtype= (or cast "
+                            f"the operand with .astype) to make the "
+                            f"accumulation width a reviewed decision")
+                elif (isinstance(node.func, ast.Attribute) and
+                      node.func.attr == "astype" and
+                      isinstance(node.func.value, ast.Call) and
+                      (mod.dotted(node.func.value.func) or "")
+                      in _ACCUM_REDUCTIONS):
+                    target = _astype_target_leaf(node, mod)
+                    if target in _NARROW_INT_DTYPES:
+                        yield Finding(
+                            "dtype-discipline", mod.rel, node.lineno,
+                            f"reduction result .astype({target}) in one "
+                            f"expression — the accumulator is truncated "
+                            f"un-probed; check the range (or clip) before "
+                            f"narrowing, or suppress with the proven "
+                            f"bound")
+            elif isinstance(node, ast.Compare):
+                operands = [node.left] + list(node.comparators)
+                frac_lit = any(
+                    isinstance(o, ast.Constant) and
+                    isinstance(o.value, float) and
+                    math.isfinite(o.value) and
+                    o.value != int(o.value)
+                    for o in operands)
+                if frac_lit and any(isinstance(op, (ast.Eq, ast.NotEq))
+                                    for op in node.ops):
+                    yield Finding(
+                        "dtype-discipline", mod.rel, node.lineno,
+                        "==/!= against a fractional float literal in a "
+                        "device-resident module — computed f32 values "
+                        "(accumulated, noised, rescaled) are never "
+                        "reliably equal to a decimal literal; compare "
+                        "integers, exact sentinels (0.0), or use a "
+                        "tolerance")
 
 
 # ---------------------------------------------------------------------------
@@ -851,6 +951,12 @@ KNOB_VALIDATORS: Dict[str, str] = {
     # and is validated at its own API boundary
     # (DPAggregationService.submit).
     "deadline_s": "validate_deadline_s",
+    # Numeric-armor knobs (PR 19): the accumulation discipline decides
+    # whether overflow wraps or fails closed, and the snapping-grid
+    # floor changes which values a release can legally take — both are
+    # release semantics, validated in TPUBackend.__init__.
+    "numeric_mode": "validate_numeric_mode",
+    "snap_grid_bits": "validate_snap_grid_bits",
 }
 
 # Data-plane parameters: configuration, not failure semantics — adding

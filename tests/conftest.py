@@ -101,6 +101,12 @@ def pytest_configure(config):
         "jobs, bit-exact ledgers, bit-identical results), "
         "storage-fault hardening and the delta-debugging schedule "
         "minimizer (tier-1, NOT slow; select alone with -m chaos)")
+    config.addinivalue_line(
+        "markers",
+        "numeric_armor: overflow-safe accumulation, the fail-closed "
+        "release sentinel, discrete/snapped noise and the "
+        "extreme_values fault kind (tier-1, NOT slow; select alone "
+        "with -m numeric_armor)")
 
 
 @pytest.fixture(autouse=True)

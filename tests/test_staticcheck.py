@@ -219,6 +219,19 @@ POSITIVE = {
             "    keys = set(col)\n"
             "    return [(k, 1) for k in keys]\n"),
     },
+    "dtype-discipline": {
+        # All three sub-patterns: an implicit f32 accumulator, a
+        # fractional float-literal equality, and a reduction narrowed
+        # to int32 in one expression.
+        "pipelinedp_tpu/ops/fix_dtype.py": (
+            "import jax.numpy as jnp\n"
+            "def f(x):\n"
+            "    total = jnp.sum(x)\n"
+            "    ids = jnp.cumsum(x).astype(jnp.int32)\n"
+            "    if total == 0.5:\n"
+            "        return ids\n"
+            "    return total\n"),
+    },
 }
 
 SUPPRESSED = {
@@ -360,6 +373,15 @@ SUPPRESSED = {
             "    return [(k, 1) for k in keys]  "
             "# staticcheck: disable=determinism — fixture: sanctioned "
             "unordered debug release, gated off in production\n"),
+    },
+    "dtype-discipline": {
+        "pipelinedp_tpu/ops/fix_dtype.py": (
+            "import jax.numpy as jnp\n"
+            "def f(x):\n"
+            "    total = jnp.sum(x)  "
+            "# staticcheck: disable=dtype-discipline — fixture: bool "
+            "mask popcount, bounded by the block size\n"
+            "    return total\n"),
     },
 }
 
@@ -540,6 +562,24 @@ CLEAN = {
             "def lazy_aggregate(backend, col):\n"
             "    keys = sorted(set(col))\n"
             "    return [(k, 1) for k in keys]\n"),
+    },
+    "dtype-discipline": {
+        # Declared accumulators (dtype= / operand .astype), an exact
+        # integral-float sentinel compare, probed narrowing, and a
+        # non-device module where the rule does not apply at all.
+        "pipelinedp_tpu/ops/fix_dtype.py": (
+            "import jax.numpy as jnp\n"
+            "def f(x):\n"
+            "    total = jnp.sum(x, dtype=x.dtype)\n"
+            "    ids = jnp.cumsum(x.astype(jnp.int32))\n"
+            "    if total == 0.0:\n"
+            "        return ids\n"
+            "    wide = jnp.sum(x, dtype=jnp.float64)\n"
+            "    return wide.astype(jnp.int32)\n"),
+        "pipelinedp_tpu/fix_dtype_host.py": (
+            "import jax.numpy as jnp\n"
+            "def g(x):\n"
+            "    return jnp.sum(x)\n"),
     },
 }
 
