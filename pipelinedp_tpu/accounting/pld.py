@@ -40,6 +40,16 @@ _TAIL_MASS = 1e-15
 # without the cap, eps0=1e4 would need a 1e8-cell grid and overflow exp().
 _MAX_FINITE_LOSS = 80.0
 
+# The suffix-sum delta query computes e^eps in extended precision, which
+# overflows past ~11356; queries beyond this (privacy-meaningless, only
+# reachable on huge composed grids) take the direct-scan path instead.
+_FAST_QUERY_MAX_EPS = 11000.0
+
+# e^{-l} for grid losses below this saturates even extended precision;
+# the suffix weights treat such cells as unqueryable and the (equally
+# privacy-meaningless) queries that would land there take the scan path.
+_FAST_QUERY_MIN_LOSS = -700.0
+
 
 def _norm_cdf(z):
     return 0.5 * special.erfc(-np.asarray(z, dtype=np.float64) / math.sqrt(2))
@@ -58,6 +68,12 @@ class PrivacyLossDistribution:
         self._lower_index = lower_index
         self._interval = interval
         self._infinity_mass = float(infinity_mass)
+        # Lazily computed suffix tail-sums (see _tail_sums). Lock-free
+        # lazy publish: concurrent computes derive identical arrays from
+        # the immutable pmf and the single reference assignment is
+        # atomic (the deliberately-undeclared single-writer pattern of
+        # runtime/concurrency.py).
+        self._tails = None
 
     @property
     def interval(self) -> float:
@@ -108,8 +124,41 @@ class PrivacyLossDistribution:
                 base = base.compose(base)
         return result
 
-    def get_delta_for_epsilon(self, epsilon: float) -> float:
-        """Hockey-stick divergence at the given epsilon."""
+    def _tail_sums(self):
+        """Suffix tail-sums powering the O(log L) delta query.
+
+        With A[j] = sum_{i>=j} p_i and B[j] = sum_{i>=j} p_i * e^{-l_i},
+        the hockey-stick divergence collapses to
+            delta(eps) = inf_mass + A[j] - e^eps * B[j]
+        where j is the first grid index whose loss exceeds eps — an O(1)
+        arithmetic index on the uniform grid plus two lookups, instead
+        of a full-grid mask + sum per probe. Accumulated in extended
+        precision (np.longdouble: 80-bit on x86-64) so the collapsed
+        form agrees with the direct scan well past 1e-9 even on
+        million-cell composed grids. Returns (A, B, exact_from): cells
+        below ``exact_from`` carry losses so negative that e^{-l}
+        saturates — queries landing there fall back to the scan.
+        """
+        tails = self._tails
+        if tails is None:
+            losses = self.losses
+            probs = self._probs.astype(np.longdouble)
+            finite = losses > _FAST_QUERY_MIN_LOSS
+            weights = np.zeros(len(probs), dtype=np.longdouble)
+            weights[finite] = probs[finite] * np.exp(
+                -losses[finite].astype(np.longdouble))
+            tail_p = np.cumsum(probs[::-1])[::-1]
+            tail_w = np.cumsum(weights[::-1])[::-1]
+            exact_from = (int(np.argmax(finite)) if finite.any()
+                          else len(probs))
+            tails = (tail_p, tail_w, exact_from)
+            self._tails = tails
+        return tails
+
+    def _get_delta_for_epsilon_scan(self, epsilon: float) -> float:
+        """Direct full-grid evaluation of the hockey-stick divergence —
+        the reference the fast path is tested against, and the fallback
+        for extreme queries outside the suffix sums' exact range."""
         losses = self.losses
         mask = losses > epsilon
         if not mask.any():
@@ -117,6 +166,32 @@ class PrivacyLossDistribution:
         delta = self._infinity_mass + np.sum(
             self._probs[mask] * (-np.expm1(epsilon - losses[mask])))
         return float(min(1.0, max(0.0, delta)))
+
+    def get_delta_for_epsilon(self, epsilon: float) -> float:
+        """Hockey-stick divergence at the given epsilon (O(log L) via
+        suffix tail-sums; see _tail_sums)."""
+        epsilon = float(epsilon)
+        n = len(self._probs)
+        lo, d = self._lower_index, self._interval
+        if n == 0 or epsilon >= (lo + n - 1) * d:
+            # No grid loss exceeds epsilon.
+            return min(1.0, self._infinity_mass)
+        # First index with (lo + j) * d > epsilon: O(1) on the uniform
+        # grid, with float fixups so the boundary matches the scan's
+        # `losses > epsilon` mask exactly.
+        j = min(max(int(math.floor(epsilon / d - lo)) + 1, 0), n)
+        while j > 0 and (lo + j - 1) * d > epsilon:
+            j -= 1
+        while j < n and (lo + j) * d <= epsilon:
+            j += 1
+        if j >= n:
+            return min(1.0, self._infinity_mass)
+        tail_p, tail_w, exact_from = self._tail_sums()
+        if j < exact_from or epsilon > _FAST_QUERY_MAX_EPS:
+            return self._get_delta_for_epsilon_scan(epsilon)
+        delta = (np.longdouble(self._infinity_mass) + tail_p[j] -
+                 np.exp(np.longdouble(epsilon)) * tail_w[j])
+        return float(min(1.0, max(0.0, float(delta))))
 
     def get_epsilon_for_delta(self, delta: float) -> float:
         """Smallest epsilon such that the mechanism is (epsilon, delta)-DP."""
@@ -126,10 +201,12 @@ class PrivacyLossDistribution:
             # Maybe even a negative epsilon would do, but by convention the
             # accountant only needs eps >= 0.
             return 0.0
-        losses = self.losses
-        high = float(losses[-1]) if len(losses) else 0.0
+        n = len(self._probs)
+        high = (float((self._lower_index + n - 1) * self._interval)
+                if n else 0.0)
         low = 0.0
-        # delta(eps) is non-increasing in eps; bisect.
+        # delta(eps) is non-increasing in eps; bisect. Each probe is an
+        # O(log L) suffix-sum query, not a full-grid scan.
         for _ in range(100):
             mid = (low + high) / 2
             if self.get_delta_for_epsilon(mid) <= delta:

@@ -405,6 +405,8 @@ class PLDBudgetAccountant(BudgetAccountant):
                  aggregation_weights: Optional[list] = None):
         super().__init__(total_epsilon, total_delta, num_aggregations,
                          aggregation_weights)
+        input_validators.validate_pld_discretization(
+            pld_discretization, "PLDBudgetAccountant")
         self.minimum_noise_std = None
         self._pld_discretization = pld_discretization
 
@@ -539,32 +541,41 @@ class PLDBudgetAccountant(BudgetAccountant):
 
     def _compose_distributions(self, noise_standard_deviation: float):
         """Composes the PLDs of all registered mechanisms at the given
-        normalized noise std."""
-        from pipelinedp_tpu.accounting import pld as pldlib
+        normalized noise std.
 
-        composed = None
+        Identical mechanisms (same kind + normalized scale) collapse
+        into one spectrum-power group; the discretized pmfs come from
+        the shared spectrum cache (so the binary search's repeated
+        probes of nearby scales only pay the CDF discretization once
+        per distinct scale) and the whole set composes in a single
+        batched frequency-domain shot.
+        """
+        from pipelinedp_tpu.accounting import compose as compose_engine
+
+        groups = collections.OrderedDict()
         for spec in self._mechanisms:
             mech_type = spec.mechanism_spec.mechanism_type
             if mech_type == agg_params.MechanismType.LAPLACE:
                 # Laplace parameter b = std / sqrt(2).
-                pld = pldlib.from_laplace_mechanism(
-                    spec.sensitivity * noise_standard_deviation /
-                    math.sqrt(2) / spec.weight,
-                    value_discretization_interval=self._pld_discretization)
+                key = (str(mech_type),
+                       spec.sensitivity * noise_standard_deviation /
+                       math.sqrt(2) / spec.weight)
             elif mech_type == agg_params.MechanismType.GAUSSIAN:
-                pld = pldlib.from_gaussian_mechanism(
-                    spec.sensitivity * noise_standard_deviation / spec.weight,
-                    value_discretization_interval=self._pld_discretization)
+                key = (str(mech_type),
+                       spec.sensitivity * noise_standard_deviation /
+                       spec.weight)
             elif mech_type == agg_params.MechanismType.GENERIC:
                 # Interpret the generic mechanism's noise std as a Laplace
                 # calibration; delta proportional to epsilon.
                 epsilon_0 = math.sqrt(2) / noise_standard_deviation
                 delta_0 = epsilon_0 / self._total_epsilon * self._total_delta
-                pld = pldlib.from_privacy_parameters(
-                    epsilon_0,
-                    delta_0,
-                    value_discretization_interval=self._pld_discretization)
+                key = (str(mech_type), (epsilon_0, delta_0))
             else:
                 raise ValueError(f"Unsupported mechanism {mech_type}")
-            composed = pld if composed is None else composed.compose(pld)
-        return composed
+            groups[key] = groups.get(key, 0) + 1
+        plds = [
+            compose_engine.CACHE.get(kind, scale, 1.0,
+                                     self._pld_discretization)
+            for kind, scale in groups
+        ]
+        return compose_engine.compose_plds(plds, list(groups.values()))

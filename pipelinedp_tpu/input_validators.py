@@ -625,3 +625,42 @@ def validate_retry_policy(retry, obj_name: str) -> None:
             f"retries across every seam (dispatch retry, reshard "
             f"fallback, host fetch), so composed faults cannot spiral "
             f"one job into a retry storm.")
+
+
+def validate_tenant_accounting(tenant_accounting, obj_name: str) -> None:
+    """Validates the tenant-admission accounting mode: the string
+    "naive" (admission charges the bit-exact left-to-right epsilon sum,
+    the ledger-of-record) or "pld" (admission charges the PLD-composed
+    epsilon rebuilt from the odometer trail, with a documented safety
+    margin — the capacity multiplier).
+
+    Raises:
+        ValueError: tenant_accounting is not "naive" or "pld".
+    """
+    if tenant_accounting not in ("naive", "pld"):
+        raise ValueError(
+            f"{obj_name}: tenant_accounting must be 'naive' (admission "
+            f"charges the bit-exact epsilon sum) or 'pld' (admission "
+            f"charges the PLD-composed spend rebuilt from the odometer "
+            f"trail), but {tenant_accounting!r} given.")
+
+
+def validate_pld_discretization(pld_discretization, obj_name: str) -> None:
+    """Validates the PLD loss-grid discretization interval: a finite
+    number in [1e-7, 0.5]. Finer than 1e-7 makes million-cell grids
+    balloon past the composition engine's coarsening budget; coarser
+    than 0.5 gives ceilings too loose to be useful.
+
+    Raises:
+        ValueError: pld_discretization is not a number in [1e-7, 0.5].
+    """
+    if (not isinstance(pld_discretization, numbers.Number) or
+            isinstance(pld_discretization, bool) or
+            math.isnan(pld_discretization) or
+            not 1e-7 <= pld_discretization <= 0.5):
+        raise ValueError(
+            f"{obj_name}: pld_discretization must be a number in "
+            f"[1e-7, 0.5], but {pld_discretization!r} given — it is "
+            f"the privacy-loss grid interval; finer grids are more "
+            f"accurate but cost memory and FFT time (pessimistic "
+            f"ceiling rounding keeps every choice sound).")

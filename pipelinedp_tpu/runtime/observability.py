@@ -449,6 +449,15 @@ class OdometerRecord:
     def delta(self) -> Optional[float]:
         return getattr(self._spec, "_delta", None)
 
+    @property
+    def noise_std(self) -> Optional[float]:
+        """The calibrated noise stddev, once the budget is computed.
+
+        PLD-composed spend rebuilds (accounting/compose.py) prefer this
+        over re-deriving a scale from the (eps, delta) share, so the
+        rebuilt PLD is the PLD of the mechanism that actually ran."""
+        return getattr(self._spec, "_noise_standard_deviation", None)
+
     def accountant(self):
         return self._accountant_ref()
 
@@ -464,6 +473,7 @@ class OdometerRecord:
             "process_index": self.process_index,
             "eps": self.eps,
             "delta": self.delta,
+            "noise_std": self.noise_std,
         }
 
 
@@ -622,6 +632,8 @@ def persist_odometer(journal, job_id: str,
         outputs={
             "eps": np.asarray(_col("eps", np.nan), dtype=np.float64),
             "delta": np.asarray(_col("delta", np.nan), dtype=np.float64),
+            "noise_std": np.asarray(_col("noise_std", np.nan),
+                                    dtype=np.float64),
             "weight": np.asarray(_col("weight"), np.float64),
             "sensitivity": np.asarray(_col("sensitivity"), np.float64),
             "count": np.asarray(_col("count"), np.int64),
@@ -645,6 +657,9 @@ def load_odometer(journal, job_id: str) -> List[Dict[str, Any]]:
     for i, seq in enumerate(record.ids):
         eps = float(record.outputs["eps"][i])
         delta = float(record.outputs["delta"][i])
+        # Trails persisted before the column existed load as None.
+        noise_std = (float(record.outputs["noise_std"][i])
+                     if "noise_std" in record.outputs else np.nan)
         out.append({
             "seq": int(seq),
             "job_id": str(record.outputs["job_id"][i]) or None,
@@ -656,6 +671,7 @@ def load_odometer(journal, job_id: str) -> List[Dict[str, Any]]:
             "process_index": int(record.outputs["process_index"][i]),
             "eps": None if np.isnan(eps) else eps,
             "delta": None if np.isnan(delta) else delta,
+            "noise_std": None if np.isnan(noise_std) else noise_std,
         })
     return out
 

@@ -222,6 +222,20 @@ REGISTRY: Dict[str, Metric] = {
                  "discrete/snapped host mechanisms (geometric counts, "
                  "snapped Laplace/Gaussian sums — "
                  "dp_computations.create_discrete_mechanism)"),
+        _counter("pld_compositions",
+                 "batched one-shot PLD compositions run by the "
+                 "frequency-domain engine (accounting/compose.py: one "
+                 "increment per compose_plds call, however many "
+                 "mechanisms it folded)"),
+        _counter("pld_cache_hits",
+                 "mechanism-PLD spectrum-cache lookups served without "
+                 "re-discretizing (key: mechanism kind, normalized "
+                 "scale, sensitivity, discretization — repeat tenants "
+                 "and repeated binary-search probes land here)"),
+        _counter("pld_cache_misses",
+                 "spectrum-cache lookups that discretized a mechanism "
+                 "CDF onto the loss grid (first sighting of a "
+                 "(kind, scale, sensitivity, discretization) key)"),
         _counter("chaos_invariant_failures",
                  "chaos trials that FAILED an invariant (lost/duplicated "
                  "jobs, ledger mismatch, double-spend, nondeterminism, "
@@ -258,6 +272,12 @@ REGISTRY: Dict[str, Metric] = {
         _gauge("service_queue_depth",
                "jobs waiting in the service admission queue (admitted "
                "but not yet picked up by a worker)"),
+        _gauge("tenant_pld_epsilon_saved",
+               "naive-composition spend minus PLD-composed spend for "
+               "the gauge's tenant (job_id label = tenant id): the "
+               "epsilon the tenant's budget got back by admitting "
+               "against the composed number; refreshed whenever the "
+               "ledger rebuilds its composed spend"),
         _gauge("service_batch_occupancy",
                "lane count of the most recent megabatched launch (how "
                "full the batch window ran; 1-lane windows fall through "

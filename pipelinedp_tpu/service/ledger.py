@@ -36,6 +36,7 @@ Accounting discipline (two-phase, mirroring the admission flow):
 """
 
 import logging
+import math
 import re
 import threading
 from typing import Any, Dict, List, Optional
@@ -51,6 +52,14 @@ from pipelinedp_tpu.service.errors import TenantBudgetExceededError
 # every persisted job id (see max_job_seq).
 _JOB_SEQ_RE = re.compile(r"--j(\d+)$")
 
+# Safety margin on the PLD-composed spend before admission charges it:
+# the composed number is a pessimistic (ceiling-rounded) upper bound
+# already, but it depends on the discretization knob, so admission adds
+# 1% on top and never charges less than min(naive, pld * (1 + margin)).
+# Both the naive sum and the inflated composed bound are sound upper
+# bounds on the true spend, so their min is too.
+PLD_ADMISSION_HEADROOM = 0.01
+
 
 class TenantLedger:
     """One tenant's lifetime budget ledger (thread-safe; shared by the
@@ -58,15 +67,28 @@ class TenantLedger:
 
     # Workers reserve/charge concurrently while submit() reads
     # remaining budget; persistence runs OUTSIDE the lock (journal.put
-    # fsyncs) with a version re-check loop for write ordering.
-    _GUARDED_BY = guarded_by("_lock", "_records", "_reserved", "_version")
+    # fsyncs) with a version re-check loop for write ordering. The
+    # PLD-composed spend is likewise rebuilt OUTSIDE the lock (an FFT
+    # composition must never run under a lock workers contend on) and
+    # cached against the trail version it was computed from.
+    _GUARDED_BY = guarded_by("_lock", "_records", "_reserved", "_version",
+                             "_pld_cached", "_pld_cache_version")
 
-    def __init__(self, tenant_id: str, lifetime_epsilon: float, journal):
+    def __init__(self, tenant_id: str, lifetime_epsilon: float, journal,
+                 *,
+                 accounting_mode: str = "naive",
+                 pld_discretization: float = 1e-4):
         input_validators.validate_job_id(tenant_id, "TenantLedger")
         input_validators.validate_tenant_budget_epsilon(
             lifetime_epsilon, "TenantLedger")
+        input_validators.validate_tenant_accounting(
+            accounting_mode, "TenantLedger")
+        input_validators.validate_pld_discretization(
+            pld_discretization, "TenantLedger")
         self.tenant_id = tenant_id
         self.lifetime_epsilon = float(lifetime_epsilon)
+        self.accounting_mode = accounting_mode
+        self._pld_discretization = float(pld_discretization)
         self._journal = journal
         self._lock = threading.Lock()
         self._reserved: Dict[str, float] = {}
@@ -77,6 +99,8 @@ class TenantLedger:
         self._records: List[Dict[str, Any]] = list(
             observability.load_odometer(journal, tenant_id))
         self._version = 0
+        self._pld_cached = 0.0
+        self._pld_cache_version = -1
 
     # -- queries ---------------------------------------------------------
 
@@ -110,6 +134,62 @@ class TenantLedger:
         with self._lock:
             return sum(self._reserved.values())
 
+    def pld_spent_epsilon(self) -> float:
+        """Cumulative spend under PLD composition: the tenant's full
+        persisted trail rebuilt through the batched frequency-domain
+        engine (accounting/compose.py), queried at the trail's naive
+        delta spend — directly comparable to ``spent_epsilon()``, and
+        at k Gaussian jobs ~sqrt(k) times smaller.
+
+        Cached against the trail version; a charge invalidates. Falls
+        back to the naive sum when composition cannot produce a finite
+        number (e.g. the target delta sits below the composed infinity
+        mass) — the admission number must never be optimistic."""
+        with self._lock:
+            version = self._version
+            if self._pld_cache_version == version:
+                return self._pld_cached
+            records = list(self._records)
+        naive = sum(self._job_sums(records).values())
+        from pipelinedp_tpu.accounting import compose as compose_engine
+        try:
+            composed, _ = compose_engine.composed_epsilon_from_records(
+                records, discretization=self._pld_discretization)
+        except Exception:  # noqa: BLE001 - any rebuild failure (bad
+            # record shape, grid overflow, FFT error) degrades to the
+            # naive sum, which is always a sound admission bound; the
+            # rebuild is advisory, never load-bearing for soundness.
+            logging.exception(
+                "tenant %r: PLD spend rebuild failed — falling back to "
+                "the naive sum for this trail version.", self.tenant_id)
+            composed = naive
+        if not math.isfinite(composed):
+            composed = naive
+        from pipelinedp_tpu.runtime import telemetry
+        telemetry.set_gauge("tenant_pld_epsilon_saved",
+                            max(naive - composed, 0.0),
+                            job_id=self.tenant_id)
+        with self._lock:
+            # A charge may have raced the rebuild; only publish a cache
+            # entry for the version it was computed from.
+            if self._version == version:
+                self._pld_cached = composed
+                self._pld_cache_version = version
+        return composed
+
+    def admission_spent_epsilon(self) -> float:
+        """The spend number ``reserve()`` charges against. Naive mode:
+        the bit-exact sum (the ledger of record). PLD mode:
+        min(naive, pld * (1 + PLD_ADMISSION_HEADROOM)) — both are
+        sound upper bounds on the true spend, so the min is too, and
+        the naive clamp guarantees PLD admission is never STRICTER
+        than naive admission."""
+        if self.accounting_mode != "pld":
+            return self.spent_epsilon()
+        composed = self.pld_spent_epsilon()
+        return min(self.spent_epsilon(),
+                   composed * (1.0 + PLD_ADMISSION_HEADROOM))
+
     def max_job_seq(self) -> int:
         """Largest job-sequence number among this ledger's recorded and
         in-flight job ids (0 when none match the service format). A
@@ -128,12 +208,12 @@ class TenantLedger:
         return best
 
     def remaining_epsilon(self) -> float:
-        """Lifetime budget minus recorded spend minus in-flight
-        reservations (never below 0)."""
+        """Lifetime budget minus the ADMISSION spend (naive sum, or the
+        PLD-composed bound in pld mode) minus in-flight reservations
+        (never below 0)."""
+        spent = self.admission_spent_epsilon()
         with self._lock:
-            records = list(self._records)
             reserved = sum(self._reserved.values())
-        spent = sum(self._job_sums(records).values())
         return max(self.lifetime_epsilon - spent - reserved, 0.0)
 
     def records(self) -> List[Dict[str, Any]]:
@@ -142,18 +222,29 @@ class TenantLedger:
             return [dict(r) for r in self._records]
 
     def snapshot(self) -> Dict[str, Any]:
+        # Dual-spend columns: spent_epsilon stays the bit-exact naive
+        # sum (the ledger of record, what reconciliation checks);
+        # pld_spent_epsilon is the composed rebuild of the same trail;
+        # admission_spent_epsilon is what reserve() actually charges
+        # against under the configured accounting_mode.
+        pld_spent = self.pld_spent_epsilon()
         with self._lock:
             records = list(self._records)
             reserved = dict(self._reserved)
         sums = self._job_sums(records)
         spent = sum(sums.values())
+        admission = (spent if self.accounting_mode != "pld" else
+                     min(spent, pld_spent * (1.0 + PLD_ADMISSION_HEADROOM)))
         return {
             "tenant_id": self.tenant_id,
             "lifetime_epsilon": self.lifetime_epsilon,
+            "accounting_mode": self.accounting_mode,
             "spent_epsilon": spent,
+            "pld_spent_epsilon": pld_spent,
+            "admission_spent_epsilon": admission,
             "reserved_epsilon": sum(reserved.values()),
             "remaining_epsilon": max(
-                self.lifetime_epsilon - spent - sum(reserved.values()),
+                self.lifetime_epsilon - admission - sum(reserved.values()),
                 0.0),
             "jobs": sums,
             "mechanisms": len(records),
@@ -170,21 +261,37 @@ class TenantLedger:
     def reserve(self, job_id: str, epsilon: float) -> None:
         """Admission grant: reserves `epsilon` against the lifetime
         budget, or raises TenantBudgetExceededError — before any
-        accountant or mechanism exists for the job."""
+        accountant or mechanism exists for the job.
+
+        In pld accounting mode the spend charged here is the composed
+        bound (see admission_spent_epsilon), rebuilt OUTSIDE the lock;
+        the version re-check loops when a concurrent charge landed
+        mid-rebuild, so a reservation never admits against a stale
+        trail."""
         epsilon = float(epsilon)
-        with self._lock:
-            records = list(self._records)
-            reserved = sum(self._reserved.values())
-            spent = sum(self._job_sums(records).values())
-            if spent + reserved + epsilon > self.lifetime_epsilon:
-                raise TenantBudgetExceededError(
-                    f"tenant {self.tenant_id!r}: requested epsilon "
-                    f"{epsilon} exceeds the remaining lifetime budget "
-                    f"(lifetime {self.lifetime_epsilon}, recorded spend "
-                    f"{spent}, in-flight reservations {reserved}). The "
-                    f"job was refused before any mechanism registered; "
-                    f"nothing was spent.")
-            self._reserved[job_id] = epsilon
+        while True:
+            with self._lock:
+                version = self._version
+            # Rebuild (or hit the version cache) before taking the
+            # lock — composition must not run under it.
+            spent = self.admission_spent_epsilon()
+            with self._lock:
+                if self._version != version:
+                    # A charge landed mid-rebuild; the spend number is
+                    # for a trail that no longer exists. Go again.
+                    continue
+                reserved = sum(self._reserved.values())
+                if spent + reserved + epsilon > self.lifetime_epsilon:
+                    raise TenantBudgetExceededError(
+                        f"tenant {self.tenant_id!r}: requested epsilon "
+                        f"{epsilon} exceeds the remaining lifetime budget "
+                        f"(lifetime {self.lifetime_epsilon}, recorded spend "
+                        f"{spent} under {self.accounting_mode!r} "
+                        f"accounting, in-flight reservations {reserved}). "
+                        f"The job was refused before any mechanism "
+                        f"registered; nothing was spent.")
+                self._reserved[job_id] = epsilon
+                return
 
     def release(self, job_id: str) -> None:
         """Drops a reservation without charging (job shed before it

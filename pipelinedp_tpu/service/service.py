@@ -360,6 +360,18 @@ class DPAggregationService:
         max_batch_jobs: lane cap per megabatched launch; a group that
             fills dispatches immediately, without waiting out the
             window.
+        tenant_accounting: what admission charges a tenant's spend as.
+            "naive" (default): the bit-exact left-to-right epsilon sum
+            — the ledger of record. "pld": the PLD-composed epsilon
+            rebuilt from the same persisted trail (with a 1% safety
+            margin, and never looser than naive) — at k Gaussian jobs
+            ~sqrt(k) tighter, so the same lifetime budget admits more
+            jobs. The naive sum stays the ledger of record and its
+            reconciliation stays bit-exact in BOTH modes.
+        pld_discretization: privacy-loss grid interval for the PLD
+            spend rebuild (and the spectrum-cache key). Finer = more
+            accurate composed bound, more memory/FFT time; ceiling
+            rounding keeps every choice a sound upper bound.
     """
 
     _GUARDED_BY = guarded_by("_lock", "_ledgers", "_handles", "_seq",
@@ -377,7 +389,9 @@ class DPAggregationService:
                  memory_limit_bytes: Optional[int] = None,
                  batching: bool = False,
                  batch_window_ms: float = 25.0,
-                 max_batch_jobs: int = 16):
+                 max_batch_jobs: int = 16,
+                 tenant_accounting: str = "naive",
+                 pld_discretization: float = 1e-4):
         if not isinstance(backend, pipeline_backend.TPUBackend):
             raise ValueError(
                 f"DPAggregationService: backend must be a TPUBackend "
@@ -399,6 +413,10 @@ class DPAggregationService:
             batch_window_ms, "DPAggregationService")
         input_validators.validate_max_batch_jobs(
             max_batch_jobs, "DPAggregationService")
+        input_validators.validate_tenant_accounting(
+            tenant_accounting, "DPAggregationService")
+        input_validators.validate_pld_discretization(
+            pld_discretization, "DPAggregationService")
         self._backend = backend
         self._ledger_journal = BlockJournal(ledger_dir)
         self._ledger_dir = ledger_dir
@@ -409,6 +427,8 @@ class DPAggregationService:
         self._shed_watermark_fraction = float(shed_watermark_fraction)
         self._memory_limit_bytes = (None if memory_limit_bytes is None
                                     else int(memory_limit_bytes))
+        self._tenant_accounting = tenant_accounting
+        self._pld_discretization = float(pld_discretization)
         # Megabatching only ever coalesces launches whose lanes
         # fingerprint-match exactly; a lone-lane window, a mixed spec,
         # or any dispatch failure returns every lane to its unchanged
@@ -544,7 +564,9 @@ class DPAggregationService:
         # Construct outside the lock (the reload reads journal files);
         # a concurrent first-use race is settled by setdefault.
         ledger = TenantLedger(tenant_id, self._tenant_budget_epsilon,
-                              self._ledger_journal)
+                              self._ledger_journal,
+                              accounting_mode=self._tenant_accounting,
+                              pld_discretization=self._pld_discretization)
         with self._lock:
             return self._ledgers.setdefault(tenant_id, ledger)
 

@@ -18,7 +18,7 @@ from pipelinedp_tpu.staticcheck.model import (Finding, Module,
 # Bump when rules are added/removed or their semantics change enough to
 # invalidate baselines; surfaced in receipts so a finding-count change
 # can be told apart from a rule-set change.
-RULES_VERSION = "13"
+RULES_VERSION = "14"
 
 
 @dataclasses.dataclass

@@ -957,6 +957,13 @@ KNOB_VALIDATORS: Dict[str, str] = {
     # release semantics, validated in TPUBackend.__init__.
     "numeric_mode": "validate_numeric_mode",
     "snap_grid_bits": "validate_snap_grid_bits",
+    # PLD-accounting knobs (PR 20): the accounting mode decides which
+    # spend number admission charges (privacy semantics by definition),
+    # and the discretization interval sizes the loss grid every
+    # composed bound is computed on — both validated at the service
+    # API boundary (and in TenantLedger / PLDBudgetAccountant).
+    "tenant_accounting": "validate_tenant_accounting",
+    "pld_discretization": "validate_pld_discretization",
 }
 
 # Data-plane parameters: configuration, not failure semantics — adding

@@ -107,6 +107,13 @@ def pytest_configure(config):
         "release sentinel, discrete/snapped noise and the "
         "extreme_values fault kind (tier-1, NOT slow; select alone "
         "with -m numeric_armor)")
+    config.addinivalue_line(
+        "markers",
+        "pld: the PLD fast-composition engine and dual-spend admission "
+        "— batched-FFT vs pairwise parity, closed-form/golden "
+        "accounting checks, the query fast path, the spectrum cache "
+        "and the tenant capacity multiplier (tier-1, NOT slow; select "
+        "alone with -m pld)")
 
 
 @pytest.fixture(autouse=True)

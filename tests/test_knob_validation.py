@@ -174,6 +174,12 @@ class TestKnobRejection:
             DPAggregationService(backend, batch_window_ms=-5.0)
         with pytest.raises(ValueError, match="max_batch_jobs"):
             DPAggregationService(backend, max_batch_jobs=True)
+        with pytest.raises(ValueError, match="tenant_accounting"):
+            DPAggregationService(backend, tenant_accounting="exact")
+        with pytest.raises(ValueError, match="pld_discretization"):
+            DPAggregationService(backend, pld_discretization=0.0)
+        with pytest.raises(ValueError, match="pld_discretization"):
+            DPAggregationService(backend, pld_discretization=1.5)
 
     def test_service_knob_without_validation_is_flagged(self):
         """A new defaulted DPAggregationService.__init__ parameter with
